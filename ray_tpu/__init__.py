@@ -1,0 +1,75 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+The capabilities of the surveyed Ray snapshot (tasks, actors, objects, placement
+groups, and the Train/Tune/Data/Serve/RLlib libraries), re-designed TPU-first:
+the tensor plane is XLA collectives over ICI meshes (`ray_tpu.util.collective`,
+`ray_tpu.parallel`) instead of NCCL, and Train/RLlib drive JAX SPMD programs.
+
+Public API parity anchor: `/root/reference/python/ray/__init__.py`.
+"""
+
+from ray_tpu import exceptions
+from ray_tpu._private.worker import (
+    ObjectRef,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    wait,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, method
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+
+def remote(*args, **kwargs):
+    """`@ray_tpu.remote` decorator for functions and classes (reference:
+    `worker.py:2942` overloads). Supports bare and parameterized forms."""
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return decorator
+
+
+__all__ = [
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+    "__version__",
+]

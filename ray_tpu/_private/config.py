@@ -1,0 +1,91 @@
+"""Central typed configuration, the analogue of the reference's RAY_CONFIG system
+(`/root/reference/src/ray/common/ray_config_def.h` — 195 `RAY_CONFIG(type, name, default)`
+entries, each overridable by a `RAY_<name>` env var or a `_system_config` dict at init).
+
+Here every entry is a dataclass field; overrides come from `RAY_TPU_<NAME>` env vars or
+the `_system_config` dict passed to `ray_tpu.init`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ in (dict, list):
+        return json.loads(value)
+    return typ(value)
+
+
+@dataclasses.dataclass
+class Config:
+    # --- object store ---
+    # Objects whose serialized size is below this are stored inline in the owner's
+    # in-process memory store (reference: `memory_store.h`); larger ones go to the
+    # shared-memory store (reference: plasma, `object_manager/plasma/store.cc`).
+    max_direct_call_object_size: int = 100 * 1024
+    # Cap on the total bytes of shared-memory objects per node before puts raise
+    # ObjectStoreFullError (plasma's footprint limit).
+    object_store_memory: int = 2 * 1024 * 1024 * 1024
+    # LRU-evict sealed-but-unreferenced secondary copies when full.
+    object_store_full_delay_ms: int = 100
+
+    # --- scheduling ---
+    # Hybrid policy threshold: pack onto the best node until its utilization
+    # exceeds this, then spread (reference: `hybrid_scheduling_policy.cc`).
+    scheduler_spread_threshold: float = 0.5
+    # How long a leased idle worker is kept before being returned to the pool.
+    idle_worker_killing_time_threshold_ms: int = 1000
+    # Max stateless workers started per node beyond num_cpus (oversubscription to
+    # break ray.get deadlocks, reference worker_pool prestart behaviour).
+    maximum_startup_concurrency: int = 4
+    max_io_workers: int = 2
+
+    # --- fault tolerance ---
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    health_check_period_ms: int = 1000
+    health_check_failure_threshold: int = 5
+
+    # --- task events / tracing (reference: task_event_buffer.h, gcs_task_manager.h) ---
+    task_events_max_num_task_in_gcs: int = 100000
+    enable_timeline: bool = True
+
+    # --- collective ---
+    collective_timeout_s: float = 120.0
+
+    # --- worker process ---
+    worker_register_timeout_s: float = 60.0
+    worker_nice: int = 0
+
+    def apply_overrides(self, system_config: dict | None = None) -> "Config":
+        for f in dataclasses.fields(self):
+            env_key = f"RAY_TPU_{f.name}"
+            if env_key in os.environ:
+                setattr(self, f.name, _coerce(os.environ[env_key], f.type if isinstance(f.type, type) else type(getattr(self, f.name))))
+        if system_config:
+            for k, v in system_config.items():
+                if not hasattr(self, k):
+                    raise ValueError(f"Unknown system config key: {k}")
+                setattr(self, k, v)
+        return self
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config().apply_overrides()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

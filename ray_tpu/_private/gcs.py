@@ -1,0 +1,114 @@
+"""Global Control Store: cluster-wide metadata tables.
+
+The reference's GCS is a standalone C++ server wiring 13 managers
+(`/root/reference/src/ray/gcs/gcs_server/gcs_server.cc:128-167`): node, actor, job,
+placement-group, KV, health-check and task-event managers over a pluggable storage
+backend. In this build the control plane is hosted in the driver process (single
+controller per job); the tables below are the same managers' state, and the storage
+backend seam (`InMemoryStore` here) mirrors `store_client/in_memory_store_client.h`
+so a redis-backed variant can slot in for fault tolerance later.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID, TaskID
+
+
+class InMemoryStore:
+    """Pluggable KV storage seam (reference: `gcs/store_client/`)."""
+
+    def __init__(self):
+        self._data: Dict[str, Dict[bytes, bytes]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, table: str, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._data.setdefault(table, {})[key] = value
+
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(table, {}).get(key)
+
+    def delete(self, table: str, key: bytes) -> bool:
+        with self._lock:
+            return self._data.get(table, {}).pop(key, None) is not None
+
+    def keys(self, table: str, prefix: bytes = b"") -> List[bytes]:
+        with self._lock:
+            return [k for k in self._data.get(table, {}) if k.startswith(prefix)]
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    class_name: str
+    state: str = "PENDING"  # PENDING -> ALIVE -> RESTARTING -> DEAD
+    max_restarts: int = 0
+    num_restarts: int = 0
+    node_id: Optional[NodeID] = None
+    death_cause: Optional[str] = None
+
+
+@dataclass
+class TaskEvent:
+    """Task lifecycle event for the state API / timeline (reference:
+    `gcs_task_manager.h:61`, `task_event_buffer.h:188`)."""
+
+    task_id: str
+    name: str
+    state: str
+    timestamp: float
+    node_id: str = ""
+    worker_pid: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class GCS:
+    """In-driver control store; every mutation happens on the scheduler thread."""
+
+    def __init__(self):
+        self.store = InMemoryStore()
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[str, ActorID] = {}
+        self.placement_groups: Dict[PlacementGroupID, Any] = {}
+        self.function_table: Dict[str, bytes] = {}
+        self.task_events: List[TaskEvent] = []
+        self._task_event_cap = 100000
+        self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
+
+    # --- internal KV (reference: GcsKvManager / experimental.internal_kv) ---
+    def kv_put(self, key: bytes, value: bytes, namespace: str = "default") -> None:
+        self.store.put(f"kv:{namespace}", key, value)
+
+    def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
+        return self.store.get(f"kv:{namespace}", key)
+
+    def kv_del(self, key: bytes, namespace: str = "default") -> bool:
+        return self.store.delete(f"kv:{namespace}", key)
+
+    def kv_keys(self, prefix: bytes, namespace: str = "default") -> List[bytes]:
+        return self.store.keys(f"kv:{namespace}", prefix)
+
+    # --- pubsub (reference: src/ray/pubsub) ---
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        self._subscribers.setdefault(channel, []).append(callback)
+
+    def publish(self, channel: str, message: Any) -> None:
+        for cb in self._subscribers.get(channel, []):
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+    # --- task events ---
+    def record_task_event(self, ev: TaskEvent) -> None:
+        self.task_events.append(ev)
+        if len(self.task_events) > self._task_event_cap:
+            # Bounded store with head drop, like the reference's gcs_task_manager.
+            del self.task_events[: self._task_event_cap // 10]

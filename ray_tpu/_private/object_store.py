@@ -1,0 +1,178 @@
+"""Node-local object store: an in-process memory store for small objects plus a
+shared-memory (/dev/shm mmap) store for large ones.
+
+This is the TPU-native re-design of the reference's two stores:
+ - in-process memory store (`/root/reference/src/ray/core_worker/store_provider/
+   memory_store/memory_store.h:43`) for small/inlined results, and
+ - plasma (`/root/reference/src/ray/object_manager/plasma/store.cc`), the node-level
+   shared-memory store with zero-copy reads.
+
+Differences from plasma, deliberate for the TPU build:
+ - one segment file per object (created by the *writing* process, attached lazily by
+   readers) instead of a single dlmalloc arena behind a unix-socket protocol. Segment
+   metadata travels through the control plane, so writers never copy payload bytes
+   through a socket. A C++ arena allocator can replace the per-object files without
+   changing this interface (see ray_tpu/_native).
+ - jax.Array device buffers never enter the store (SURVEY.md §7); only host arrays do.
+
+Layout of a segment file:  [8-byte inband len][inband pickle][buffer 0][buffer 1]...
+with every buffer 64-byte aligned so numpy views over the mmap are aligned.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import SerializedValue, deserialize, serialize
+
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+@dataclass
+class ObjectMeta:
+    """Control-plane record describing where an object's bytes live."""
+
+    object_id: ObjectID
+    size: int
+    # For inline objects, the payload travels with the metadata.
+    inband: Optional[bytes] = None
+    inline_buffers: Optional[List[bytes]] = None
+    # For shm objects: segment path + (offset, length) per out-of-band buffer.
+    segment: Optional[str] = None
+    buffer_layout: Optional[List[Tuple[int, int]]] = None
+    # Error payloads are stored like inline objects but marked, so `get` re-raises.
+    is_error: bool = False
+
+
+class SharedSegment:
+    """A single mmap'ed object segment under /dev/shm."""
+
+    def __init__(self, path: str, size: int = 0, create: bool = False):
+        self.path = path
+        if create:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                self.mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+        else:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                size = os.fstat(fd).st_size
+                self.mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+        self.size = size
+
+    def close(self):
+        try:
+            self.mm.close()
+        except BufferError:
+            # A numpy view still references the mapping; the mmap will be freed
+            # when the last view dies.
+            pass
+
+    def unlink(self):
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+def write_segment(dir_path: str, object_id: ObjectID, sv: SerializedValue) -> ObjectMeta:
+    """Create a segment for `sv` and copy its buffers in (the only copy on the write
+    path; readers are zero-copy)."""
+    header = 8 + len(sv.inband)
+    layout: List[Tuple[int, int]] = []
+    offset = _align(header)
+    for b in sv.buffers:
+        layout.append((offset, b.nbytes))
+        offset = _align(offset + b.nbytes)
+    total = max(offset, header)
+    path = os.path.join(dir_path, object_id.hex())
+    seg = SharedSegment(path, size=total, create=True)
+    mm = seg.mm
+    mm[0:8] = len(sv.inband).to_bytes(8, "little")
+    mm[8:header] = sv.inband
+    for (off, length), buf in zip(layout, sv.buffers):
+        mm[off : off + length] = buf
+    seg.close()
+    return ObjectMeta(
+        object_id=object_id,
+        size=total,
+        segment=path,
+        buffer_layout=layout,
+    )
+
+
+class LocalObjectStore:
+    """Per-process facade over inline values and shm segments.
+
+    Each process keeps attached segments alive in `_segments` while any
+    deserialized view may reference them; the owner decides when to unlink.
+    """
+
+    def __init__(self, shm_dir: str):
+        self.shm_dir = shm_dir
+        os.makedirs(shm_dir, exist_ok=True)
+        self._segments: Dict[str, SharedSegment] = {}
+        self._lock = threading.Lock()
+
+    # --- write path ---
+    def put_serialized(self, object_id: ObjectID, sv: SerializedValue, inline_threshold: int) -> ObjectMeta:
+        if sv.total_size <= inline_threshold or not sv.buffers:
+            return ObjectMeta(
+                object_id=object_id,
+                size=sv.total_size,
+                inband=sv.inband,
+                inline_buffers=[bytes(b) for b in sv.buffers],
+            )
+        return write_segment(self.shm_dir, object_id, sv)
+
+    def put(self, object_id: ObjectID, value, inline_threshold: int) -> ObjectMeta:
+        return self.put_serialized(object_id, serialize(value), inline_threshold)
+
+    # --- read path ---
+    def get(self, meta: ObjectMeta):
+        if meta.segment is None:
+            buffers = [memoryview(b) for b in (meta.inline_buffers or [])]
+            return deserialize(meta.inband, buffers)
+        with self._lock:
+            seg = self._segments.get(meta.segment)
+            if seg is None:
+                seg = SharedSegment(meta.segment)
+                self._segments[meta.segment] = seg
+        mm = seg.mm
+        inband_len = int.from_bytes(mm[0:8], "little")
+        inband = mm[8 : 8 + inband_len]
+        buffers = [memoryview(mm)[off : off + length] for off, length in meta.buffer_layout or []]
+        return deserialize(bytes(inband), buffers)
+
+    # --- lifecycle (owner side) ---
+    def free(self, meta: ObjectMeta):
+        if meta.segment is None:
+            return
+        with self._lock:
+            seg = self._segments.pop(meta.segment, None)
+        if seg is not None:
+            seg.close()
+        try:
+            os.unlink(meta.segment)
+        except FileNotFoundError:
+            pass
+
+    def detach_all(self):
+        with self._lock:
+            for seg in self._segments.values():
+                seg.close()
+            self._segments.clear()

@@ -1,0 +1,76 @@
+"""Wire types exchanged between the driver control plane and worker processes.
+
+The reference splits this across protobuf services (`/root/reference/src/ray/protobuf/
+core_worker.proto`, `node_manager.proto`) spoken over gRPC. Here a node is a single
+machine and the control plane lives in the driver process, so messages are pickled
+tuples over `multiprocessing` duplex pipes — payload bytes for large objects never
+travel on these pipes (they go through the shared-memory store; see object_store.py).
+
+Message grammar (all pickled with cloudpickle):
+  worker -> driver:
+    ("register", worker_id_hex, pid)
+    ("done", task_id_bytes, ok: bool, result_metas: list[ObjectMeta])
+    ("req", req_id: int, method: str, payload)        # blocking control-plane RPC
+    ("actor_exit", reason)
+  driver -> worker:
+    ("exec", ExecRequest)
+    ("resp", req_id: int, ok: bool, payload)
+    ("shutdown",)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu._private.object_store import ObjectMeta
+
+
+@dataclass
+class FunctionDescriptor:
+    """Identifies a pickled function/class in the GCS function table, so each worker
+    deserializes it once and caches it (reference: function table keyed by
+    function_id in `_private/function_manager.py`)."""
+
+    function_id: str  # sha1 of the pickled blob
+    name: str
+
+
+@dataclass
+class TaskSpec:
+    """The analogue of the reference's `TaskSpecification`
+    (`/root/reference/src/ray/common/task/task_spec.h`)."""
+
+    task_id: TaskID
+    func: FunctionDescriptor
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    is_actor_creation: bool = False
+    method_name: Optional[str] = None
+    # Scheduling
+    scheduling_strategy: Any = None
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    name: str = ""
+    # Runtime env (subset: env_vars)
+    env_vars: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ExecRequest:
+    """A task pushed to a leased worker (reference: `CoreWorkerService.PushTask`)."""
+
+    spec: TaskSpec
+    # Resolved top-level args: each is either ("meta", ObjectMeta) for an object-store
+    # arg or ("ref", object_id_bytes) — refs stay refs only when nested, so top-level
+    # entries here are always metas. kwargs likewise.
+    arg_metas: List[ObjectMeta]
+    kwarg_metas: Dict[str, ObjectMeta]
+    # Function blob rides along the first time a worker sees this function_id.
+    func_blob: Optional[bytes] = None
+    # Return object ids (assigned by the submitter).
+    return_ids: List[ObjectID] = field(default_factory=list)

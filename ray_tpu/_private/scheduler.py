@@ -1,0 +1,1449 @@
+"""Driver-hosted cluster scheduler: node table, worker pools, task dispatch,
+placement groups, and fault handling.
+
+This collapses three reference components into one event loop, keeping their seams:
+ - `ClusterTaskManager`/`LocalTaskManager` two-level scheduling with a hybrid
+   pack-then-spread policy (`/root/reference/src/ray/raylet/scheduling/
+   cluster_task_manager.h`, `local_task_manager.h`, `policy/hybrid_scheduling_policy.cc`),
+ - the worker pool with on-demand startup (`raylet/worker_pool.h:77`),
+ - the GCS actor/placement-group managers (`gcs/gcs_server/gcs_actor_manager.h:281`,
+   `gcs_placement_group_manager.h:223`).
+
+Threading: ONE scheduler thread owns all mutable state. Driver API threads and
+worker pipes feed it through a command queue + wakeup socket; results come back on
+`concurrent.futures.Future`s. Workers blocked in `get`/`wait` release their CPU so
+recursive task graphs cannot deadlock the pool (the reference releases resources on
+`ray.get` the same way).
+"""
+
+from __future__ import annotations
+
+import base64
+import concurrent.futures
+import multiprocessing
+import os
+import pickle
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.gcs import GCS, ActorInfo, TaskEvent
+from ray_tpu._private.ids import (
+    ActorID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu._private.object_store import ObjectMeta
+from ray_tpu._private.protocol import ExecRequest, TaskSpec
+from ray_tpu._private.worker_main import WorkerArgs, worker_loop
+
+_mp = multiprocessing.get_context("spawn")
+
+
+class _Proc:
+    """Popen adapter with a multiprocessing.Process-like surface."""
+
+    def __init__(self, popen: subprocess.Popen):
+        self.popen = popen
+
+    @property
+    def pid(self) -> int:
+        return self.popen.pid
+
+    def is_alive(self) -> bool:
+        return self.popen.poll() is None
+
+    def terminate(self) -> None:
+        try:
+            self.popen.kill()
+        except ProcessLookupError:
+            pass
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        try:
+            self.popen.wait(timeout)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    node_id: NodeID
+    process: Any
+    conn: Any = None  # attached when the worker connects back
+    state: str = "idle"  # idle | busy | blocked
+    current_task: Optional[TaskID] = None
+    actor_id: Optional[ActorID] = None
+    known_functions: set = field(default_factory=set)
+    send_lock: threading.Lock = field(default_factory=threading.Lock)
+    outbox: List[bytes] = field(default_factory=list)
+    started_at: float = field(default_factory=time.time)
+
+    def send(self, msg) -> bool:
+        data = serialization.dumps(msg)
+        with self.send_lock:
+            if self.conn is None:
+                # Worker still starting up: queue until it connects back.
+                self.outbox.append(data)
+                return True
+            try:
+                self.conn.send_bytes(data)
+                return True
+            except (OSError, ValueError, BrokenPipeError):
+                return False
+
+    def attach(self, conn) -> bool:
+        with self.send_lock:
+            self.conn = conn
+            try:
+                for data in self.outbox:
+                    conn.send_bytes(data)
+            except (OSError, ValueError, BrokenPipeError):
+                return False
+            self.outbox.clear()
+        return True
+
+
+@dataclass
+class NodeState:
+    """A (possibly virtual) node: resource spec + worker pool. `cluster_utils.Cluster`
+    registers several of these to emulate multi-node on one machine, the analogue of
+    the reference's in-process multi-raylet `Cluster` fixture
+    (`/root/reference/python/ray/cluster_utils.py:99`)."""
+
+    node_id: NodeID
+    resources: Dict[str, float]
+    available: Dict[str, float]
+    shm_dir: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    workers: Dict[WorkerID, WorkerHandle] = field(default_factory=dict)
+    idle: List[WorkerID] = field(default_factory=list)
+    alive: bool = True
+
+    def utilization(self) -> float:
+        total = sum(v for v in self.resources.values() if v > 0) or 1.0
+        avail = sum(max(self.available.get(k, 0.0), 0.0) for k in self.resources)
+        return 1.0 - avail / total
+
+
+@dataclass
+class TaskRecord:
+    spec: TaskSpec
+    # Each arg entry: ("id", bytes) for an ObjectRef dep | ("meta", ObjectMeta).
+    arg_entries: List[Tuple[str, Any]]
+    kwarg_entries: Dict[str, Tuple[str, Any]]
+    return_ids: List[ObjectID]
+    func_blob: Optional[bytes]
+    retries_left: int = 0
+    state: str = "PENDING"
+    worker: Optional[WorkerID] = None
+    node: Optional[NodeID] = None
+    acquired: Dict[str, float] = field(default_factory=dict)
+    acquired_pg: Optional[Tuple[PlacementGroupID, int]] = None
+    unresolved: int = 0
+    submitted_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    creation_req: ExecRequest
+    resources: Dict[str, float]
+    worker: Optional[WorkerID] = None
+    node: Optional[NodeID] = None
+    state: str = "PENDING"  # PENDING -> ALIVE -> RESTARTING -> DEAD
+    max_restarts: int = 0
+    num_restarts: int = 0
+    inflight: List[TaskID] = field(default_factory=list)
+    # Method calls queued while the actor is PENDING/RESTARTING.
+    backlog: List[ExecRequest] = field(default_factory=list)
+    acquired_pg: Optional[Tuple[PlacementGroupID, int]] = None
+    acquired: Dict[str, float] = field(default_factory=dict)
+    death_cause: Optional[str] = None
+
+
+@dataclass
+class Bundle:
+    index: int
+    resources: Dict[str, float]
+    node: Optional[NodeID] = None
+    available: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class PGRecord:
+    pg_id: PlacementGroupID
+    bundles: List[Bundle]
+    strategy: str
+    state: str = "PENDING"
+    ready_futures: List[concurrent.futures.Future] = field(default_factory=list)
+    name: str = ""
+
+
+def _fits(avail: Dict[str, float], req: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+
+def _acquire(avail: Dict[str, float], req: Dict[str, float]) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _release(avail: Dict[str, float], req: Dict[str, float]) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+class Scheduler:
+    def __init__(self, gcs: GCS, config: Config, session_dir: str):
+        self.gcs = gcs
+        self.config = config
+        self.session_dir = session_dir
+        self.nodes: Dict[NodeID, NodeState] = {}
+        self.node_order: List[NodeID] = []
+        self.object_table: Dict[bytes, ObjectMeta] = {}
+        self.object_waiters: Dict[bytes, List[Callable[[ObjectMeta], None]]] = {}
+        self.tasks: Dict[TaskID, TaskRecord] = {}
+        self.pending: List[TaskRecord] = []
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.pgs: Dict[PlacementGroupID, PGRecord] = {}
+        self.pending_pgs: List[PGRecord] = []
+        self._commands: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._conn_to_worker: Dict[Any, WorkerHandle] = {}
+        self._workers_by_id: Dict[str, WorkerHandle] = {}
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._acceptor: Optional[threading.Thread] = None
+        self._rr_counter = 0
+        self._authkey = os.urandom(16)
+        self._sock_path = os.path.join(session_dir, "worker.sock")
+        from multiprocessing.connection import Listener
+
+        self._listener = Listener(self._sock_path, family="AF_UNIX", authkey=self._authkey)
+
+    # ------------------------------------------------------------------ lifecycle
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="scheduler")
+        self._thread.start()
+        self._acceptor = threading.Thread(target=self._accept_loop, daemon=True, name="acceptor")
+        self._acceptor.start()
+
+    def _accept_loop(self):
+        """Accept worker connect-backs (workers are subprocesses of
+        `worker_entry.py`, which dial the unix socket on startup)."""
+        while not self._stopped.is_set():
+            try:
+                conn = self._listener.accept()
+                worker_id_hex = conn.recv_bytes().decode()
+            except (OSError, EOFError, Exception):
+                if self._stopped.is_set():
+                    return
+                continue
+            self.call("attach_worker", (worker_id_hex, conn))
+
+    def _cmd_attach_worker(self, payload):
+        worker_id_hex, conn = payload
+        wh = self._workers_by_id.get(worker_id_hex)
+        if wh is None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return False
+        if not wh.attach(conn):
+            self._on_worker_death(wh)
+            return False
+        self._conn_to_worker[conn] = wh
+        return True
+
+    def stop(self):
+        fut = self.call("_stop", None)
+        try:
+            fut.result(timeout=5)
+        except Exception:
+            pass
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._wake()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def call(self, method: str, payload: Any) -> concurrent.futures.Future:
+        """Thread-safe entry for driver API threads."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._commands.put((method, payload, fut))
+        self._wake()
+        return fut
+
+    def _wake(self):
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------ main loop
+    def _loop(self):
+        import multiprocessing.connection as mpc
+
+        last_health_check = time.time()
+        while not self._stopped.is_set():
+            waitables = [self._wake_r] + [
+                w.conn for n in self.nodes.values() for w in n.workers.values() if w.conn is not None
+            ]
+            try:
+                ready = mpc.wait(waitables, timeout=0.25)
+            except OSError:
+                ready = []
+            # Reap workers that died before (or without) connecting back.
+            now = time.time()
+            if now - last_health_check > 0.5:
+                last_health_check = now
+                for node in list(self.nodes.values()):
+                    for wh in list(node.workers.values()):
+                        if not wh.process.is_alive() and wh.conn is None:
+                            self._on_worker_death(wh)
+            for obj in ready:
+                if obj is self._wake_r:
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except BlockingIOError:
+                        pass
+                    continue
+                wh = self._conn_to_worker.get(obj)
+                if wh is None:
+                    continue
+                self._drain_worker(wh)
+            # Drain commands.
+            while True:
+                try:
+                    method, payload, fut = self._commands.get_nowait()
+                except queue.Empty:
+                    break
+                if method == "_stop":
+                    self._shutdown_workers()
+                    fut.set_result(None)
+                    self._stopped.set()
+                    break
+                try:
+                    result = getattr(self, "_cmd_" + method)(payload)
+                    # _ASYNC handlers resolve a caller-provided inner future later;
+                    # the command future just acknowledges receipt.
+                    fut.set_result(None if result is _ASYNC else result)
+                except Exception as e:  # noqa: BLE001
+                    fut.set_exception(e)
+            # The loop must survive any scheduling-path exception: a dead
+            # scheduler thread would hang every future get/put forever.
+            try:
+                self._schedule()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _drain_worker(self, wh: WorkerHandle):
+        try:
+            while wh.conn.poll():
+                data = wh.conn.recv_bytes()
+                self._on_worker_message(wh, serialization.loads(data))
+        except (EOFError, OSError):
+            self._on_worker_death(wh)
+
+    def _shutdown_workers(self):
+        for node in self.nodes.values():
+            for wh in list(node.workers.values()):
+                wh.send(("shutdown",))
+        deadline = time.time() + 2.0
+        for node in self.nodes.values():
+            for wh in list(node.workers.values()):
+                t = max(0.0, deadline - time.time())
+                wh.process.join(timeout=t)
+                if wh.process.is_alive():
+                    wh.process.terminate()
+
+    # ------------------------------------------------------------------ nodes
+    def _cmd_add_node(self, payload) -> NodeID:
+        resources, labels = payload
+        node_id = NodeID.from_random()
+        shm_dir = os.path.join(self.session_dir, "shm")
+        node = NodeState(
+            node_id=node_id,
+            resources=dict(resources),
+            available=dict(resources),
+            shm_dir=shm_dir,
+            labels=labels or {},
+        )
+        self.nodes[node_id] = node
+        self.node_order.append(node_id)
+        return node_id
+
+    def _cmd_remove_node(self, node_id: NodeID):
+        """Simulate node failure: kill its workers, fail its tasks/actors
+        (chaos-testing hook; reference: NodeKillerActor, test_utils.py:1355)."""
+        node = self.nodes.get(node_id)
+        if node is None:
+            return False
+        node.alive = False
+        for wh in list(node.workers.values()):
+            try:
+                wh.process.terminate()
+            except Exception:
+                pass
+            self._on_worker_death(wh)
+        del self.nodes[node_id]
+        self.node_order.remove(node_id)
+        # PG bundles on this node go back to pending.
+        for pg in self.pgs.values():
+            for b in pg.bundles:
+                if b.node == node_id:
+                    b.node = None
+                    pg.state = "RESCHEDULING"
+                    if pg not in self.pending_pgs:
+                        self.pending_pgs.append(pg)
+        return True
+
+    def _cmd_get_nodes(self, _):
+        return [
+            {
+                "node_id": n.node_id.hex(),
+                "resources": dict(n.resources),
+                "available": dict(n.available),
+                "alive": n.alive,
+                "labels": dict(n.labels),
+                "num_workers": len(n.workers),
+            }
+            for n in self.nodes.values()
+        ]
+
+    def _cmd_available_resources(self, _):
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            for k, v in n.available.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def _cmd_cluster_resources(self, _):
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            for k, v in n.resources.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    # ------------------------------------------------------------------ workers
+    def _spawn_worker(self, node: NodeState, actor_id: Optional[ActorID] = None,
+                      env_vars: Optional[Dict[str, str]] = None) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        args = WorkerArgs(
+            worker_id_hex=worker_id.hex(),
+            node_id_hex=node.node_id.hex(),
+            shm_dir=node.shm_dir,
+            session_name=os.path.basename(self.session_dir),
+            config=self.config,
+            env_vars=env_vars or {},
+            is_actor_worker=actor_id is not None,
+        )
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        envb = dict(os.environ)
+        envb.update(env_vars or {})
+        envb["RAY_TPU_AUTHKEY_HEX"] = self._authkey.hex()
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        envb["PYTHONPATH"] = repo_root + os.pathsep + envb.get("PYTHONPATH", "")
+        blob = base64.b64encode(pickle.dumps(args)).decode()
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:8]}.log"), "wb")
+        popen = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_entry",
+             "--address", self._sock_path, "--args", blob],
+            env=envb,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            cwd=repo_root,
+        )
+        out.close()
+        wh = WorkerHandle(
+            worker_id=worker_id,
+            node_id=node.node_id,
+            process=_Proc(popen),
+            state="idle" if actor_id is None else "busy",
+            actor_id=actor_id,
+        )
+        node.workers[worker_id] = wh
+        self._workers_by_id[worker_id.hex()] = wh
+        if actor_id is None:
+            node.idle.append(worker_id)
+        return wh
+
+    def _on_worker_death(self, wh: WorkerHandle):
+        node = self.nodes.get(wh.node_id)
+        if node is not None:
+            node.workers.pop(wh.worker_id, None)
+            if wh.worker_id in node.idle:
+                node.idle.remove(wh.worker_id)
+        self._workers_by_id.pop(wh.worker_id.hex(), None)
+        if wh.conn is not None:
+            self._conn_to_worker.pop(wh.conn, None)
+            try:
+                wh.conn.close()
+            except OSError:
+                pass
+        if wh.actor_id is not None:
+            self._handle_actor_worker_death(wh)
+        elif wh.current_task is not None:
+            rec = self.tasks.get(wh.current_task)
+            if rec is not None:
+                self._handle_task_worker_death(rec)
+
+    def _handle_task_worker_death(self, rec: TaskRecord):
+        self._release_task_resources(rec)
+        if rec.retries_left > 0:
+            rec.retries_left -= 1
+            rec.state = "PENDING"
+            rec.worker = None
+            self.pending.append(rec)
+            self._record_event(rec.spec, "RETRY")
+        else:
+            from ray_tpu.exceptions import WorkerCrashedError
+
+            err = WorkerCrashedError(
+                f"Worker running task {rec.spec.name or rec.spec.func.name} died "
+                "unexpectedly (no retries left)."
+            )
+            self._store_error_results(rec, err)
+
+    def _handle_actor_worker_death(self, wh: WorkerHandle):
+        from ray_tpu.exceptions import RayActorError
+
+        ar = self.actors.get(wh.actor_id)
+        if ar is None:
+            return
+        info = self.gcs.actors.get(wh.actor_id)
+        # Fail all in-flight calls.
+        err = RayActorError(f"Actor {wh.actor_id.hex()} died (worker crashed).")
+        for tid in ar.inflight:
+            rec = self.tasks.get(tid)
+            if rec is not None:
+                self._store_error_results(rec, err)
+        ar.inflight.clear()
+        ar.worker = None
+        if ar.state == "DEAD":
+            self._release_actor_resources(ar)
+            return
+        if ar.num_restarts < ar.max_restarts:
+            ar.num_restarts += 1
+            ar.state = "RESTARTING"
+            if info:
+                info.state = "RESTARTING"
+                info.num_restarts = ar.num_restarts
+            self._release_actor_resources(ar)
+            self._try_start_actor(ar)
+        else:
+            ar.state = "DEAD"
+            ar.death_cause = "worker crashed"
+            if info:
+                info.state = "DEAD"
+                info.death_cause = ar.death_cause
+            self._release_actor_resources(ar)
+            for req in ar.backlog:
+                rec = self.tasks.get(req.spec.task_id)
+                if rec is not None:
+                    self._store_error_results(rec, err)
+            ar.backlog.clear()
+
+    # ------------------------------------------------------------------ messages
+    def _on_worker_message(self, wh: WorkerHandle, msg):
+        kind = msg[0]
+        if kind == "register":
+            return
+        if kind == "done":
+            _, task_id_bytes, ok, metas = msg
+            self._on_task_done(wh, TaskID(task_id_bytes), ok, metas)
+        elif kind == "req":
+            _, req_id, method, payload = msg
+            self._on_worker_request(wh, req_id, method, payload)
+
+    def _respond(self, wh: WorkerHandle, req_id: int, ok: bool, payload):
+        wh.send(("resp", req_id, ok, payload))
+
+    def _on_worker_request(self, wh: WorkerHandle, req_id: int, method: str, payload):
+        handler = getattr(self, "_req_" + method, None)
+        if handler is None:
+            self._respond(wh, req_id, False, ValueError(f"unknown request {method}"))
+            return
+        try:
+            handler(wh, req_id, payload)
+        except Exception as e:  # noqa: BLE001
+            self._respond(wh, req_id, False, e)
+
+    def _on_task_done(self, wh: WorkerHandle, task_id: TaskID, ok: bool, metas: List[ObjectMeta]):
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            return
+        rec.state = "FINISHED" if ok else "FAILED"
+        self._record_event(rec.spec, rec.state)
+        for meta in metas:
+            self._seal_object(meta)
+        if rec.spec.actor_id is not None:
+            ar = self.actors.get(rec.spec.actor_id)
+            if ar is not None:
+                if task_id in ar.inflight:
+                    ar.inflight.remove(task_id)
+                if rec.spec.is_actor_creation:
+                    self._on_actor_created(ar, ok, metas)
+        else:
+            self._release_task_resources(rec)
+            if wh.actor_id is None:
+                wh.state = "idle"
+                wh.current_task = None
+                node = self.nodes.get(wh.node_id)
+                if node is not None and wh.worker_id not in node.idle and node.alive:
+                    node.idle.append(wh.worker_id)
+
+    def _on_actor_created(self, ar: ActorRecord, ok: bool, metas: List[ObjectMeta]):
+        info = self.gcs.actors.get(ar.actor_id)
+        if ar.state == "DEAD":
+            # Killed while the creation task was in flight: tear the worker down.
+            node = self.nodes.get(ar.node)
+            wh = node.workers.get(ar.worker) if node else None
+            if wh is not None:
+                try:
+                    wh.process.terminate()
+                except Exception:
+                    pass
+                self._on_worker_death(wh)
+            return
+        if ok:
+            ar.state = "ALIVE"
+            if info:
+                info.state = "ALIVE"
+                info.node_id = ar.node
+            for req in ar.backlog:
+                self._dispatch_actor_call(ar, req)
+            ar.backlog.clear()
+        else:
+            # Creation raised: actor is dead; error already sealed into the
+            # creation "ready" object so waiters see the root cause.
+            ar.state = "DEAD"
+            ar.death_cause = "creation task failed"
+            if info:
+                info.state = "DEAD"
+                info.death_cause = ar.death_cause
+            from ray_tpu.exceptions import RayActorError
+
+            err = RayActorError(f"Actor {ar.actor_id.hex()} failed during creation.")
+            for req in ar.backlog:
+                rec = self.tasks.get(req.spec.task_id)
+                if rec is not None:
+                    self._store_error_results(rec, err)
+            ar.backlog.clear()
+            self._release_actor_resources(ar)
+
+    # ------------------------------------------------------------------ objects
+    def _seal_object(self, meta: ObjectMeta):
+        key = meta.object_id.binary()
+        self.object_table[key] = meta
+        for cb in self.object_waiters.pop(key, []):
+            cb(meta)
+
+    def _store_error_results(self, rec: TaskRecord, err: Exception):
+        sv = serialization.serialize(err)
+        for oid in rec.return_ids:
+            meta = ObjectMeta(
+                object_id=oid,
+                size=sv.total_size,
+                inband=sv.inband,
+                inline_buffers=[bytes(b) for b in sv.buffers],
+                is_error=True,
+            )
+            self._seal_object(meta)
+        rec.state = "FAILED"
+        self._record_event(rec.spec, "FAILED")
+
+    # ------------------------------------------------------------------ commands (driver API)
+    def _cmd_submit(self, payload):
+        rec: TaskRecord = payload
+        self._register_task(rec)
+        return [oid for oid in rec.return_ids]
+
+    def _cmd_put_meta(self, meta: ObjectMeta):
+        self._seal_object(meta)
+        return True
+
+    def _cmd_get_metas(self, payload):
+        ids, fut = payload
+        self._async_get_metas(ids, fut)
+        return _ASYNC
+
+    def _cmd_peek_metas(self, ids: List[bytes]):
+        return {i: self.object_table.get(i) for i in ids if i in self.object_table}
+
+    def _cmd_wait(self, payload):
+        ids, num_returns, fut = payload
+        self._async_wait(ids, num_returns, fut)
+        return _ASYNC
+
+    def _cmd_free(self, ids: List[bytes]):
+        freed = []
+        for i in ids:
+            meta = self.object_table.pop(i, None)
+            if meta is not None and meta.segment:
+                freed.append(meta)
+        return freed
+
+    def _cmd_create_actor(self, payload):
+        ar, info, name = payload
+        self.actors[ar.actor_id] = ar
+        self.gcs.actors[ar.actor_id] = info
+        if name:
+            if name in self.gcs.named_actors:
+                raise ValueError(f"Actor name '{name}' already taken")
+            self.gcs.named_actors[name] = ar.actor_id
+        self._try_start_actor(ar)
+        return True
+
+    def _cmd_submit_actor_task(self, payload):
+        req: ExecRequest = payload
+        return self._submit_actor_task(req)
+
+    def _cmd_get_actor_by_name(self, name: str):
+        actor_id = self.gcs.named_actors.get(name)
+        if actor_id is None:
+            return None
+        info = self.gcs.actors.get(actor_id)
+        if info is None or info.state == "DEAD":
+            return None
+        return actor_id
+
+    def _cmd_kill_actor(self, payload):
+        from ray_tpu.exceptions import RayActorError
+
+        actor_id, no_restart = payload
+        ar = self.actors.get(actor_id)
+        if ar is None:
+            return False
+        was_pending = ar.state in ("PENDING", "RESTARTING")
+        if no_restart:
+            ar.max_restarts = ar.num_restarts  # no more restarts
+            ar.state = "DEAD"
+            ar.death_cause = "ray_tpu.kill"
+            info = self.gcs.actors.get(actor_id)
+            if info:
+                info.state = "DEAD"
+                info.death_cause = "ray_tpu.kill"
+        if was_pending and no_restart:
+            # The creation task may still be queued: drop it and fail the backlog,
+            # or _on_actor_created would resurrect a killed actor.
+            crec = self.tasks.get(ar.creation_req.spec.task_id)
+            if crec is not None and crec.state == "PENDING":
+                crec.state = "CANCELLED"
+            err = RayActorError("Actor was killed before creation completed.")
+            for req in ar.backlog:
+                rec = self.tasks.get(req.spec.task_id)
+                if rec is not None:
+                    self._store_error_results(rec, err)
+            ar.backlog.clear()
+            self._release_actor_resources(ar)
+        if ar.worker is not None:
+            node = self.nodes.get(ar.node)
+            wh = node.workers.get(ar.worker) if node else None
+            if wh is not None:
+                try:
+                    wh.process.terminate()
+                except Exception:
+                    pass
+                self._on_worker_death(wh)
+        # Drop the name so it can be reused.
+        for name, aid in list(self.gcs.named_actors.items()):
+            if aid == actor_id and ar.state == "DEAD":
+                del self.gcs.named_actors[name]
+        return True
+
+    def _cmd_register_function(self, payload):
+        function_id, blob = payload
+        self.gcs.function_table[function_id] = blob
+        return True
+
+    def _cmd_kv(self, payload):
+        op, args = payload
+        return getattr(self.gcs, "kv_" + op)(*args)
+
+    def _cmd_create_pg(self, payload):
+        pg: PGRecord = payload
+        self.pgs[pg.pg_id] = pg
+        self.pending_pgs.append(pg)
+        return True
+
+    def _cmd_pg_ready(self, payload):
+        pg_id, fut = payload
+        pg = self.pgs.get(pg_id)
+        if pg is None:
+            fut.set_exception(ValueError("no such placement group"))
+            return _ASYNC
+        if pg.state == "CREATED":
+            fut.set_result(True)
+        else:
+            pg.ready_futures.append(fut)
+        return _ASYNC
+
+    def _cmd_remove_pg(self, pg_id: PlacementGroupID):
+        pg = self.pgs.pop(pg_id, None)
+        if pg is None:
+            return False
+        if pg in self.pending_pgs:
+            self.pending_pgs.remove(pg)
+        for b in pg.bundles:
+            if b.node is not None:
+                node = self.nodes.get(b.node)
+                if node is not None:
+                    # Return only what the bundle still holds unused.
+                    _release(node.available, b.available)
+        pg.state = "REMOVED"
+        return True
+
+    def _cmd_cancel(self, payload):
+        task_id, force = payload
+        from ray_tpu.exceptions import TaskCancelledError
+
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            return False
+        if rec.state == "PENDING":
+            if rec in self.pending:
+                self.pending.remove(rec)
+            self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
+            rec.state = "CANCELLED"
+            return True
+        if rec.state == "RUNNING" and force and rec.spec.actor_id is None:
+            node = self.nodes.get(rec.node)
+            wh = node.workers.get(rec.worker) if node else None
+            if wh is not None:
+                rec.retries_left = 0
+                try:
+                    wh.process.terminate()
+                except Exception:
+                    pass
+                self._release_task_resources(rec)
+                self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
+                rec.state = "CANCELLED"
+                # Death handler will see FAILED results already sealed.
+                self.tasks.pop(task_id, None)
+                self._on_worker_death(wh)
+                self.tasks[task_id] = rec
+            return True
+        return False
+
+    def _cmd_task_events(self, _):
+        return list(self.gcs.task_events)
+
+    def _cmd_list_actors(self, _):
+        return [
+            {
+                "actor_id": a.actor_id.hex(),
+                "name": a.name,
+                "class_name": a.class_name,
+                "state": a.state,
+                "num_restarts": a.num_restarts,
+            }
+            for a in self.gcs.actors.values()
+        ]
+
+    # ------------------------------------------------------------------ worker requests
+    def _req_submit(self, wh: WorkerHandle, req_id: int, payload):
+        rec: TaskRecord = payload
+        if rec.func_blob is not None:
+            self.gcs.function_table.setdefault(rec.spec.func.function_id, rec.func_blob)
+        self._register_task(rec)
+        self._respond(wh, req_id, True, True)
+
+    def _req_submit_actor_task(self, wh: WorkerHandle, req_id: int, payload):
+        req: ExecRequest = payload
+        self._submit_actor_task(req)
+        self._respond(wh, req_id, True, True)
+
+    def _req_put_meta(self, wh: WorkerHandle, req_id: int, meta: ObjectMeta):
+        self._seal_object(meta)
+        self._respond(wh, req_id, True, True)
+
+    def _req_get_metas(self, wh: WorkerHandle, req_id: int, ids: List[bytes]):
+        self._mark_blocked(wh)
+
+        def done(metas):
+            self._unmark_blocked(wh)
+            self._respond(wh, req_id, True, metas)
+
+        fut = concurrent.futures.Future()
+        fut.add_done_callback(lambda f: done(f.result()))
+        self._async_get_metas(ids, fut)
+
+    def _req_peek_metas(self, wh: WorkerHandle, req_id: int, ids: List[bytes]):
+        self._respond(wh, req_id, True, self._cmd_peek_metas(ids))
+
+    def _req_wait(self, wh: WorkerHandle, req_id: int, payload):
+        ids, num_returns = payload
+        self._mark_blocked(wh)
+
+        def done(result):
+            self._unmark_blocked(wh)
+            self._respond(wh, req_id, True, result)
+
+        fut = concurrent.futures.Future()
+        fut.add_done_callback(lambda f: done(f.result()))
+        self._async_wait(ids, num_returns, fut)
+
+    def _req_fetch_function(self, wh: WorkerHandle, req_id: int, function_id: str):
+        blob = self.gcs.function_table.get(function_id)
+        if blob is None:
+            self._respond(wh, req_id, False, KeyError(f"unknown function {function_id}"))
+        else:
+            wh.known_functions.add(function_id)
+            self._respond(wh, req_id, True, blob)
+
+    def _req_create_actor(self, wh: WorkerHandle, req_id: int, payload):
+        self._cmd_create_actor(payload)
+        self._respond(wh, req_id, True, True)
+
+    def _req_get_actor_by_name(self, wh: WorkerHandle, req_id: int, name: str):
+        self._respond(wh, req_id, True, self._cmd_get_actor_by_name(name))
+
+    def _req_kv(self, wh: WorkerHandle, req_id: int, payload):
+        self._respond(wh, req_id, True, self._cmd_kv(payload))
+
+    def _req_kill_actor(self, wh: WorkerHandle, req_id: int, payload):
+        self._respond(wh, req_id, True, self._cmd_kill_actor(payload))
+
+    def _req_create_pg(self, wh: WorkerHandle, req_id: int, payload):
+        self._respond(wh, req_id, True, self._cmd_create_pg(payload))
+
+    def _req_pg_ready(self, wh: WorkerHandle, req_id: int, pg_id):
+        self._mark_blocked(wh)
+
+        def done(result):
+            self._unmark_blocked(wh)
+            self._respond(wh, req_id, True, result)
+
+        fut = concurrent.futures.Future()
+        fut.add_done_callback(lambda f: done(f.result()))
+        self._cmd_pg_ready((pg_id, fut))
+
+    def _req_available_resources(self, wh: WorkerHandle, req_id: int, _):
+        self._respond(wh, req_id, True, self._cmd_available_resources(None))
+
+    def _req_cluster_resources(self, wh: WorkerHandle, req_id: int, _):
+        self._respond(wh, req_id, True, self._cmd_cluster_resources(None))
+
+    def _mark_blocked(self, wh: WorkerHandle):
+        """Release the CPU held by the task running on `wh` while it blocks in
+        get/wait, so dependent tasks can run (prevents pool deadlock; mirrors the
+        reference's resource release on blocking `ray.get`)."""
+        if wh.state == "busy" and wh.current_task is not None:
+            rec = self.tasks.get(wh.current_task)
+            node = self.nodes.get(wh.node_id)
+            if rec is not None and node is not None and rec.acquired.get("CPU"):
+                _release(node.available, {"CPU": rec.acquired["CPU"]})
+                rec.acquired["CPU"] = 0.0
+        wh.state = "blocked" if wh.state == "busy" else wh.state
+
+    def _unmark_blocked(self, wh: WorkerHandle):
+        if wh.state == "blocked":
+            wh.state = "busy"
+
+    # ------------------------------------------------------------------ async get/wait
+    def _async_get_metas(self, ids: List[bytes], fut: concurrent.futures.Future):
+        missing = [i for i in ids if i not in self.object_table]
+        if not missing:
+            fut.set_result([self.object_table[i] for i in ids])
+            return
+        remaining = {"n": len(set(missing))}
+
+        def on_ready(_meta):
+            remaining["n"] -= 1
+            if remaining["n"] == 0 and not fut.done():
+                fut.set_result([self.object_table[i] for i in ids])
+
+        for i in set(missing):
+            self.object_waiters.setdefault(i, []).append(on_ready)
+
+    def _async_wait(self, ids: List[bytes], num_returns: int, fut: concurrent.futures.Future):
+        def ready_now():
+            return [i for i in ids if i in self.object_table]
+
+        if len(ready_now()) >= num_returns:
+            fut.set_result(ready_now())
+            return
+
+        def on_ready(_meta):
+            if not fut.done() and len(ready_now()) >= num_returns:
+                fut.set_result(ready_now())
+
+        for i in ids:
+            if i not in self.object_table:
+                self.object_waiters.setdefault(i, []).append(on_ready)
+
+    # ------------------------------------------------------------------ task registration & scheduling
+    def _register_task(self, rec: TaskRecord):
+        self.tasks[rec.spec.task_id] = rec
+        if rec.func_blob is not None:
+            self.gcs.function_table.setdefault(rec.spec.func.function_id, rec.func_blob)
+        self._record_event(rec.spec, "SUBMITTED")
+        if rec.spec.actor_id is not None and not rec.spec.is_actor_creation:
+            # Actor call path (should come through _submit_actor_task).
+            raise ValueError("actor tasks must use submit_actor_task")
+        self.pending.append(rec)
+
+    def _submit_actor_task(self, req: ExecRequest):
+        from ray_tpu.exceptions import RayActorError
+
+        spec = req.spec
+        rec = TaskRecord(
+            spec=spec,
+            arg_entries=[],
+            kwarg_entries={},
+            return_ids=req.return_ids,
+            func_blob=None,
+        )
+        self.tasks[spec.task_id] = rec
+        self._record_event(spec, "SUBMITTED")
+        ar = self.actors.get(spec.actor_id)
+        if ar is None or ar.state == "DEAD":
+            cause = ar.death_cause if ar else "actor not found"
+            self._store_error_results(rec, RayActorError(f"Actor is dead: {cause}"))
+            return False
+        # Resolve dependencies before dispatch (actor args may be refs).
+        self._resolve_then(req, lambda: self._route_actor_call(ar, req))
+        return True
+
+    def _route_actor_call(self, ar: ActorRecord, req: ExecRequest):
+        if ar.state == "ALIVE" and ar.worker is not None:
+            self._dispatch_actor_call(ar, req)
+        elif ar.state == "DEAD":
+            from ray_tpu.exceptions import RayActorError
+
+            rec = self.tasks.get(req.spec.task_id)
+            if rec is not None:
+                self._store_error_results(rec, RayActorError("Actor is dead."))
+        else:
+            ar.backlog.append(req)
+
+    def _dispatch_actor_call(self, ar: ActorRecord, req: ExecRequest):
+        node = self.nodes.get(ar.node)
+        wh = node.workers.get(ar.worker) if node else None
+        if wh is None:
+            ar.backlog.append(req)
+            return
+        rec = self.tasks.get(req.spec.task_id)
+        if rec is not None:
+            rec.state = "RUNNING"
+            rec.worker = wh.worker_id
+            rec.node = wh.node_id
+        ar.inflight.append(req.spec.task_id)
+        self._record_event(req.spec, "RUNNING")
+        if not wh.send(("exec", req)):
+            self._on_worker_death(wh)
+
+    def _resolve_then(self, req: ExecRequest, then: Callable[[], None]):
+        """Resolve ("id", ...) placeholders in an ExecRequest's args to metas, then
+        invoke `then`. Error deps propagate immediately."""
+        dep_ids = [v for (kind, v) in getattr(req, "_arg_entries", []) if kind == "id"]
+        # ExecRequests built by the worker facade carry entries in arg_metas slots
+        # as tuples; normalize here.
+        entries = getattr(req, "_arg_entries", None)
+        kwentries = getattr(req, "_kwarg_entries", None)
+        if entries is None:
+            then()
+            return
+        needed = {v for (k, v) in entries if k == "id"} | {
+            v for (k, v) in kwentries.values() if k == "id"
+        }
+        missing = [i for i in needed if i not in self.object_table]
+
+        def finish():
+            arg_metas = []
+            for kind, v in entries:
+                arg_metas.append(self.object_table[v] if kind == "id" else v)
+            kw = {}
+            for key, (kind, v) in kwentries.items():
+                kw[key] = self.object_table[v] if kind == "id" else v
+            # Propagate dependency errors without running.
+            err_meta = next((m for m in list(arg_metas) + list(kw.values()) if m.is_error), None)
+            rec = self.tasks.get(req.spec.task_id)
+            if err_meta is not None and rec is not None:
+                for oid in rec.return_ids:
+                    m = ObjectMeta(
+                        object_id=oid,
+                        size=err_meta.size,
+                        inband=err_meta.inband,
+                        inline_buffers=err_meta.inline_buffers,
+                        segment=err_meta.segment,
+                        buffer_layout=err_meta.buffer_layout,
+                        is_error=True,
+                    )
+                    self._seal_object(m)
+                rec.state = "FAILED"
+                return
+            req.arg_metas = arg_metas
+            req.kwarg_metas = kw
+            req._arg_entries = None
+            req._kwarg_entries = None
+            then()
+
+        if not missing:
+            finish()
+            return
+        remaining = {"n": len(set(missing))}
+
+        def on_ready(_):
+            remaining["n"] -= 1
+            if remaining["n"] == 0:
+                finish()
+
+        for i in set(missing):
+            self.object_waiters.setdefault(i, []).append(on_ready)
+
+    # --- placement groups ---
+    def _try_schedule_pgs(self):
+        for pg in list(self.pending_pgs):
+            if self._try_reserve_pg(pg):
+                self.pending_pgs.remove(pg)
+                pg.state = "CREATED"
+                for fut in pg.ready_futures:
+                    if not fut.done():
+                        fut.set_result(True)
+                pg.ready_futures.clear()
+
+    def _try_reserve_pg(self, pg: PGRecord) -> bool:
+        """Bundle placement policies, the analogue of the reference's
+        `bundle_scheduling_policy.cc` PACK/SPREAD/STRICT_PACK/STRICT_SPREAD."""
+        nodes = [self.nodes[nid] for nid in self.node_order if self.nodes[nid].alive]
+        unplaced = [b for b in pg.bundles if b.node is None]
+        if not unplaced:
+            return True
+        plan: List[Tuple[Bundle, NodeState]] = []
+        scratch = {n.node_id: dict(n.available) for n in nodes}
+
+        def place(b: Bundle, n: NodeState) -> bool:
+            if _fits(scratch[n.node_id], b.resources):
+                _acquire(scratch[n.node_id], b.resources)
+                plan.append((b, n))
+                return True
+            return False
+
+        strategy = pg.strategy
+        if strategy in ("STRICT_PACK", "PACK"):
+            ok = False
+            for n in nodes:
+                # try to fit ALL unplaced bundles on this node
+                t = dict(n.available)
+                fits_all = True
+                for b in unplaced:
+                    if _fits(t, b.resources):
+                        _acquire(t, b.resources)
+                    else:
+                        fits_all = False
+                        break
+                if fits_all:
+                    for b in unplaced:
+                        place(b, n)
+                    ok = True
+                    break
+            if not ok:
+                if strategy == "STRICT_PACK":
+                    return False
+                # PACK falls back to best-effort spread.
+                plan.clear()
+                scratch = {n.node_id: dict(n.available) for n in nodes}
+                for b in unplaced:
+                    if not any(place(b, n) for n in nodes):
+                        return False
+        elif strategy == "STRICT_SPREAD":
+            used = {b.node for b in pg.bundles if b.node is not None}
+            for b in unplaced:
+                cand = [n for n in nodes if n.node_id not in used and n.node_id not in {p[1].node_id for p in plan}]
+                if not any(place(b, n) for n in cand):
+                    return False
+        else:  # SPREAD (best-effort round robin)
+            for i, b in enumerate(unplaced):
+                order = nodes[i % len(nodes):] + nodes[: i % len(nodes)] if nodes else []
+                if not any(place(b, n) for n in order):
+                    return False
+        for b, n in plan:
+            _acquire(n.available, b.resources)
+            b.node = n.node_id
+            b.available = dict(b.resources)
+        return True
+
+    # --- main scheduling pass ---
+    def _schedule(self):
+        self._try_schedule_pgs()
+        if not self.pending:
+            return
+        # Swap the queue out first: death handlers invoked from _try_dispatch may
+        # legitimately append (retries, actor restarts) — those must land in the
+        # live queue, not be lost when we reassign it.
+        snapshot = self.pending
+        self.pending = []
+        for rec in snapshot:
+            if rec.state != "PENDING":
+                continue  # cancelled or already failed while queued
+            if not self._try_dispatch(rec):
+                self.pending.append(rec)
+
+    def _pick_node(self, rec: TaskRecord) -> Optional[NodeState]:
+        """Hybrid policy: prefer the first (head) node until its utilization crosses
+        the spread threshold, then least-utilized feasible node (reference:
+        `hybrid_scheduling_policy.cc`). Node/PG affinity strategies override."""
+        strategy = rec.spec.scheduling_strategy
+        if rec.spec.placement_group_id is not None:
+            pg = self.pgs.get(rec.spec.placement_group_id)
+            if pg is None or pg.state not in ("CREATED",):
+                return None
+            idx = rec.spec.placement_group_bundle_index
+            if idx >= len(pg.bundles):
+                self._store_error_results(
+                    rec,
+                    ValueError(
+                        f"placement_group_bundle_index {idx} out of range for a "
+                        f"{len(pg.bundles)}-bundle placement group"
+                    ),
+                )
+                return None
+            candidates = pg.bundles if idx < 0 else [pg.bundles[idx]]
+            for b in candidates:
+                if b.node is not None and _fits(b.available, rec.spec.resources):
+                    node = self.nodes.get(b.node)
+                    if node is not None and node.alive:
+                        rec.acquired_pg = (pg.pg_id, b.index)
+                        return node
+            return None
+        if strategy is not None and getattr(strategy, "node_id", None) is not None:
+            node = self.nodes.get(NodeID.from_hex(strategy.node_id))
+            if node is not None and node.alive and _fits(node.available, rec.spec.resources):
+                return node
+            if strategy.soft:
+                pass  # fall through to default policy
+            else:
+                return None
+        if strategy == "SPREAD":
+            alive = [self.nodes[nid] for nid in self.node_order if self.nodes[nid].alive]
+            feasible = [n for n in alive if _fits(n.available, rec.spec.resources)]
+            if not feasible:
+                return None
+            self._rr_counter += 1
+            return feasible[self._rr_counter % len(feasible)]
+        threshold = self.config.scheduler_spread_threshold
+        best: Optional[NodeState] = None
+        for nid in self.node_order:
+            node = self.nodes[nid]
+            if not node.alive or not _fits(node.available, rec.spec.resources):
+                continue
+            if node.utilization() < threshold:
+                return node  # pack onto first under-threshold feasible node
+            if best is None or node.utilization() < best.utilization():
+                best = node
+        return best
+
+    def _try_dispatch(self, rec: TaskRecord) -> bool:
+        # 1) dependencies
+        needed = {v for (k, v) in rec.arg_entries if k == "id"} | {
+            v for (k, v) in rec.kwarg_entries.values() if k == "id"
+        }
+        missing = [i for i in needed if i not in self.object_table]
+        if missing:
+            if rec.unresolved == 0:
+                rec.unresolved = 1
+                remaining = {"n": len(set(missing))}
+
+                def on_ready(_):
+                    remaining["n"] -= 1
+                    if remaining["n"] == 0:
+                        rec.unresolved = 0
+                        # task is still in self.pending; next pass dispatches
+
+                for i in set(missing):
+                    self.object_waiters.setdefault(i, []).append(on_ready)
+            return False
+        # Propagate dependency errors.
+        metas = [self.object_table[v] if k == "id" else v for k, v in rec.arg_entries]
+        kw = {key: (self.object_table[v] if k == "id" else v) for key, (k, v) in rec.kwarg_entries.items()}
+        err = next((m for m in list(metas) + list(kw.values()) if m.is_error), None)
+        if err is not None:
+            for oid in rec.return_ids:
+                m = ObjectMeta(
+                    object_id=oid, size=err.size, inband=err.inband,
+                    inline_buffers=err.inline_buffers, segment=err.segment,
+                    buffer_layout=err.buffer_layout, is_error=True,
+                )
+                self._seal_object(m)
+            rec.state = "FAILED"
+            return True
+        # 2) actor creation: dedicated worker + resources
+        if rec.spec.is_actor_creation:
+            return self._try_dispatch_actor_creation(rec, metas, kw)
+        # 3) node + resources
+        node = self._pick_node(rec)
+        if node is None:
+            return False
+        # 4) worker
+        wh = None
+        while node.idle:
+            wid = node.idle.pop(0)
+            cand = node.workers.get(wid)
+            if cand is not None and cand.process.is_alive():
+                wh = cand
+                break
+        if wh is None:
+            max_workers = int(node.resources.get("CPU", 1)) + self.config.maximum_startup_concurrency
+            if len(node.workers) >= max_workers + len(self.actors):
+                return False
+            wh = self._spawn_worker(node)
+            node.idle.remove(wh.worker_id)
+        # 5) acquire + dispatch
+        if rec.acquired_pg is not None:
+            pg = self.pgs[rec.acquired_pg[0]]
+            bundle = pg.bundles[rec.acquired_pg[1]]
+            _acquire(bundle.available, rec.spec.resources)
+        else:
+            _acquire(node.available, rec.spec.resources)
+        rec.acquired = dict(rec.spec.resources)
+        rec.state = "RUNNING"
+        rec.worker = wh.worker_id
+        rec.node = node.node_id
+        wh.state = "busy"
+        wh.current_task = rec.spec.task_id
+        self._record_event(rec.spec, "RUNNING")
+        req = ExecRequest(
+            spec=rec.spec,
+            arg_metas=metas,
+            kwarg_metas=kw,
+            func_blob=None,
+            return_ids=rec.return_ids,
+        )
+        if rec.spec.func.function_id not in wh.known_functions:
+            req.func_blob = self.gcs.function_table.get(rec.spec.func.function_id, rec.func_blob)
+            wh.known_functions.add(rec.spec.func.function_id)
+        if not wh.send(("exec", req)):
+            # Death handling retries or seals an error for this record itself;
+            # return True so the caller does not also re-queue it.
+            self._on_worker_death(wh)
+        return True
+
+    def _try_dispatch_actor_creation(self, rec: TaskRecord, metas, kw) -> bool:
+        ar = self.actors.get(rec.spec.actor_id)
+        if ar is None or ar.state == "DEAD":
+            return True  # dropped (e.g. killed while pending)
+        node = self._pick_node(rec)
+        if node is None:
+            return False
+        if rec.acquired_pg is not None:
+            pg = self.pgs[rec.acquired_pg[0]]
+            bundle = pg.bundles[rec.acquired_pg[1]]
+            _acquire(bundle.available, rec.spec.resources)
+            ar.acquired_pg = rec.acquired_pg
+        else:
+            _acquire(node.available, rec.spec.resources)
+        ar.acquired = dict(rec.spec.resources)
+        env_vars = dict(rec.spec.env_vars)
+        # TPU visibility: give the actor its chip share (analogue of
+        # CUDA_VISIBLE_DEVICES assignment in the reference's resource allocator).
+        num_tpus = rec.spec.resources.get("TPU", 0)
+        if num_tpus:
+            env_vars.setdefault("TPU_CHIPS", str(int(num_tpus)))
+        wh = self._spawn_worker(node, actor_id=ar.actor_id, env_vars=env_vars)
+        ar.worker = wh.worker_id
+        ar.node = node.node_id
+        rec.state = "RUNNING"
+        rec.worker = wh.worker_id
+        rec.node = node.node_id
+        ar.inflight.append(rec.spec.task_id)
+        self._record_event(rec.spec, "RUNNING")
+        req = ExecRequest(
+            spec=rec.spec,
+            arg_metas=metas,
+            kwarg_metas=kw,
+            func_blob=self.gcs.function_table.get(rec.spec.func.function_id, rec.func_blob),
+            return_ids=rec.return_ids,
+        )
+        wh.known_functions.add(rec.spec.func.function_id)
+        if not wh.send(("exec", req)):
+            # Actor death handling restarts or fails the actor itself; don't
+            # also re-queue this creation record.
+            self._on_worker_death(wh)
+        return True
+
+    def _try_start_actor(self, ar: ActorRecord):
+        """(Re)run the creation task for a PENDING/RESTARTING actor."""
+        req = ar.creation_req
+        rec = TaskRecord(
+            spec=req.spec,
+            arg_entries=getattr(req, "_saved_arg_entries", [("meta", m) for m in req.arg_metas]),
+            kwarg_entries=getattr(req, "_saved_kwarg_entries", {k: ("meta", m) for k, m in req.kwarg_metas.items()}),
+            return_ids=req.return_ids,
+            func_blob=req.func_blob,
+        )
+        self.tasks[req.spec.task_id] = rec
+        self.pending.append(rec)
+
+    # ------------------------------------------------------------------ resources
+    def _release_task_resources(self, rec: TaskRecord):
+        if rec.acquired_pg is not None:
+            pg = self.pgs.get(rec.acquired_pg[0])
+            if pg is not None and pg.state == "CREATED":
+                _release(pg.bundles[rec.acquired_pg[1]].available, rec.acquired)
+            else:
+                # PG was removed while this task ran: its bundle reservation is
+                # gone, so the in-use share goes straight back to the node.
+                node = self.nodes.get(rec.node)
+                if node is not None:
+                    _release(node.available, rec.acquired)
+            rec.acquired_pg = None
+        elif rec.node is not None:
+            node = self.nodes.get(rec.node)
+            if node is not None:
+                _release(node.available, rec.acquired)
+        rec.acquired = {}
+
+    def _release_actor_resources(self, ar: ActorRecord):
+        if ar.acquired_pg is not None:
+            pg = self.pgs.get(ar.acquired_pg[0])
+            if pg is not None and pg.state == "CREATED":
+                _release(pg.bundles[ar.acquired_pg[1]].available, ar.acquired)
+            else:
+                node = self.nodes.get(ar.node)
+                if node is not None:
+                    _release(node.available, ar.acquired)
+            ar.acquired_pg = None
+        elif ar.node is not None:
+            node = self.nodes.get(ar.node)
+            if node is not None:
+                _release(node.available, ar.acquired)
+        ar.acquired = {}
+
+    # ------------------------------------------------------------------ misc
+    def _record_event(self, spec: TaskSpec, state: str):
+        if not self.config.enable_timeline:
+            return
+        self.gcs.record_task_event(
+            TaskEvent(
+                task_id=spec.task_id.hex(),
+                name=spec.name or spec.func.name,
+                state=state,
+                timestamp=time.time(),
+            )
+        )
+
+
+_ASYNC = object()

@@ -1,0 +1,86 @@
+"""Serialization of task args/returns and `put` objects.
+
+Mirrors the reference's pickle5 + out-of-band-buffer design
+(`/root/reference/python/ray/_private/serialization.py`): values are cloudpickled with
+protocol 5 and a buffer callback, so large contiguous payloads (numpy arrays, bytes)
+are captured as zero-copy `PickleBuffer`s that the object store places in shared
+memory; readers reconstruct arrays directly over the mmap with no copy.
+
+jax.Array device buffers are intentionally NOT routed through shared memory (SURVEY.md
+§7 "Device buffers vs plasma"): they are converted to host numpy at the boundary only
+when they actually cross a process, via the reducer below.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Callable, List
+
+import cloudpickle
+
+
+@dataclass
+class SerializedValue:
+    """In-band pickle bytes plus out-of-band buffers."""
+
+    inband: bytes
+    buffers: List[memoryview] = field(default_factory=list)
+
+    @property
+    def total_size(self) -> int:
+        return len(self.inband) + sum(b.nbytes for b in self.buffers)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    """Cloudpickler that lowers jax.Array leaves to host numpy.
+
+    A jax.Array's device buffer must stay resident on the device that owns it; only
+    the host copy crosses process boundaries. Tasks that want device arrays re-`put`
+    them onto their local devices.
+    """
+
+    def reducer_override(self, obj):
+        # Lazy import so the core runtime never drags in jax.
+        mod = type(obj).__module__ or ""
+        if mod.startswith("jaxlib") or mod.startswith("jax"):
+            try:
+                import jax
+                import numpy as np
+
+                if isinstance(obj, jax.Array):
+                    import numpy
+
+                    return (numpy.asarray, (numpy.asarray(obj),))
+            except ImportError:
+                pass
+        return NotImplemented
+
+
+def serialize(value: Any) -> SerializedValue:
+    buffers: List[pickle.PickleBuffer] = []
+    import io
+
+    f = io.BytesIO()
+    p = _Pickler(f, protocol=5, buffer_callback=buffers.append)
+    p.dump(value)
+    views = []
+    for b in buffers:
+        view = b.raw()
+        if not view.contiguous:
+            view = memoryview(bytes(view))
+        views.append(view)
+    return SerializedValue(inband=f.getvalue(), buffers=views)
+
+
+def deserialize(inband: bytes, buffers: List[memoryview]) -> Any:
+    return pickle.loads(inband, buffers=buffers)
+
+
+def dumps(obj: Any) -> bytes:
+    """Single-blob serialization for control-plane messages (no out-of-band)."""
+    return cloudpickle.dumps(obj)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

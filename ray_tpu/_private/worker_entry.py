@@ -1,0 +1,37 @@
+"""Worker process entrypoint (the analogue of the reference's
+`python/ray/_private/workers/default_worker.py`): started by the scheduler as
+`python -m ray_tpu._private.worker_entry`, connects back to the driver's unix
+socket, then runs the task loop. Using an explicit entrypoint instead of
+`multiprocessing` spawn avoids re-executing the user's __main__ module in every
+worker."""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import os
+import pickle
+import sys
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True, help="driver unix socket path")
+    parser.add_argument("--args", required=True, help="base64(pickle(WorkerArgs))")
+    ns = parser.parse_args()
+
+    args = pickle.loads(base64.b64decode(ns.args))
+
+    from multiprocessing.connection import Client
+
+    authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", ""))
+    conn = Client(ns.address, family="AF_UNIX", authkey=authkey)
+    conn.send_bytes(args.worker_id_hex.encode())
+
+    from ray_tpu._private.worker_main import worker_loop
+
+    worker_loop(conn, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,235 @@
+"""Worker process: executes tasks and hosts actors.
+
+The analogue of the reference's `default_worker.py` + the C++ core-worker task
+execution loop (`/root/reference/python/ray/_private/workers/default_worker.py`,
+`core_worker.cc:2525 ExecuteTask`, `_raylet.pyx:1168 task_execution_handler`).
+
+Thread model: a reader thread drains the duplex pipe from the driver, routing
+"exec" messages to the task queue and "resp" messages to the blocked requester;
+the main thread executes tasks sequentially (actor ordering falls out of this,
+like the reference's `ActorSchedulingQueue`).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config, set_config
+from ray_tpu._private.ids import ActorID, TaskID, WorkerID
+from ray_tpu._private.object_store import LocalObjectStore, ObjectMeta
+from ray_tpu._private.protocol import ExecRequest
+
+
+@dataclass
+class WorkerArgs:
+    worker_id_hex: str
+    node_id_hex: str
+    shm_dir: str
+    session_name: str
+    config: Config
+    env_vars: Dict[str, str]
+    is_actor_worker: bool = False
+
+
+class WorkerConnection:
+    """Request/response multiplexing over the driver pipe."""
+
+    def __init__(self, conn):
+        self.conn = conn
+        self._send_lock = threading.Lock()
+        self._req_lock = threading.Lock()
+        self._next_req_id = 0
+        self._pending: Dict[int, "queue.SimpleQueue"] = {}
+        self.task_queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._closed = threading.Event()
+
+    def send(self, msg) -> None:
+        with self._send_lock:
+            self.conn.send_bytes(serialization.dumps(msg))
+
+    def request(self, method: str, payload: Any, timeout: float | None = None) -> Any:
+        """Blocking control-plane RPC to the driver (e.g. get/wait/submit)."""
+        with self._req_lock:
+            req_id = self._next_req_id
+            self._next_req_id += 1
+            q: "queue.SimpleQueue" = queue.SimpleQueue()
+            self._pending[req_id] = q
+        self.send(("req", req_id, method, payload))
+        try:
+            ok, result = q.get(timeout=timeout)
+        except queue.Empty:
+            with self._req_lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError(f"request {method} timed out after {timeout}s") from None
+        if not ok:
+            raise result
+        return result
+
+    def reader_loop(self):
+        try:
+            while True:
+                data = self.conn.recv_bytes()
+                msg = serialization.loads(data)
+                kind = msg[0]
+                if kind == "exec":
+                    self.task_queue.put(msg[1])
+                elif kind == "resp":
+                    _, req_id, ok, payload = msg
+                    with self._req_lock:
+                        q = self._pending.pop(req_id, None)
+                    if q is not None:
+                        q.put((ok, payload))
+                elif kind == "shutdown":
+                    self.task_queue.put(None)
+                    return
+        except (EOFError, OSError):
+            pass
+        finally:
+            self._closed.set()
+            self.task_queue.put(None)
+            # Unblock anyone waiting on a response: the driver is gone.
+            with self._req_lock:
+                for q in self._pending.values():
+                    q.put((False, ConnectionError("driver connection closed")))
+                self._pending.clear()
+
+
+class WorkerRuntime:
+    """Per-process runtime state: object store facade, function cache, actor."""
+
+    def __init__(self, args: WorkerArgs, wc: WorkerConnection):
+        self.args = args
+        self.wc = wc
+        self.store = LocalObjectStore(args.shm_dir)
+        self.functions: Dict[str, Any] = {}
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self.current_task_id: Optional[TaskID] = None
+        self.current_task_name: str = ""
+        self._put_counter = 0
+
+    def next_put_index(self) -> int:
+        self._put_counter += 1
+        return self._put_counter
+
+    def load_function(self, function_id: str, blob: Optional[bytes]):
+        fn = self.functions.get(function_id)
+        if fn is not None:
+            return fn
+        if blob is None:
+            blob = self.wc.request("fetch_function", function_id)
+        fn = serialization.loads(blob)
+        self.functions[function_id] = fn
+        return fn
+
+
+def _execute(rt: WorkerRuntime, req: ExecRequest):
+    from ray_tpu import exceptions
+    from ray_tpu._private import worker as worker_mod
+
+    spec = req.spec
+    rt.current_task_id = spec.task_id
+    rt.current_task_name = spec.name or spec.func.name
+    cfg = rt.args.config
+    for k, v in spec.env_vars.items():
+        os.environ[k] = v
+    try:
+        args = [rt.store.get(m) for m in req.arg_metas]
+        kwargs = {k: rt.store.get(m) for k, m in req.kwarg_metas.items()}
+        # Resolve any ObjectRefs that arrived as *resolved values already* — the
+        # driver substitutes top-level refs with their value metas, so nothing to
+        # do here; nested refs were rebuilt by the unpickler as live ObjectRefs.
+        if spec.is_actor_creation:
+            cls = rt.load_function(spec.func.function_id, req.func_blob)
+            rt.actor_instance = cls(*args, **kwargs)
+            rt.actor_id = spec.actor_id
+            worker_mod._set_current_actor_id(spec.actor_id)
+            results = [None] * spec.num_returns if spec.num_returns else []
+            out = None
+        elif spec.actor_id is not None:
+            if spec.method_name == "__ray_ready__":
+                out = True
+            elif spec.method_name == "__ray_terminate__":
+                rt.wc.task_queue.put(None)
+                out = None
+            else:
+                method = getattr(rt.actor_instance, spec.method_name)
+                out = method(*args, **kwargs)
+        else:
+            fn = rt.load_function(spec.func.function_id, req.func_blob)
+            out = fn(*args, **kwargs)
+        # Split returns.
+        n = spec.num_returns
+        if spec.is_actor_creation:
+            values = []
+        elif n == 1:
+            values = [out]
+        elif n == 0:
+            values = []
+        else:
+            values = list(out)
+            if len(values) != n:
+                raise ValueError(
+                    f"Task {spec.name} declared num_returns={n} but returned "
+                    f"{len(values)} values"
+                )
+        metas = []
+        for oid, value in zip(req.return_ids, values):
+            sv = serialization.serialize(value)
+            meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
+            metas.append(meta)
+        rt.wc.send(("done", spec.task_id.binary(), True, metas))
+    except Exception as e:  # noqa: BLE001 — every task error must be captured
+        tb = traceback.format_exc()
+        err = exceptions.RayTaskError(
+            function_name=spec.name or spec.func.name,
+            traceback_str=tb,
+            cause=e,
+            pid=os.getpid(),
+        )
+        metas = []
+        try:
+            sv = serialization.serialize(err)
+        except Exception:
+            sv = serialization.serialize(
+                exceptions.RayTaskError(spec.func.name, tb, None, os.getpid())
+            )
+        for oid in req.return_ids:
+            meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
+            meta.is_error = True
+            metas.append(meta)
+        rt.wc.send(("done", spec.task_id.binary(), False, metas))
+    finally:
+        rt.current_task_id = None
+
+
+def worker_loop(conn, args: WorkerArgs):
+    """Entry point run in the spawned worker process."""
+    set_config(args.config)
+    for k, v in args.env_vars.items():
+        os.environ.setdefault(k, v)
+    wc = WorkerConnection(conn)
+    rt = WorkerRuntime(args, wc)
+
+    # Bind the module-level API (ray_tpu.get/put/remote/...) to this worker.
+    from ray_tpu._private import worker as worker_mod
+
+    worker_mod._connect_worker_process(rt)
+
+    reader = threading.Thread(target=wc.reader_loop, daemon=True, name="reader")
+    reader.start()
+    wc.send(("register", args.worker_id_hex, os.getpid()))
+    while True:
+        req = wc.task_queue.get()
+        if req is None:
+            break
+        _execute(rt, req)
+    rt.store.detach_all()
+    sys.exit(0)

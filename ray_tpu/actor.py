@@ -1,0 +1,196 @@
+"""Actors: stateful workers with ordered method dispatch.
+
+Reference: `python/ray/actor.py` (`ActorClass:377`, `ActorClass._remote:659`,
+`ActorHandle._actor_method_call:1111`); creation is registered with the GCS actor
+manager which leases a dedicated worker (`gcs_actor_manager.h:281`), and method
+calls go directly to that worker, ordered by the submission sequence
+(`transport/actor_scheduling_queue.h`). Here the dedicated worker is a spawned
+process whose main loop executes its queue in order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import serialization, worker as worker_mod
+from ray_tpu._private.gcs import ActorInfo
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.protocol import ExecRequest, FunctionDescriptor, TaskSpec
+from ray_tpu._private.scheduler import ActorRecord
+from ray_tpu._private.worker import ObjectRef, global_worker
+from ray_tpu.remote_function import _apply_strategy, _resources_from_options
+
+_VALID_ACTOR_OPTIONS = {
+    "num_cpus",
+    "num_tpus",
+    "num_gpus",
+    "resources",
+    "max_restarts",
+    "max_task_retries",
+    "max_concurrency",
+    "name",
+    "namespace",
+    "lifetime",
+    "scheduling_strategy",
+    "runtime_env",
+    "memory",
+    "get_if_exists",
+}
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, opts.get("num_returns", 1))
+
+    def remote(self, *args, **kwargs):
+        return self._handle._actor_method_call(
+            self._name, args, kwargs, self._num_returns
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._name}' cannot be called directly; use "
+            f"'.{self._name}.remote()'."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = "Actor",
+                 method_meta: Optional[Dict[str, int]] = None):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        # method name -> num_returns, collected from @ray_tpu.method decorators.
+        self._method_meta = method_meta or {}
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name, self._method_meta.get(name, 1))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name, self._method_meta))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+    def _actor_method_call(self, method_name: str, args, kwargs, num_returns: int):
+        task_id = global_worker.next_task_id()
+        spec = TaskSpec(
+            task_id=task_id,
+            func=FunctionDescriptor("", method_name),
+            num_returns=num_returns,
+            actor_id=self._actor_id,
+            method_name=method_name,
+            name=f"{self._class_name}.{method_name}",
+        )
+        entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
+        return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]
+        req = ExecRequest(spec=spec, arg_metas=[], kwarg_metas={}, return_ids=return_ids)
+        req._arg_entries = entries
+        req._kwarg_entries = kwentries
+        global_worker.context.submit_actor_task(req)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    @property
+    def __ray_ready__(self):  # parity helper: `get(actor.__ray_ready__.remote())`
+        return ActorMethod(self, "__ray_ready__")
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        for k in self._options:
+            if k not in _VALID_ACTOR_OPTIONS:
+                raise ValueError(f"Invalid actor option: {k}")
+        self._blob: Optional[bytes] = None
+        self._function_id: Optional[str] = None
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()."
+        )
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        ac = ActorClass(self._cls, merged)
+        ac._blob = self._blob
+        ac._function_id = self._function_id
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker_mod._auto_init()
+        opts = self._options
+        name = opts.get("name")
+        if name and opts.get("get_if_exists"):
+            existing = global_worker.context.get_actor_by_name(name)
+            if existing is not None:
+                return ActorHandle(existing, self._cls.__name__)
+        if self._blob is None:
+            self._blob = serialization.dumps(self._cls)
+            self._function_id = worker_mod.function_id_of(self._blob)
+        actor_id = ActorID.of(global_worker.job_id)
+        task_id = global_worker.next_task_id()
+        resources = _resources_from_options(opts, default_cpus=0.0)
+        spec = TaskSpec(
+            task_id=task_id,
+            func=FunctionDescriptor(self._function_id, self._cls.__name__),
+            num_returns=0,
+            resources=resources,
+            actor_id=actor_id,
+            is_actor_creation=True,
+            name=f"{self._cls.__name__}.__init__",
+        )
+        _apply_strategy(spec, opts.get("scheduling_strategy"))
+        entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
+        req = ExecRequest(
+            spec=spec, arg_metas=[], kwarg_metas={}, func_blob=self._blob, return_ids=[]
+        )
+        req._saved_arg_entries = entries
+        req._saved_kwarg_entries = kwentries
+        max_restarts = int(opts.get("max_restarts", 0))
+        if max_restarts < 0:  # -1 = infinite, like the reference
+            max_restarts = 1 << 30
+        ar = ActorRecord(
+            actor_id=actor_id,
+            creation_req=req,
+            resources=resources,
+            max_restarts=max_restarts,
+        )
+        info = ActorInfo(
+            actor_id=actor_id,
+            name=name,
+            class_name=self._cls.__name__,
+            max_restarts=max_restarts,
+        )
+        global_worker.context.create_actor((ar, info, name))
+        method_meta = {
+            n: getattr(m, "__ray_tpu_num_returns__")
+            for n, m in vars(self._cls).items()
+            if callable(m) and hasattr(m, "__ray_tpu_num_returns__")
+        }
+        return ActorHandle(actor_id, self._cls.__name__, method_meta)
+
+
+def method(**opts):
+    """`@ray_tpu.method(num_returns=n)` decorator for actor methods."""
+
+    def decorator(fn):
+        fn.__ray_tpu_num_returns__ = opts.get("num_returns", 1)
+        return fn
+
+    return decorator

@@ -1,0 +1,136 @@
+"""`@ray_tpu.remote` functions (reference: `python/ray/remote_function.py`,
+`RemoteFunction._remote` at `:240` — pickle the function once, register it in the
+GCS function table, then submit TaskSpecs referencing it by hash)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import serialization, worker as worker_mod
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.protocol import FunctionDescriptor, TaskSpec
+from ray_tpu._private.scheduler import TaskRecord
+from ray_tpu._private.worker import ObjectRef, global_worker
+
+_VALID_OPTIONS = {
+    "num_cpus",
+    "num_tpus",
+    "num_gpus",  # accepted for API familiarity; maps to a custom "GPU" resource
+    "resources",
+    "num_returns",
+    "max_retries",
+    "name",
+    "scheduling_strategy",
+    "retry_exceptions",
+    "runtime_env",
+    "memory",
+    "_metadata",
+}
+
+# Function ids this process has already shipped/registered.
+_sent_functions: set = set()
+_sent_lock = threading.Lock()
+
+
+def _resources_from_options(opts: Dict[str, Any], default_cpus: float) -> Dict[str, float]:
+    res: Dict[str, float] = {}
+    num_cpus = opts.get("num_cpus")
+    res["CPU"] = float(num_cpus) if num_cpus is not None else default_cpus
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_gpus"):
+        res["GPU"] = float(opts["num_gpus"])
+    for k, v in (opts.get("resources") or {}).items():
+        res[k] = float(v)
+    if res.get("CPU") == 0:
+        res.pop("CPU")
+    return res
+
+
+def _apply_strategy(spec: TaskSpec, strategy) -> None:
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    if strategy is None or strategy == "DEFAULT":
+        return
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        spec.placement_group_id = strategy.placement_group._id
+        spec.placement_group_bundle_index = strategy.placement_group_bundle_index
+    elif isinstance(strategy, (NodeAffinitySchedulingStrategy,)) or strategy == "SPREAD":
+        spec.scheduling_strategy = strategy
+    else:
+        raise ValueError(f"Unknown scheduling strategy: {strategy!r}")
+
+
+class RemoteFunction:
+    def __init__(self, function, options: Optional[Dict[str, Any]] = None):
+        self._function = function
+        self._options = dict(options or {})
+        for k in self._options:
+            if k not in _VALID_OPTIONS:
+                raise ValueError(f"Invalid @remote option: {k}")
+        self._blob: Optional[bytes] = None
+        self._function_id: Optional[str] = None
+        self.__name__ = getattr(function, "__name__", "remote_function")
+
+    def _ensure_pickled(self):
+        if self._blob is None:
+            self._blob = serialization.dumps(self._function)
+            self._function_id = worker_mod.function_id_of(self._blob)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; use "
+            f"'{self.__name__}.remote()'."
+        )
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(opts)
+        rf = RemoteFunction(self._function, merged)
+        rf._blob = self._blob
+        rf._function_id = self._function_id
+        return rf
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, opts):
+        worker_mod._auto_init()
+        self._ensure_pickled()
+        num_returns = int(opts.get("num_returns", 1))
+        task_id = global_worker.next_task_id()
+        spec = TaskSpec(
+            task_id=task_id,
+            func=FunctionDescriptor(self._function_id, self.__name__),
+            num_returns=num_returns,
+            resources=_resources_from_options(opts, default_cpus=1.0),
+            max_retries=int(opts.get("max_retries", 0)),
+            name=opts.get("name") or self.__name__,
+        )
+        _apply_strategy(spec, opts.get("scheduling_strategy"))
+        entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
+        return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]
+        blob = None
+        with _sent_lock:
+            if self._function_id not in _sent_functions:
+                blob = self._blob
+                _sent_functions.add(self._function_id)
+        rec = TaskRecord(
+            spec=spec,
+            arg_entries=entries,
+            kwarg_entries=kwentries,
+            return_ids=return_ids,
+            func_blob=blob,
+            retries_left=spec.max_retries,
+        )
+        global_worker.context.submit(rec)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        if num_returns == 1:
+            return refs[0]
+        if num_returns == 0:
+            return None
+        return refs

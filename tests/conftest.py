@@ -1,0 +1,41 @@
+"""Shared fixtures, modeled on the reference's `python/ray/tests/conftest.py`
+(`ray_start_regular:313`, `ray_start_cluster:394`).
+
+JAX-dependent tests run on a virtual 8-device CPU mesh: the env vars must be set
+before jax initializes its backends (SURVEY.md §7 / task instructions), so they are
+set at conftest import time, before any test module imports jax.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """A 4-CPU single-node runtime, torn down after the test."""
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=False)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-virtual-node cluster builder (reference: `cluster_utils.Cluster`)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    yield cluster
+    cluster.shutdown()

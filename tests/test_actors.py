@@ -1,0 +1,162 @@
+"""Actor tests, modeled on the reference's `python/ray/tests/test_actor.py` and
+`test_actor_failures.py`."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, k=1):
+        self.n += k
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def fail(self):
+        raise RuntimeError("method failure")
+
+    def die(self):
+        import os
+
+        os._exit(1)
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.inc.remote()) == 6
+    assert ray_tpu.get(c.inc.remote(4)) == 10
+    assert ray_tpu.get(c.value.remote()) == 10
+
+
+def test_actor_method_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_method_error(ray_start_regular):
+    c = Counter.remote()
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(c.fail.remote())
+    # Actor survives a method exception.
+    assert ray_tpu.get(c.inc.remote()) == 1
+
+
+def test_actor_constructor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("ctor fail")
+
+        def m(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(b.m.remote(), timeout=10)
+
+
+def test_actor_death_fails_calls(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    c.die.remote()
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(c.inc.remote(), timeout=15)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Flaky:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    f = Flaky.remote()
+    assert ray_tpu.get(f.inc.remote()) == 1
+    f.die.remote()
+    # After restart, state is rebuilt from __init__ (restart-from-scratch,
+    # like the reference's max_restarts without task retries).
+    for _ in range(50):
+        try:
+            v = ray_tpu.get(f.inc.remote(), timeout=10)
+            break
+        except ray_tpu.exceptions.RayActorError:
+            time.sleep(0.2)
+    assert v == 1
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="named_counter").remote(100)
+    h = ray_tpu.get_actor("named_counter")
+    assert ray_tpu.get(h.inc.remote()) == 101
+
+
+def test_named_actor_missing(ray_start_regular):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does_not_exist")
+
+
+def test_named_actor_duplicate_rejected(ray_start_regular):
+    Counter.options(name="dup").remote()
+    with pytest.raises(Exception):
+        Counter.options(name="dup").remote()
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="shared", get_if_exists=True).remote(7)
+    ray_tpu.get(a.inc.remote())
+    b = Counter.options(name="shared", get_if_exists=True).remote(7)
+    assert ray_tpu.get(b.value.remote()) == 8
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(c.inc.remote(), timeout=15)
+
+
+def test_actor_handle_in_task(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(h, k):
+        return ray_tpu.get(h.inc.remote(k))
+
+    assert ray_tpu.get(bump.remote(c, 5)) == 5
+    assert ray_tpu.get(c.value.remote()) == 5
+
+
+def test_actor_ready_protocol(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.__ray_ready__.remote()) is True
+
+
+def test_actor_task_from_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Parent:
+        def __init__(self):
+            self.child = Counter.remote(0)
+
+        def delegate(self):
+            return ray_tpu.get(self.child.inc.remote())
+
+    p = Parent.remote()
+    assert ray_tpu.get(p.delegate.remote()) == 1

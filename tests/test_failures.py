@@ -1,0 +1,109 @@
+"""Fault-tolerance tests: worker crashes, retries, node removal, cancellation.
+Modeled on the reference's `test_component_failures.py` / `test_chaos.py` pattern."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_worker_crash_no_retries(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        import os
+
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.exceptions.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=30)
+
+
+def test_worker_crash_with_retry_succeeds(ray_start_regular):
+    # Use the KV store to make the task fail only on its first attempt.
+    @ray_tpu.remote(max_retries=2)
+    def flaky():
+        from ray_tpu._private.worker import global_worker
+
+        ctx = global_worker.context
+        if ctx.kv("get", b"flaky_ran") is None:
+            ctx.kv("put", b"flaky_ran", b"1")
+            import os
+
+            os._exit(1)
+        return "recovered"
+
+    assert ray_tpu.get(flaky.remote(), timeout=60) == "recovered"
+
+
+def test_cancel_pending_task(ray_start_regular):
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(60)
+
+    @ray_tpu.remote(num_cpus=4)
+    def big():
+        return 1
+
+    blockers = [blocker.remote() for _ in range(4)]
+    ref = big.remote()  # cannot schedule while blockers hold all CPUs
+    time.sleep(0.3)
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_cancel_running_task_force(ray_start_regular):
+    @ray_tpu.remote
+    def spin():
+        time.sleep(60)
+        return 1
+
+    ref = spin.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_multinode_spread_and_node_failure(ray_start_cluster):
+    cluster = ray_start_cluster
+    n2 = cluster.add_node(num_cpus=2, resources={"special": 1})
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(resources={"special": 1})
+    def on_special():
+        import time as t
+
+        t.sleep(0.2)
+        return "ran"
+
+    # Runs only on the 'special' node.
+    assert ray_tpu.get(on_special.remote(), timeout=30) == "ran"
+
+    # Kill the special node while a task is pending on it -> retry then fail over.
+    @ray_tpu.remote(resources={"special": 1}, max_retries=0)
+    def long_special():
+        import time as t
+
+        t.sleep(60)
+
+    ref = long_special.remote()
+    time.sleep(1.0)
+    cluster.remove_node(n2)
+    with pytest.raises(ray_tpu.exceptions.WorkerCrashedError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_infeasible_becomes_feasible_on_new_node(ray_start_cluster):
+    cluster = ray_start_cluster
+
+    @ray_tpu.remote(resources={"late": 1})
+    def f():
+        return "finally"
+
+    ref = f.remote()
+    ready, _ = ray_tpu.wait([ref], timeout=0.5)
+    assert not ready
+    cluster.add_node(num_cpus=1, resources={"late": 1})
+    assert ray_tpu.get(ref, timeout=30) == "finally"
