@@ -1,0 +1,151 @@
+"""Collective API (reference: `python/ray/util/collective/collective.py` —
+`init_collective_group:120`, `allreduce:258`, `barrier:298`, `reduce:311`,
+`broadcast:373`, `allgather:423`, `reducescatter:472`, `send/recv:531+`).
+
+Differences from the reference, by design:
+ - backends are `xla` (ICI mesh collectives, replaces NCCL) and `tcp` (host
+   data, replaces pygloo); "nccl"/"gloo" names are accepted and mapped.
+ - XLA collectives return the result instead of mutating in place (XLA arrays
+   are immutable; in-place NCCL semantics don't map).
+ - rendezvous uses the GCS KV instead of a named NCCLUniqueIDStore actor.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+_groups: Dict[str, object] = {}
+_lock = threading.Lock()
+_RESERVED = object()
+
+
+def _kv(op: str, *args):
+    from ray_tpu._private.worker import _auto_init, global_worker
+
+    _auto_init()
+    return global_worker.context.kv(op, *args)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    g = _groups.get(group_name)
+    return g is not None and g is not _RESERVED
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "xla",
+    group_name: str = "default",
+    devices: Optional[List] = None,
+):
+    """Join this process into a named collective group. Every participant must
+    call this with the same world_size/group_name and a distinct rank."""
+    if world_size < 1 or not (0 <= rank < world_size):
+        raise ValueError(f"invalid world_size={world_size} rank={rank}")
+    # Reserve the name atomically so concurrent initializations of the same
+    # group cannot both construct (and leak) a coordinator.
+    with _lock:
+        if group_name in _groups:
+            raise RuntimeError(f"collective group '{group_name}' already initialized")
+        _groups[group_name] = _RESERVED
+    try:
+        b = Backend.resolve(backend)
+        if b == Backend.XLA:
+            from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
+
+            g = XLAGroup(world_size, rank, group_name, kv=_kv, devices=devices)
+        elif b == Backend.TCP:
+            from ray_tpu.util.collective.collective_group.tcp_group import TCPGroup
+
+            g = TCPGroup(world_size, rank, group_name, kv=_kv)
+        else:
+            raise ValueError(f"unsupported backend {backend}")
+    except BaseException:
+        with _lock:
+            if _groups.get(group_name) is _RESERVED:
+                del _groups[group_name]
+        raise
+    with _lock:
+        _groups[group_name] = g
+    return g
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        g = _groups.pop(group_name, None)
+    if g is not None:
+        g.destroy()
+
+
+def get_group(group_name: str = "default"):
+    g = _groups.get(group_name)
+    if g is _RESERVED:
+        raise RuntimeError(f"collective group '{group_name}' is still initializing")
+    if g is None:
+        raise RuntimeError(
+            f"collective group '{group_name}' is not initialized in this process; "
+            "call init_collective_group first"
+        )
+    return g
+
+
+def get_rank(group_name: str = "default") -> int:
+    return get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return get_group(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return get_group(group_name).allreduce(tensor, op)
+
+
+def barrier(group_name: str = "default") -> None:
+    get_group(group_name).barrier()
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return get_group(group_name).reduce(tensor, root_rank=dst_rank, op=op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(tensor, root_rank=src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return get_group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return get_group(group_name).reducescatter(tensor, op)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    return get_group(group_name).send(tensor, dst_rank)
+
+
+def recv(shape, dtype, src_rank: int, group_name: str = "default"):
+    return get_group(group_name).recv(shape, dtype, src_rank)
+
+
+def sendrecv(tensor, perm, group_name: str = "default"):
+    """SPMD permute: all ranks call; rank i receives from j for (j, i) in perm
+    (XLA backend only; lowered to lax.ppermute over ICI)."""
+    return get_group(group_name).sendrecv(tensor, perm)
+
+
+# Reference-parity aliases for the multi-accelerator-per-process variants.
+def allreduce_multidevice(tensors, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return get_group(group_name).allreduce_multidevice(tensors, op)
+
+
+def allgather_multidevice(tensors, group_name: str = "default"):
+    return get_group(group_name).allgather_multidevice(tensors)
+
+
+def reducescatter_multidevice(tensors, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return get_group(group_name).reducescatter_multidevice(tensors, op)

@@ -1,0 +1,258 @@
+"""TCP collective group: host-data collectives over sockets, the TPU build's
+analogue of the reference's pygloo-backed `GlooGroup`
+(`python/ray/util/collective/collective_group/gloo_collective_group.py`).
+
+Topology: rank 0 runs a coordinator server; every rank keeps one persistent
+connection to it. Collectives are sequence-numbered: the coordinator gathers all
+world_size contributions for a sequence, computes, and replies. This is O(N)
+through rank 0 — fine for control-plane payloads (rendezvous metadata, metrics,
+small gradients in tests); bulk tensor traffic belongs on the XLA/ICI backend.
+
+Rendezvous mirrors the reference's named-actor `NCCLUniqueIDStore`
+(`nccl_collective_group.py:28-60`) but uses the GCS KV (SURVEY.md §5: "rendezvous
+via the GCS KV instead of a named actor").
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.util.collective.collective_group.base_group import BaseGroup
+from ray_tpu.util.collective.rendezvous import clear, publish, wait_for
+from ray_tpu.util.collective.types import ReduceOp
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=5)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("collective peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _reduce(arrays: List[np.ndarray], op: ReduceOp) -> np.ndarray:
+    stack = np.stack(arrays)
+    if op == ReduceOp.SUM:
+        return stack.sum(axis=0)
+    if op == ReduceOp.PRODUCT:
+        return stack.prod(axis=0)
+    if op == ReduceOp.MIN:
+        return stack.min(axis=0)
+    if op == ReduceOp.MAX:
+        return stack.max(axis=0)
+    if op == ReduceOp.MEAN:
+        return stack.mean(axis=0)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+class _Coordinator:
+    """Rank-0 server: collects per-sequence contributions and answers."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.server.bind(("127.0.0.1", 0))
+        self.server.listen(world_size + 1)
+        self.port = self.server.getsockname()[1]
+        self._conns: Dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # seq -> {rank: payload}
+        self._contribs: Dict[Tuple[str, int], Dict[int, Any]] = {}
+        # p2p mailbox keyed (src, dst, seq): per-pair FIFO, no cross-sender
+        # overwrites.
+        self._mail: Dict[Tuple[int, int, int], Any] = {}
+        self._stopped = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                conn, _ = self.server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            hello = _recv_msg(conn)
+            rank = hello["rank"]
+            with self._cv:
+                self._conns[rank] = conn
+                self._cv.notify_all()
+            while True:
+                msg = _recv_msg(conn)
+                self._handle(rank, conn, msg)
+        except (ConnectionError, EOFError, OSError):
+            pass
+
+    def _handle(self, rank: int, conn: socket.socket, msg: Dict[str, Any]):
+        kind = msg["kind"]
+        if kind in ("allreduce", "reduce", "broadcast", "allgather", "reducescatter", "barrier"):
+            key = (kind, msg["seq"])
+            with self._cv:
+                self._contribs.setdefault(key, {})[rank] = msg
+                if len(self._contribs[key]) == self.world_size:
+                    self._complete(key)
+        elif kind == "send":
+            with self._cv:
+                self._mail[(rank, msg["dst"], msg["seq"])] = msg["data"]
+                self._cv.notify_all()
+        elif kind == "recv":
+            key = (msg["src"], rank, msg["seq"])
+            with self._cv:
+                while key not in self._mail and not self._stopped:
+                    self._cv.wait(timeout=1.0)
+                data = self._mail.pop(key, None)
+            _send_msg(conn, {"data": data})
+
+    def _complete(self, key: Tuple[str, int]):
+        """Called with lock held once all contributions for `key` arrived."""
+        kind, _seq = key
+        contribs = self._contribs.pop(key)
+        op = contribs[0].get("op", ReduceOp.SUM)
+        if kind == "barrier":
+            replies = {r: None for r in contribs}
+        elif kind == "allreduce":
+            out = _reduce([contribs[r]["data"] for r in sorted(contribs)], op)
+            replies = {r: out for r in contribs}
+        elif kind == "reduce":
+            root = contribs[0]["root"]
+            out = _reduce([contribs[r]["data"] for r in sorted(contribs)], op)
+            replies = {r: (out if r == root else None) for r in contribs}
+        elif kind == "broadcast":
+            root = contribs[0]["root"]
+            out = contribs[root]["data"]
+            replies = {r: out for r in contribs}
+        elif kind == "allgather":
+            gathered = [contribs[r]["data"] for r in sorted(contribs)]
+            replies = {r: gathered for r in contribs}
+        elif kind == "reducescatter":
+            out = _reduce([contribs[r]["data"] for r in sorted(contribs)], op)
+            shards = np.array_split(out, self.world_size, axis=0)
+            replies = {r: shards[r] for r in contribs}
+        else:
+            replies = {r: None for r in contribs}
+        for r, reply in replies.items():
+            try:
+                _send_msg(self._conns[r], {"data": reply})
+            except (KeyError, OSError):
+                pass
+
+    def stop(self):
+        self._stopped = True
+        try:
+            self.server.close()
+        except OSError:
+            pass
+
+
+class TCPGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str, kv):
+        super().__init__(world_size, rank, group_name)
+        self._kv = kv
+        self._seq = 0
+        self._coord: Optional[_Coordinator] = None
+        key = f"collective/{group_name}/coordinator".encode()
+        if rank == 0:
+            self._coord = _Coordinator(world_size)
+            publish(kv, key, f"127.0.0.1:{self._coord.port}".encode())
+            addr = ("127.0.0.1", self._coord.port)
+        else:
+            host, port = wait_for(kv, key).decode().split(":")
+            addr = (host, int(port))
+        self._sock = socket.create_connection(addr, timeout=60)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(self._sock, {"rank": rank})
+        self._sock_lock = threading.Lock()
+        # Per-peer FIFO sequence counters for p2p.
+        self._send_seqs: Dict[int, int] = {}
+        self._recv_seqs: Dict[int, int] = {}
+
+    def _round_trip(self, msg: Dict[str, Any]) -> Any:
+        with self._sock_lock:
+            _send_msg(self._sock, msg)
+            return _recv_msg(self._sock)["data"]
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        arr = np.asarray(tensor)
+        return self._round_trip(
+            {"kind": "allreduce", "seq": self._next_seq(), "data": arr, "op": op}
+        )
+
+    def barrier(self):
+        self._round_trip({"kind": "barrier", "seq": self._next_seq()})
+
+    def reduce(self, tensor, root_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        arr = np.asarray(tensor)
+        return self._round_trip(
+            {"kind": "reduce", "seq": self._next_seq(), "data": arr, "op": op, "root": root_rank}
+        )
+
+    def broadcast(self, tensor, root_rank: int = 0):
+        arr = np.asarray(tensor) if tensor is not None else None
+        return self._round_trip(
+            {"kind": "broadcast", "seq": self._next_seq(), "data": arr, "root": root_rank}
+        )
+
+    def allgather(self, tensor):
+        arr = np.asarray(tensor)
+        return self._round_trip(
+            {"kind": "allgather", "seq": self._next_seq(), "data": arr}
+        )
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        arr = np.asarray(tensor)
+        return self._round_trip(
+            {"kind": "reducescatter", "seq": self._next_seq(), "data": arr, "op": op}
+        )
+
+    def send(self, tensor, dst_rank: int):
+        arr = np.asarray(tensor)
+        seq = self._send_seqs.get(dst_rank, 0)
+        self._send_seqs[dst_rank] = seq + 1
+        with self._sock_lock:
+            _send_msg(
+                self._sock,
+                {"kind": "send", "seq": seq, "dst": dst_rank, "data": arr},
+            )
+
+    def recv(self, shape, dtype, src_rank: int):
+        seq = self._recv_seqs.get(src_rank, 0)
+        self._recv_seqs[src_rank] = seq + 1
+        return self._round_trip({"kind": "recv", "seq": seq, "src": src_rank})
+
+    def destroy(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._coord is not None:
+            self._coord.stop()
+            clear(self._kv, f"collective/{self.group_name}/coordinator".encode())

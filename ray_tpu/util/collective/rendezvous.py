@@ -1,0 +1,28 @@
+"""KV-based rendezvous shared by all collective backends: rank 0 publishes a
+value under a group-scoped key; other ranks poll until it appears. The TPU
+build's replacement for the reference's named `NCCLUniqueIDStore` actor
+(`nccl_collective_group.py:28-60`)."""
+
+from __future__ import annotations
+
+import time
+
+DEFAULT_TIMEOUT_S = 120.0
+
+
+def publish(kv, key: bytes, value: bytes) -> None:
+    kv("put", key, value)
+
+
+def wait_for(kv, key: bytes, timeout: float = DEFAULT_TIMEOUT_S) -> bytes:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        value = kv("get", key)
+        if value:
+            return value
+        time.sleep(0.05)
+    raise TimeoutError(f"rendezvous on {key!r} timed out after {timeout}s")
+
+
+def clear(kv, key: bytes) -> None:
+    kv("del", key)

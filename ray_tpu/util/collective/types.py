@@ -1,0 +1,64 @@
+"""Collective types (reference: `python/ray/util/collective/types.py` — Backend
+enum NCCL/GLOO/MPI, ReduceOp). The TPU build replaces NCCL with XLA (ICI mesh
+collectives) and pygloo with a pure-Python TCP group for host data."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+
+class Backend(str, Enum):
+    XLA = "xla"  # ICI/XLA collectives over a jax device mesh (replaces NCCL)
+    TCP = "tcp"  # host-data collectives over sockets (replaces pygloo)
+    # Accepted for API familiarity; mapped onto the TPU-native equivalents.
+    NCCL = "nccl"
+    GLOO = "gloo"
+
+    @classmethod
+    def resolve(cls, name: str) -> "Backend":
+        b = cls(name.lower())
+        if b == cls.NCCL:
+            return cls.XLA
+        if b == cls.GLOO:
+            return cls.TCP
+        return b
+
+
+class ReduceOp(str, Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"
+
+
+@dataclass
+class AllReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+
+
+@dataclass
+class BarrierOptions:
+    pass
+
+
+@dataclass
+class ReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+
+
+@dataclass
+class BroadcastOptions:
+    root_rank: int = 0
+
+
+@dataclass
+class AllGatherOptions:
+    pass
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM

@@ -9,12 +9,25 @@ set at conftest import time, before any test module imports jax.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the machine boots every interpreter with the axon TPU plugin
+# (sitecustomize calls jax.config.update("jax_platforms", "axon,cpu")), which
+# beats env vars. Tests must run on the virtual 8-device CPU mesh, so (a) unset
+# the axon trigger for worker subprocesses, (b) set the env for them, and
+# (c) override the jax config in this process before any backend initializes.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
