@@ -1,0 +1,33 @@
+from ray_tpu.models.gpt import (
+    GPTConfig,
+    forward,
+    init_params,
+    loss_fn,
+    num_params,
+    param_logical_axes,
+    train_flops_per_token,
+)
+from ray_tpu.models.training import (
+    TrainState,
+    create_train_state,
+    default_optimizer,
+    make_train_step,
+    param_shardings,
+    shard_batch,
+)
+
+__all__ = [
+    "GPTConfig",
+    "TrainState",
+    "create_train_state",
+    "default_optimizer",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "make_train_step",
+    "num_params",
+    "param_logical_axes",
+    "param_shardings",
+    "shard_batch",
+    "train_flops_per_token",
+]

@@ -1,0 +1,258 @@
+"""GPT-2 family, TPU-first: the flagship model for the Train/bench path
+(BASELINE.md north star: data-parallel GPT-2 at >=40% MFU).
+
+Design choices for the MXU/XLA:
+ - params are a plain pytree with per-leaf *logical* axis names; placement is
+   decided by `parallel.ShardingRules` at trainer level (DP/FSDP/TP without
+   touching the model).
+ - per-layer params are stacked on a leading "layers" dim and the forward scans
+   over them (`lax.scan`): compile time is O(1) in depth, and remat
+   (`jax.checkpoint`) wraps the scanned block to trade FLOPs for HBM.
+ - activations/matmuls in bfloat16, params & softmax/logits in float32.
+ - attention: pallas flash kernel on TPU, plain XLA elsewhere, ring attention
+   (context parallelism) injectable via `attention_fn`.
+ - vocab padded to a multiple of 128 so the logits matmul tiles the MXU.
+
+The reference has no model code (it is the distributed substrate); the
+equivalent user-facing artifact is its GPT-2 release benchmark
+(`/root/reference/release/air_tests/air_benchmarks/` HF-GPT-2 workloads).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # 50257 padded up to a multiple of 128
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 0  # 0 -> 4 * d_model
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    # "dots" saves matmul outputs across the remat boundary (less recompute,
+    # more memory); None recomputes everything in the block.
+    remat_policy: Optional[str] = None
+    attention: str = "auto"  # auto | flash | xla
+    dropout: float = 0.0  # pretraining default; inference/eval ignores it anyway
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    # ---- presets ----
+    @classmethod
+    def gpt2_small(cls, **kw):
+        return cls(n_layer=12, n_head=12, d_model=768, **kw)
+
+    @classmethod
+    def gpt2_medium(cls, **kw):
+        return cls(n_layer=24, n_head=16, d_model=1024, **kw)
+
+    @classmethod
+    def gpt2_large(cls, **kw):
+        return cls(n_layer=36, n_head=20, d_model=1280, **kw)
+
+    @classmethod
+    def gpt2_xl(cls, **kw):
+        return cls(n_layer=48, n_head=25, d_model=1600, **kw)
+
+    @classmethod
+    def nano(cls, **kw):
+        """Tiny config for CPU tests."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq_len", 128)
+        return cls(n_layer=2, n_head=2, d_model=64, **kw)
+
+
+def num_params(config: GPTConfig) -> int:
+    d, L, V, F = config.d_model, config.n_layer, config.vocab_size, config.ff_dim
+    per_layer = (
+        3 * d * d + 3 * d  # qkv
+        + d * d + d        # attn out
+        + d * F + F        # mlp fc
+        + F * d + d        # mlp proj
+        + 4 * d            # 2 layernorms
+    )
+    return V * d + config.max_seq_len * d + L * per_layer + 2 * d
+
+
+def train_flops_per_token(config: GPTConfig, seq_len: int) -> float:
+    """6*N matmul flops + attention term, the standard MFU accounting."""
+    n = num_params(config) - config.vocab_size * config.d_model  # non-embedding
+    n += config.vocab_size * config.d_model  # logits matmul counts
+    attn = 12 * config.n_layer * config.d_model * seq_len  # fwd+bwd qk+pv
+    return 6.0 * n + attn
+
+
+# --------------------------------------------------------------------------- init
+def init_params(config: GPTConfig, key) -> Dict[str, Any]:
+    d, L, V, F = config.d_model, config.n_layer, config.vocab_size, config.ff_dim
+    nh, hd = config.n_head, config.head_dim
+    k = iter(jax.random.split(key, 16))
+    std = 0.02
+    proj_std = std / math.sqrt(2 * L)  # GPT-2 residual-scaled init
+    pd = config.param_dtype
+
+    def norm(key, shape, s):
+        return (jax.random.normal(key, shape) * s).astype(pd)
+
+    params = {
+        "wte": norm(next(k), (V, d), std),
+        "wpe": norm(next(k), (config.max_seq_len, d), std),
+        "blocks": {
+            "ln1_scale": jnp.ones((L, d), pd),
+            "ln1_bias": jnp.zeros((L, d), pd),
+            "qkv_w": norm(next(k), (L, d, 3, nh, hd), std),
+            "qkv_b": jnp.zeros((L, 3, nh, hd), pd),
+            "out_w": norm(next(k), (L, nh, hd, d), proj_std),
+            "out_b": jnp.zeros((L, d), pd),
+            "ln2_scale": jnp.ones((L, d), pd),
+            "ln2_bias": jnp.zeros((L, d), pd),
+            "fc_w": norm(next(k), (L, d, F), std),
+            "fc_b": jnp.zeros((L, F), pd),
+            "proj_w": norm(next(k), (L, F, d), proj_std),
+            "proj_b": jnp.zeros((L, d), pd),
+        },
+        "lnf_scale": jnp.ones((d,), pd),
+        "lnf_bias": jnp.zeros((d,), pd),
+    }
+    return params
+
+
+def param_logical_axes(config: GPTConfig) -> Dict[str, Any]:
+    """Per-leaf logical axis names, consumed by parallel.ShardingRules."""
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": {
+            "ln1_scale": ("layers", None),
+            "ln1_bias": ("layers", None),
+            "qkv_w": ("layers", "embed", None, "heads", None),
+            "qkv_b": ("layers", None, "heads", None),
+            "out_w": ("layers", "heads", None, "embed"),
+            "out_b": ("layers", None),
+            "ln2_scale": ("layers", None),
+            "ln2_bias": ("layers", None),
+            "fc_w": ("layers", "embed", "mlp"),
+            "fc_b": ("layers", "mlp"),
+            "proj_w": ("layers", "mlp", "embed"),
+            "proj_b": ("layers", None),
+        },
+        "lnf_scale": (None,),
+        "lnf_bias": (None,),
+    }
+
+
+# --------------------------------------------------------------------------- forward
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x = x.astype(jnp.float32)
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return ((x - mean) * jax.lax.rsqrt(var + eps) * scale + bias)
+
+
+def _attention(q, k, v, config: GPTConfig, attention_fn):
+    if attention_fn is not None:
+        return attention_fn(q, k, v)
+    from ray_tpu.ops.flash_attention import flash_attention, xla_attention
+
+    mode = config.attention
+    if mode == "auto":
+        mode = "flash" if jax.default_backend() == "tpu" else "xla"
+    if mode == "flash":
+        return flash_attention(q, k, v, causal=True)
+    return xla_attention(q, k, v, causal=True)
+
+
+def _block(x, layer, config: GPTConfig, attention_fn):
+    """One transformer block. x: (B, S, D) in config.dtype."""
+    B, S, D = x.shape
+    nh, hd = config.n_head, config.head_dim
+    cdt = config.dtype
+
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]).astype(cdt)
+    qkv = jnp.einsum("bsd,dcnh->bscnh", h, layer["qkv_w"].astype(cdt)) + layer[
+        "qkv_b"
+    ].astype(cdt)
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))  # (B, nh, S, hd)
+    o = _attention(q, k, v, config, attention_fn)  # (B, nh, S, hd)
+    o = jnp.einsum("bnsh,nhd->bsd", o.astype(cdt), layer["out_w"].astype(cdt)) + layer[
+        "out_b"
+    ].astype(cdt)
+    x = x + o
+
+    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]).astype(cdt)
+    h = jnp.einsum("bsd,df->bsf", h, layer["fc_w"].astype(cdt)) + layer["fc_b"].astype(cdt)
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("bsf,fd->bsd", h, layer["proj_w"].astype(cdt)) + layer["proj_b"].astype(cdt)
+    return x + h
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens,  # (B, S) int32
+    config: GPTConfig,
+    attention_fn: Optional[Callable] = None,
+):
+    """Returns logits (B, S, vocab) in float32."""
+    B, S = tokens.shape
+    cdt = config.dtype
+    x = params["wte"].astype(cdt)[tokens] + params["wpe"].astype(cdt)[:S][None]
+
+    block_fn = lambda x, layer: (_block(x, layer, config, attention_fn), None)
+    if config.remat:
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if config.remat_policy == "dots"
+            else None
+        )
+        block_fn = jax.checkpoint(block_fn, prevent_cse=False, policy=policy)
+    x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    # Tied LM head: bf16 operands on the MXU, f32 accumulation — an f32×f32
+    # matmul here would run at a fraction of MXU rate and this matmul is ~30%
+    # of GPT-2-small's FLOPs.
+    logits = jnp.einsum(
+        "bsd,vd->bsv",
+        x.astype(cdt),
+        params["wte"].astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, Any],  # {"tokens": (B, S+1)} or {"inputs","targets"}
+    config: GPTConfig,
+    attention_fn: Optional[Callable] = None,
+):
+    """Causal LM cross entropy (mean over tokens)."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+    else:
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, config, attention_fn)
+    # logsumexp - logit[target]: one reduction pass over V instead of
+    # materializing the full (B, S, V) log-softmax array (saves ~2x V-sized
+    # HBM traffic, ~19ms/step for GPT-2-small at B=16 on v5e).
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    at_target = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return (lse - at_target).mean()

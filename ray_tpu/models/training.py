@@ -1,0 +1,128 @@
+"""Sharded train-state + train-step factory for the model zoo.
+
+The SPMD recipe (scaling-book style, SURVEY.md §7): params are initialized
+*under jit with explicit out_shardings* (so big models never materialize
+unsharded), the optimizer state inherits param shardings through propagation,
+and the train step is a single jitted function with donated state — XLA inserts
+the DP gradient all-reduce / FSDP all-gathers / TP collectives from the sharding
+annotations alone. Loss-parity note: this is the exact computation a bare-JAX
+script would run; the framework adds no per-step Python between device
+dispatches (the reference's "Ray adds ~0% overhead over DDP" property).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel import MeshSpec, ShardingRules, batch_spec
+from ray_tpu.models import gpt
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def param_shardings(config: gpt.GPTConfig, mesh, rules: ShardingRules):
+    axes = gpt.param_logical_axes(config)
+    shapes = jax.eval_shape(lambda: gpt.init_params(config, jax.random.PRNGKey(0)))
+    return jax.tree.map(
+        lambda ax, s: rules.sharding(mesh, ax, shape=s.shape),
+        axes,
+        shapes,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )
+
+
+def create_train_state(
+    config: gpt.GPTConfig,
+    key,
+    optimizer,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+) -> TrainState:
+    """Initialize params (sharded, under jit) + optimizer state."""
+    if mesh is not None:
+        rules = rules or ShardingRules()
+        shardings = param_shardings(config, mesh, rules)
+        init = jax.jit(lambda k: gpt.init_params(config, k), out_shardings=shardings)
+    else:
+        init = jax.jit(lambda k: gpt.init_params(config, k))
+    params = init(key)
+    # Optimizer state (adam mu/nu) inherits the param shardings by propagation.
+    opt_state = jax.jit(optimizer.init)(params)
+    return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(
+    config: gpt.GPTConfig,
+    optimizer,
+    mesh=None,
+    attention_fn: Optional[Callable] = None,
+    donate: bool = True,
+) -> Callable[[TrainState, Dict[str, Any]], Tuple[TrainState, Dict[str, Any]]]:
+    """One fused SPMD update: loss -> grads -> optimizer -> new state."""
+
+    def step_fn(state: TrainState, batch):
+        def loss_of(p):
+            return gpt.loss_fn(p, batch, config, attention_fn)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        import optax
+
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params, opt_state=new_opt, step=state.step + 1
+        )
+        gnorm = optax.global_norm(grads)
+        return new_state, {"loss": loss, "grad_norm": gnorm, "step": new_state.step}
+
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+
+def shard_batch(batch: Dict[str, Any], mesh):
+    """Place a host batch onto the mesh with the canonical batch sharding
+    (batch dim over (data, fsdp), sequence over context)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(x):
+        if x.ndim >= 2:
+            spec = P(("data", "fsdp"), "context") if mesh.shape["context"] > 1 else P(("data", "fsdp"))
+            return jax.device_put(x, NamedSharding(mesh, spec))
+        return jax.device_put(x, NamedSharding(mesh, P(("data", "fsdp"))))
+
+    return jax.tree.map(put, batch)
+
+
+def default_optimizer(
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+    warmup_steps: int = 0,
+    total_steps: int = 0,
+):
+    """AdamW with cosine schedule + global-norm clipping (GPT-2 recipe)."""
+    import optax
+
+    if total_steps:
+        lr = optax.warmup_cosine_decay_schedule(
+            0.0, learning_rate, max(warmup_steps, 1), total_steps
+        )
+    else:
+        lr = learning_rate
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(lr, b1=b1, b2=b2, weight_decay=weight_decay),
+    )

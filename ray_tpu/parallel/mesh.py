@@ -112,14 +112,24 @@ class ShardingRules:
         ("norm", None),
     )
 
-    def mesh_axes(self, logical_axes: Sequence[Optional[str]]):
-        """PartitionSpec for an array annotated with logical axis names."""
+    def mesh_axes(
+        self,
+        logical_axes: Sequence[Optional[str]],
+        mesh=None,
+        shape: Optional[Sequence[int]] = None,
+    ):
+        """PartitionSpec for an array annotated with logical axis names.
+
+        With `mesh` + `shape`, mesh axes that don't divide the dimension are
+        dropped (e.g. 2 heads on a tensor=4 mesh stay replicated) — models keep
+        one annotation set across every mesh size.
+        """
         from jax.sharding import PartitionSpec
 
         lookup = dict(self.rules)
         out: List = []
         used: set = set()
-        for ax in logical_axes:
+        for i, ax in enumerate(logical_axes):
             if ax is None:
                 out.append(None)
                 continue
@@ -130,20 +140,41 @@ class ShardingRules:
                 out.append(None)
                 continue
             # An axis already consumed by another dimension cannot repeat.
-            free = tuple(a for a in mesh_axes if a not in used)
+            free = [a for a in mesh_axes if a not in used]
+            if mesh is not None and shape is not None:
+                # Pick the order-preserving subset of axes with the largest
+                # total size that divides the dimension (a greedy prefix would
+                # e.g. keep data=2 and then have to drop fsdp=8 on a dim of 8,
+                # silently losing 4x parallelism).
+                import itertools
+
+                dim = shape[i]
+                candidates = [a for a in free if mesh.shape[a] > 1]
+                best: List[str] = []
+                best_prod = 1
+                for r in range(len(candidates), 0, -1):
+                    for combo in itertools.combinations(candidates, r):
+                        prod = 1
+                        for a in combo:
+                            prod *= mesh.shape[a]
+                        if dim % prod == 0 and prod > best_prod:
+                            best, best_prod = list(combo), prod
+                    if best:
+                        break
+                free = best
             used.update(free)
             if not free:
                 out.append(None)
             elif len(free) == 1:
                 out.append(free[0])
             else:
-                out.append(free)
+                out.append(tuple(free))
         return PartitionSpec(*out)
 
-    def sharding(self, mesh, logical_axes: Sequence[Optional[str]]):
+    def sharding(self, mesh, logical_axes: Sequence[Optional[str]], shape=None):
         from jax.sharding import NamedSharding
 
-        return NamedSharding(mesh, self.mesh_axes(logical_axes))
+        return NamedSharding(mesh, self.mesh_axes(logical_axes, mesh=mesh, shape=shape))
 
 
 def batch_spec():
@@ -185,5 +216,7 @@ def shard_params(params, mesh, rules: ShardingRules, logical_axes):
     import jax
 
     return jax.tree.map(
-        lambda p, ax: jax.device_put(p, rules.sharding(mesh, ax)), params, logical_axes
+        lambda p, ax: jax.device_put(p, rules.sharding(mesh, ax, shape=p.shape)),
+        params,
+        logical_axes,
     )
