@@ -43,7 +43,9 @@ class GPTConfig:
     # more memory); None recomputes everything in the block.
     remat_policy: Optional[str] = None
     attention: str = "auto"  # auto | flash | xla
-    dropout: float = 0.0  # pretraining default; inference/eval ignores it anyway
+    # Applied to embeddings and both residual branches when a dropout_rng is
+    # passed to forward()/loss_fn (GPT-2 used 0.1; modern pretraining uses 0).
+    dropout: float = 0.0
 
     @property
     def ff_dim(self) -> int:
@@ -92,11 +94,13 @@ def num_params(config: GPTConfig) -> int:
 
 
 def train_flops_per_token(config: GPTConfig, seq_len: int) -> float:
-    """6*N matmul flops + attention term, the standard MFU accounting."""
-    n = num_params(config) - config.vocab_size * config.d_model  # non-embedding
-    n += config.vocab_size * config.d_model  # logits matmul counts
+    """6*N matmul flops + attention term, the standard MFU accounting.
+
+    The tied wte is counted once: as embedding table it costs no matmul flops,
+    as the logits head it does — num_params already includes it exactly once.
+    """
     attn = 12 * config.n_layer * config.d_model * seq_len  # fwd+bwd qk+pv
-    return 6.0 * n + attn
+    return 6.0 * num_params(config) + attn
 
 
 # --------------------------------------------------------------------------- init
@@ -179,11 +183,21 @@ def _attention(q, k, v, config: GPTConfig, attention_fn):
     return xla_attention(q, k, v, causal=True)
 
 
-def _block(x, layer, config: GPTConfig, attention_fn):
+def _dropout(x, rate: float, rng):
+    if rng is None or rate <= 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)
+
+
+def _block(x, layer, config: GPTConfig, attention_fn, drop_rng=None):
     """One transformer block. x: (B, S, D) in config.dtype."""
     B, S, D = x.shape
     nh, hd = config.n_head, config.head_dim
     cdt = config.dtype
+    r1 = r2 = None
+    if drop_rng is not None and config.dropout > 0:
+        r1, r2 = jax.random.split(drop_rng)
 
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]).astype(cdt)
     qkv = jnp.einsum("bsd,dcnh->bscnh", h, layer["qkv_w"].astype(cdt)) + layer[
@@ -194,13 +208,13 @@ def _block(x, layer, config: GPTConfig, attention_fn):
     o = jnp.einsum("bnsh,nhd->bsd", o.astype(cdt), layer["out_w"].astype(cdt)) + layer[
         "out_b"
     ].astype(cdt)
-    x = x + o
+    x = x + _dropout(o, config.dropout, r1)
 
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]).astype(cdt)
     h = jnp.einsum("bsd,df->bsf", h, layer["fc_w"].astype(cdt)) + layer["fc_b"].astype(cdt)
     h = jax.nn.gelu(h)
     h = jnp.einsum("bsf,fd->bsd", h, layer["proj_w"].astype(cdt)) + layer["proj_b"].astype(cdt)
-    return x + h
+    return x + _dropout(h, config.dropout, r2)
 
 
 def forward(
@@ -208,13 +222,23 @@ def forward(
     tokens,  # (B, S) int32
     config: GPTConfig,
     attention_fn: Optional[Callable] = None,
+    dropout_rng=None,
 ):
-    """Returns logits (B, S, vocab) in float32."""
+    """Returns logits (B, S, vocab) in float32. Pass dropout_rng to enable
+    dropout (training); omit it for deterministic eval."""
     B, S = tokens.shape
     cdt = config.dtype
     x = params["wte"].astype(cdt)[tokens] + params["wpe"].astype(cdt)[:S][None]
+    use_dropout = dropout_rng is not None and config.dropout > 0
+    if use_dropout:
+        emb_rng, layers_rng = jax.random.split(dropout_rng)
+        x = _dropout(x, config.dropout, emb_rng)
 
-    block_fn = lambda x, layer: (_block(x, layer, config, attention_fn), None)
+    def block_fn(x, xs):
+        layer, idx = xs
+        rng = jax.random.fold_in(layers_rng, idx) if use_dropout else None
+        return _block(x, layer, config, attention_fn, rng), None
+
     if config.remat:
         policy = (
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable
@@ -222,7 +246,9 @@ def forward(
             else None
         )
         block_fn = jax.checkpoint(block_fn, prevent_cse=False, policy=policy)
-    x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+    x, _ = jax.lax.scan(
+        block_fn, x, (params["blocks"], jnp.arange(config.n_layer))
+    )
 
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     # Tied LM head: bf16 operands on the MXU, f32 accumulation — an f32×f32
@@ -242,6 +268,7 @@ def loss_fn(
     batch: Dict[str, Any],  # {"tokens": (B, S+1)} or {"inputs","targets"}
     config: GPTConfig,
     attention_fn: Optional[Callable] = None,
+    dropout_rng=None,
 ):
     """Causal LM cross entropy (mean over tokens)."""
     if "inputs" in batch:
@@ -249,7 +276,7 @@ def loss_fn(
     else:
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, config, attention_fn)
+    logits = forward(params, inputs, config, attention_fn, dropout_rng)
     # logsumexp - logit[target]: one reduction pass over V instead of
     # materializing the full (B, S, V) log-softmax array (saves ~2x V-sized
     # HBM traffic, ~19ms/step for GPT-2-small at B=16 on v5e).

@@ -18,7 +18,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.parallel import MeshSpec, ShardingRules, batch_spec
+from ray_tpu.parallel import ShardingRules, batch_spec
 from ray_tpu.models import gpt
 
 
@@ -72,9 +72,15 @@ def make_train_step(
 ) -> Callable[[TrainState, Dict[str, Any]], Tuple[TrainState, Dict[str, Any]]]:
     """One fused SPMD update: loss -> grads -> optimizer -> new state."""
 
+    base_rng = jax.random.PRNGKey(0x5eed)
+
     def step_fn(state: TrainState, batch):
+        dropout_rng = (
+            jax.random.fold_in(base_rng, state.step) if config.dropout > 0 else None
+        )
+
         def loss_of(p):
-            return gpt.loss_fn(p, batch, config, attention_fn)
+            return gpt.loss_fn(p, batch, config, attention_fn, dropout_rng)
 
         loss, grads = jax.value_and_grad(loss_of)(state.params)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
@@ -92,14 +98,12 @@ def make_train_step(
 
 def shard_batch(batch: Dict[str, Any], mesh):
     """Place a host batch onto the mesh with the canonical batch sharding
-    (batch dim over (data, fsdp), sequence over context)."""
+    (batch dim over (data, fsdp), sequence over context — `parallel.batch_spec`)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def put(x):
-        if x.ndim >= 2:
-            spec = P(("data", "fsdp"), "context") if mesh.shape["context"] > 1 else P(("data", "fsdp"))
-            return jax.device_put(x, NamedSharding(mesh, spec))
-        return jax.device_put(x, NamedSharding(mesh, P(("data", "fsdp"))))
+        spec = batch_spec() if x.ndim >= 2 else P(("data", "fsdp"))
+        return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, batch)
 

@@ -268,6 +268,52 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     return dq, dk, dv
 
 
+# --------------------------------------------------------------------------- blockwise (long-seq XLA)
+def blockwise_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
+                        block_k: int = 1024):
+    """O(S * block_k)-memory attention as a remat'ed scan over K blocks — the
+    long-sequence path while the pallas kernels keep full-seq K/V in VMEM
+    (which caps them around S~8k at d=64). Exact, differentiable, pure XLA."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    B, H, S, D = q.shape
+    if S % block_k:
+        return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    nblk = S // block_k
+    kb = jnp.moveaxis(k.reshape(B, H, nblk, block_k, D), 2, 0)  # (nblk, B, H, bk, D)
+    vb = jnp.moveaxis(v.reshape(B, H, nblk, block_k, D), 2, 0)
+    qf = q.astype(jnp.float32)
+    row = jax.lax.broadcasted_iota(jnp.int32, (S, block_k), 0)
+
+    @jax.checkpoint
+    def body(carry, inp):
+        m_prev, l_prev, acc = carry
+        kblk, vblk, j = inp
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, kblk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (S, block_k), 1)
+            s = jnp.where((row >= col)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (kb, vb, jnp.arange(nblk)))
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
 # --------------------------------------------------------------------------- public entry
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -300,14 +346,22 @@ def flash_attention(
 ):
     """Multi-head attention, (batch, heads, seq, head_dim) layout.
 
-    backend: "pallas" | "xla" | None (auto: pallas on TPU, xla elsewhere).
+    backend: "pallas" | "xla" | "blockwise" | None (auto: pallas on TPU up to
+    the VMEM-resident K/V limit, blockwise beyond it, xla off-TPU).
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if backend is None:
-        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if jax.default_backend() == "tpu":
+            # Pallas kernels keep full-seq K/V in VMEM: ~2*S*D bytes (bf16)
+            # per (b,h); beyond ~8k at d=64 switch to the blockwise scan.
+            backend = "pallas" if q.shape[2] * q.shape[3] <= 8192 * 64 else "blockwise"
+        else:
+            backend = "xla"
     if backend == "xla":
         return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if backend == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     b, h, s, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)

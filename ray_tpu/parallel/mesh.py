@@ -152,6 +152,8 @@ class ShardingRules:
                 candidates = [a for a in free if mesh.shape[a] > 1]
                 best: List[str] = []
                 best_prod = 1
+                # Exhaustive over subsets (rules map to <=3 axes, so <=8): a
+                # larger subset is not necessarily a larger product.
                 for r in range(len(candidates), 0, -1):
                     for combo in itertools.combinations(candidates, r):
                         prod = 1
@@ -159,8 +161,6 @@ class ShardingRules:
                             prod *= mesh.shape[a]
                         if dim % prod == 0 and prod > best_prod:
                             best, best_prod = list(combo), prod
-                    if best:
-                        break
                 free = best
             used.update(free)
             if not free:

@@ -57,22 +57,39 @@ def ring_attention(
     def step_fn(carry, step):
         m_prev, l_prev, acc, k_cur, v_cur = carry
         src = jax.lax.rem(my - step + axis_size, axis_size)
-        s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        ) * sm_scale
+
+        def attend(args):
+            m_prev, l_prev, acc, k_cur, v_cur = args
+            s = jnp.einsum(
+                "bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale
+            if causal:
+                row = my * s_local + jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
+                col = src * s_local + jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
+                s = jnp.where((row >= col)[None, None], s, NEG_INF)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
         if causal:
-            row = my * s_local + jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
-            col = src * s_local + jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
-            s = jnp.where((row >= col)[None, None], s, NEG_INF)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
+            # K/V blocks entirely in this device's future contribute nothing:
+            # skip the quadratic compute (the branch condition is identical on
+            # every device for a given step, so control flow stays uniform).
+            m_new, l_new, acc_new = jax.lax.cond(
+                src > my,
+                lambda args: (args[0], args[1], args[2]),
+                attend,
+                (m_prev, l_prev, acc, k_cur, v_cur),
+            )
+        else:
+            m_new, l_new, acc_new = attend((m_prev, l_prev, acc, k_cur, v_cur))
         # Rotate K/V to the next device; XLA overlaps this with the next step's
         # compute when it can (double-buffered ring).
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
