@@ -54,7 +54,9 @@ class _Pickler(cloudpickle.CloudPickler):
                     return (numpy.asarray, (numpy.asarray(obj),))
             except ImportError:
                 pass
-        return NotImplemented
+        # Delegate to CloudPickler: its reducer_override implements by-value
+        # function/class pickling (what ships closures to worker processes).
+        return super().reducer_override(obj)
 
 
 def serialize(value: Any) -> SerializedValue:

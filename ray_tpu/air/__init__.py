@@ -1,0 +1,32 @@
+"""AIR core: the shared vocabulary of Train/Tune/Serve/Data.
+
+Reference: `python/ray/air/` (P15 in SURVEY.md §2) — `Checkpoint`
+(`air/checkpoint.py:63`), the unified train/tune `session` (`air/session.py:43`),
+and the config dataclasses (`air/config.py`: `ScalingConfig`, `RunConfig`,
+`FailureConfig`, `CheckpointConfig`).
+
+TPU-first deltas: `ScalingConfig` maps directly onto a `jax.sharding.Mesh`
+(`MeshSpec` axes data/fsdp/tensor/pipeline/context/expert) instead of
+num_workers x GPUs, and `Checkpoint` is jax-pytree-aware (device arrays are
+fetched to host numpy on save, restored host-side, re-sharded by the trainer).
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.air import session
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "session",
+]

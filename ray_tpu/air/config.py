@@ -1,0 +1,99 @@
+"""Run-level config dataclasses shared by Train and Tune.
+
+Reference: `python/ray/air/config.py` (`ScalingConfig`, `RunConfig`,
+`FailureConfig:512`, `CheckpointConfig`).
+
+TPU-first delta: `ScalingConfig` carries an optional `mesh` (a
+`ray_tpu.parallel.MeshSpec` or axis dict) describing the per-worker SPMD
+layout — the ScalingConfig -> jax.sharding.Mesh seam of SURVEY.md §7 step 5.
+`num_workers` remains the number of *processes* (one per TPU host);
+`mesh` describes how each step shards over the global device set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Union
+
+
+@dataclass
+class ScalingConfig:
+    """How to scale training: worker gang size, resources, and mesh layout."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # TPU-native: SPMD mesh layout for the training step. Either a MeshSpec or
+    # a dict of axis sizes, e.g. {"data": 8} or {"data": 2, "tensor": 4}.
+    mesh: Optional[Union[Dict[str, int], Any]] = None
+    # Chips each worker process owns (TPU hosts have 4 or 8 local chips).
+    tpus_per_worker: Optional[float] = None
+
+    def __post_init__(self):
+        if self.num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+
+    @property
+    def _resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = float(self.tpus_per_worker or 1.0)
+        if not self.use_tpu:
+            res.pop("TPU", None)
+        res.setdefault("CPU", 1.0)
+        return res
+
+    def as_placement_group_bundles(self) -> list:
+        return [dict(self._resources) for _ in range(self.num_workers)]
+
+    def mesh_spec(self):
+        """Resolve the mesh layout (defaults to pure DP over all workers)."""
+        from ray_tpu.parallel import MeshSpec
+
+        if self.mesh is None:
+            return None  # trainer defaults to DP over the devices it sees
+        if isinstance(self.mesh, MeshSpec):
+            return self.mesh
+        return MeshSpec.from_dict(self.mesh)
+
+
+@dataclass
+class FailureConfig:
+    """Retry policy for a run (reference: `air/config.py:512`).
+
+    max_failures: total restarts-from-last-checkpoint allowed; 0 disables,
+    -1 is unlimited.
+    """
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Checkpoint retention policy (reference `air/config.py` CheckpointConfig)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+    def __post_init__(self):
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """Experiment-level settings: name, storage, failure + checkpoint policy."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1
+    log_to_file: bool = False

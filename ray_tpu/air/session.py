@@ -1,0 +1,96 @@
+"""The unified Train/Tune session: what user training code calls.
+
+Reference: `python/ray/air/session.py` — `report:43`, `get_checkpoint:97`,
+`get_world_rank` etc. One module-level accessor, bound to whichever session
+implementation is active in this process/thread (a Train worker session or a
+Tune function-trainable session). `session.report(metrics, checkpoint=...)`
+streams metrics (and optionally a checkpoint) back to the driver.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+def _get_session():
+    return getattr(_local, "session", None)
+
+
+def _set_session(sess) -> None:
+    _local.session = sess
+
+
+def _require_session():
+    sess = _get_session()
+    if sess is None:
+        raise RuntimeError(
+            "ray_tpu.air.session.* can only be called inside a training or "
+            "tuning function launched by a Trainer/Tuner."
+        )
+    return sess
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) -> None:
+    """Stream an intermediate result (and optional checkpoint) to the driver."""
+    _require_session().report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from (set on restart after failure), else None."""
+    return _require_session().loaded_checkpoint
+
+
+def get_world_size() -> int:
+    return _require_session().world_size
+
+
+def get_world_rank() -> int:
+    return _require_session().world_rank
+
+
+def get_local_rank() -> int:
+    return _require_session().local_rank
+
+
+def get_local_world_size() -> int:
+    return _require_session().local_world_size
+
+
+def get_node_rank() -> int:
+    return _require_session().node_rank
+
+
+def get_trial_name() -> str:
+    return getattr(_require_session(), "trial_name", "")
+
+
+def get_trial_id() -> str:
+    return getattr(_require_session(), "trial_id", "")
+
+
+def get_trial_dir() -> str:
+    return getattr(_require_session(), "trial_dir", "")
+
+
+def get_experiment_name() -> str:
+    return getattr(_require_session(), "experiment_name", "")
+
+
+def get_dataset_shard(dataset_name: str = "train"):
+    """This worker's split of the Datasets passed to the Trainer (P18 ingest)."""
+    sess = _require_session()
+    shard = (getattr(sess, "dataset_shards", None) or {}).get(dataset_name)
+    if shard is None:
+        raise KeyError(f"no dataset shard named '{dataset_name}' for this worker")
+    return shard
+
+
+def get_mesh():
+    """TPU-native: the jax.sharding.Mesh for this training run (JaxBackend),
+    resolved from ScalingConfig.mesh. None outside a JaxTrainer."""
+    return getattr(_require_session(), "mesh", None)

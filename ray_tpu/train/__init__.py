@@ -1,0 +1,40 @@
+"""Distributed training on the ray_tpu runtime.
+
+Reference: `python/ray/train/` (P16 in SURVEY.md §2) — `DataParallelTrainer`
+(`data_parallel_trainer.py:56`), `BackendExecutor`
+(`_internal/backend_executor.py:43`), `WorkerGroup` (`_internal/worker_group.py:92`),
+and the per-framework `Backend` plugin seam (`backend.py:53`).
+
+TPU-first: the flagship backend is `JaxConfig`/`JaxTrainer`
+(`ray_tpu.train.jax`) — the gang of worker actors forms one multi-controller
+SPMD program via `jax.distributed.initialize` (the seam where the reference
+calls `dist.init_process_group`, `train/torch/config.py:113`), and
+`ScalingConfig.mesh` becomes a global `jax.sharding.Mesh` whose collectives
+ride ICI inside the user's jitted step.
+"""
+
+from ray_tpu.air.config import (  # re-exported for parity convenience
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.base_trainer import BaseTrainer, TrainingFailedError
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainingFailedError",
+]

@@ -1,0 +1,156 @@
+"""BackendExecutor: drives the worker gang through a training run.
+
+Reference: `python/ray/train/_internal/backend_executor.py:43` (`BackendExecutor`),
+`start:94`, `_create_placement_group:147`, `start_training:325`,
+`get_next_results:426`. Gang semantics are all-or-nothing (SURVEY.md §7 "SPMD
+gang semantics"): any worker failure fails the whole group; the trainer layer
+restarts the full gang from the last checkpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train._internal.session import DONE, ERROR, REPORT, SessionArgs, TrainingResult
+from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+
+class TrainingWorkerError(Exception):
+    """A worker of the gang failed; the gang must be restarted as a unit."""
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: ScalingConfig,
+        trial_info: Optional[Dict[str, str]] = None,
+    ):
+        self._backend_config = backend_config
+        self._backend = backend_config.backend_cls()
+        self._scaling = scaling_config
+        self._trial_info = trial_info or {}
+        self._pg = None
+        self.worker_group: Optional[WorkerGroup] = None
+        self._ranks: List[int] = []
+
+    # ------------------------------------------------------------------ start
+    def start(self):
+        bundles = self._scaling.as_placement_group_bundles()
+        self._pg = placement_group(bundles, strategy=self._scaling.placement_strategy)
+        if not self._pg.ready(timeout=60.0):
+            remove_placement_group(self._pg)
+            self._pg = None
+            raise TrainingWorkerError(
+                f"placement group {bundles} not schedulable on this cluster"
+            )
+        self.worker_group = WorkerGroup(
+            self._scaling.num_workers,
+            resources_per_worker=self._scaling._resources,
+            placement_group=self._pg,
+        )
+        meta = self.worker_group.fetch_metadata()
+        # Rank assignment: stable by (node ip, pid) so local ranks are contiguous
+        # per node (the reference sorts workers by node for the same reason).
+        order = sorted(range(len(meta)), key=lambda i: (meta[i].node_ip, meta[i].pid))
+        self._ranks = [order.index(i) for i in range(len(meta))]
+        self._local: List[Dict[str, int]] = [{} for _ in meta]
+        by_node: Dict[str, List[int]] = {}
+        for i in order:
+            by_node.setdefault(meta[i].node_ip, []).append(i)
+        node_ips = sorted(by_node)
+        for node_rank, ip in enumerate(node_ips):
+            for local_rank, i in enumerate(by_node[ip]):
+                self._local[i] = {
+                    "local_rank": local_rank,
+                    "local_world_size": len(by_node[ip]),
+                    "node_rank": node_rank,
+                }
+        self._backend.on_start(self, self._backend_config)
+
+    @property
+    def ranks(self) -> List[int]:
+        return list(self._ranks)
+
+    def world_info(self, worker_index: int) -> Dict[str, int]:
+        info = dict(self._local[worker_index])
+        info["world_rank"] = self._ranks[worker_index]
+        info["world_size"] = len(self._ranks)
+        return info
+
+    # --------------------------------------------------------------- training
+    def start_training(
+        self,
+        train_fn: Callable[[Dict[str, Any]], None],
+        config: Dict[str, Any],
+        checkpoint: Optional[Checkpoint] = None,
+        dataset_shards: Optional[List[Dict[str, Any]]] = None,
+        mesh_builder: Optional[Callable] = None,
+    ):
+        self._backend.on_training_start(self, self._backend_config)
+        refs = []
+        for i, w in enumerate(self.worker_group.workers):
+            info = self.world_info(i)
+            args = SessionArgs(
+                train_fn=train_fn,
+                config=dict(config),
+                world_rank=info["world_rank"],
+                world_size=info["world_size"],
+                local_rank=info["local_rank"],
+                local_world_size=info["local_world_size"],
+                node_rank=info["node_rank"],
+                checkpoint=checkpoint,
+                dataset_shards=(dataset_shards or [{}] * len(self._ranks))[
+                    info["world_rank"]
+                ],
+                mesh_builder=mesh_builder,
+                **self._trial_info,
+            )
+            refs.append(w.init_session.remote(args))
+        ray_tpu.get(refs)
+
+    def get_next_results(self) -> Optional[List[TrainingResult]]:
+        """One result per worker (ordered by world rank), or None when all DONE.
+
+        Raises TrainingWorkerError if any worker errored or died.
+        """
+        refs = [w.next_result.remote() for w in self.worker_group.workers]
+        try:
+            results: List[TrainingResult] = ray_tpu.get(refs)
+        except Exception as e:
+            raise TrainingWorkerError(f"a training worker died: {e}") from e
+        by_rank = sorted(results, key=lambda r: r.world_rank)
+        errors = [r for r in by_rank if r.type == ERROR]
+        if errors:
+            raise TrainingWorkerError(
+                "training worker(s) failed:\n" + "\n".join(r.error for r in errors)
+            )
+        if all(r.type == DONE for r in by_rank):
+            return None
+        if any(r.type != REPORT for r in by_rank):
+            # Mixed DONE/REPORT: some worker returned early — a gang bug.
+            raise TrainingWorkerError(
+                "workers out of sync: mixed DONE and REPORT results in one round"
+            )
+        return by_rank
+
+    # ---------------------------------------------------------------- shutdown
+    def shutdown(self):
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(self, self._backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+        if self._pg is not None:
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None

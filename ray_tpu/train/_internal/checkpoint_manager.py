@@ -1,0 +1,74 @@
+"""Checkpoint persistence + retention for a training run.
+
+Reference: `python/ray/train/_internal/checkpoint.py` +
+`tune/execution/checkpoint_manager.py` — persist reported checkpoints under
+the run directory, track latest and best (by `checkpoint_score_attribute`),
+prune to `num_to_keep`.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, run_dir: str, config: Optional[CheckpointConfig] = None):
+        self.run_dir = run_dir
+        self.config = config or CheckpointConfig()
+        self._count = 0
+        # [(path, metrics)] in registration order; best tracked separately.
+        self._kept: List[Tuple[str, Dict[str, Any]]] = []
+        os.makedirs(run_dir, exist_ok=True)
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        return Checkpoint.from_directory(self._kept[-1][0]) if self._kept else None
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
+        """Persist a reported checkpoint; returns the durable directory form."""
+        self._count += 1
+        path = os.path.join(self.run_dir, f"checkpoint_{self._count:06d}")
+        checkpoint.to_directory(path)
+        self._kept.append((path, dict(metrics or {})))
+        self._prune()
+        return Checkpoint.from_directory(path)
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        attr = self.config.checkpoint_score_attribute
+        if not self._kept:
+            return None
+        if attr is None:
+            return self.latest_checkpoint
+        scored = [(m.get(attr), p) for p, m in self._kept if attr in m]
+        if not scored:
+            return self.latest_checkpoint
+        best = (max if self.config.checkpoint_score_order == "max" else min)(scored)
+        return Checkpoint.from_directory(best[1])
+
+    def best_checkpoints(self) -> List[Tuple[Checkpoint, Dict[str, Any]]]:
+        return [(Checkpoint.from_directory(p), m) for p, m in self._kept]
+
+    def _prune(self):
+        keep = self.config.num_to_keep
+        if keep is None:
+            return
+        attr = self.config.checkpoint_score_attribute
+        while len(self._kept) > keep:
+            if attr is None:
+                victim = 0  # FIFO: oldest goes first
+            else:
+                # Drop the worst-scoring; never drop the most recent (resume).
+                order = self.config.checkpoint_score_order
+                candidates = list(enumerate(self._kept[:-1]))
+                victim = (
+                    min(candidates, key=lambda kv: kv[1][1].get(attr, float("inf")))
+                    if order == "max"
+                    else max(candidates, key=lambda kv: kv[1][1].get(attr, float("-inf")))
+                )[0]
+            path, _ = self._kept.pop(victim)
+            shutil.rmtree(path, ignore_errors=True)

@@ -1,0 +1,133 @@
+"""Per-worker training session: runs the user loop in a thread and streams
+results to the driver.
+
+Reference: `python/ray/train/_internal/session.py` (the thread-based
+`_TrainSession`): `session.report` enqueues a `TrainingResult`; the driver's
+`BackendExecutor.get_next_results` round-robins `next_result()` across the
+gang. The queue is bounded at 1 so training naturally backpressures on the
+driver consuming results (and a checkpoint is fully handed off before the
+loop continues — the property PBT-style mutation relies on).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air import session as air_session
+from ray_tpu.air.checkpoint import Checkpoint
+
+REPORT = "report"
+DONE = "done"
+ERROR = "error"
+
+
+@dataclass
+class TrainingResult:
+    type: str  # REPORT | DONE | ERROR
+    metrics: Optional[Dict[str, Any]] = None
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    world_rank: int = 0
+
+
+@dataclass
+class SessionArgs:
+    train_fn: Callable
+    config: Dict[str, Any]
+    world_rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    trial_name: str = ""
+    trial_id: str = ""
+    trial_dir: str = ""
+    experiment_name: str = ""
+    checkpoint: Optional[Checkpoint] = None
+    dataset_shards: Dict[str, Any] = field(default_factory=dict)
+    mesh_builder: Optional[Callable] = None  # () -> jax Mesh, run in-thread
+
+
+class _TrainSession:
+    def __init__(self, args: SessionArgs):
+        self.args = args
+        self.world_rank = args.world_rank
+        self.world_size = args.world_size
+        self.local_rank = args.local_rank
+        self.local_world_size = args.local_world_size
+        self.node_rank = args.node_rank
+        self.trial_name = args.trial_name
+        self.trial_id = args.trial_id
+        self.trial_dir = args.trial_dir
+        self.experiment_name = args.experiment_name
+        self.loaded_checkpoint = args.checkpoint
+        self.dataset_shards = args.dataset_shards
+        self.mesh = None
+        self._q: "queue.Queue[TrainingResult]" = queue.Queue(maxsize=1)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._finished = threading.Event()
+
+    # ----------------------------------------------------------- thread side
+    def _run(self):
+        air_session._set_session(self)
+        try:
+            if self.args.mesh_builder is not None:
+                self.mesh = self.args.mesh_builder()
+            self.args.train_fn(self.args.config)
+            self._q.put(TrainingResult(DONE, world_rank=self.world_rank))
+        except BaseException as e:  # noqa: BLE001 - forwarded to the driver
+            self._q.put(
+                TrainingResult(
+                    ERROR,
+                    error=f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
+                    world_rank=self.world_rank,
+                )
+            )
+        finally:
+            self._finished.set()
+            air_session._set_session(None)
+
+    def report(self, metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
+        self._q.put(
+            TrainingResult(
+                REPORT, metrics=dict(metrics), checkpoint=checkpoint,
+                world_rank=self.world_rank,
+            )
+        )
+
+    # ----------------------------------------------------------- driver side
+    def start(self):
+        self._thread.start()
+
+    def next_result(self, timeout: Optional[float] = None) -> TrainingResult:
+        return self._q.get(timeout=timeout)
+
+    def finished(self) -> bool:
+        return self._finished.is_set()
+
+
+# Bound in the worker process by init_session / torn down by shutdown_session.
+_session: Optional[_TrainSession] = None
+
+
+def init_session(args: SessionArgs) -> None:
+    global _session
+    if _session is not None and not _session.finished():
+        raise RuntimeError("a training session is already running in this worker")
+    _session = _TrainSession(args)
+    _session.start()
+
+
+def get_session() -> _TrainSession:
+    if _session is None:
+        raise RuntimeError("no training session in this worker")
+    return _session
+
+
+def shutdown_session() -> None:
+    global _session
+    _session = None

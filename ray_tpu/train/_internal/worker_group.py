@@ -1,0 +1,118 @@
+"""WorkerGroup: a gang of train-worker actors with broadcast execution.
+
+Reference: `python/ray/train/_internal/worker_group.py:92` (`WorkerGroup`),
+`:55` (`RayTrainWorker` — "execute arbitrary functions on a worker"). Workers
+are placed into the trainer's placement group bundles 1:1 so a TPU-slice gang
+lands one worker per TPU host (SURVEY.md §7 step 3).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._internal import session as session_mod
+from ray_tpu.train._internal.session import SessionArgs, TrainingResult
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+class RayTrainWorker:
+    """Actor hosting one training process (one TPU host's worth of chips)."""
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def metadata(self) -> Dict[str, Any]:
+        return {
+            "node_ip": socket.gethostbyname(socket.gethostname()),
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+        }
+
+    def free_port(self) -> int:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    # ------------------------------------------------------- session control
+    def init_session(self, args: SessionArgs) -> None:
+        session_mod.init_session(args)
+
+    def next_result(self) -> TrainingResult:
+        return session_mod.get_session().next_result()
+
+    def session_finished(self) -> bool:
+        return session_mod.get_session().finished()
+
+    def shutdown_session(self) -> None:
+        session_mod.shutdown_session()
+
+
+@dataclass
+class WorkerMetadata:
+    node_ip: str
+    hostname: str
+    pid: int
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Optional[Dict[str, float]] = None,
+        placement_group=None,
+    ):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        res = dict(resources_per_worker or {"CPU": 1.0})
+        opts: Dict[str, Any] = {
+            "num_cpus": res.pop("CPU", 1.0),
+        }
+        if "TPU" in res:
+            opts["num_tpus"] = res.pop("TPU")
+        if res:
+            opts["resources"] = res
+        cls = ray_tpu.remote(RayTrainWorker)
+        self._workers = []
+        for i in range(num_workers):
+            o = dict(opts)
+            if placement_group is not None:
+                o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group=placement_group, placement_group_bundle_index=i
+                )
+            self._workers.append(cls.options(**o).remote())
+        self._metadata: List[WorkerMetadata] = []
+
+    def __len__(self):
+        return len(self._workers)
+
+    @property
+    def workers(self):
+        return list(self._workers)
+
+    def fetch_metadata(self) -> List[WorkerMetadata]:
+        infos = ray_tpu.get([w.metadata.remote() for w in self._workers])
+        self._metadata = [WorkerMetadata(**m) for m in infos]
+        return self._metadata
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.execute.remote(fn, *args, **kwargs) for w in self._workers]
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(self._workers[rank].execute.remote(fn, *args, **kwargs))
+
+    def shutdown(self):
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self._workers = []

@@ -1,0 +1,148 @@
+"""DataParallelTrainer: SPMD training over a gang of worker actors.
+
+Reference: `python/ray/train/data_parallel_trainer.py:56` +
+`training_loop:385`. The driver loop consumes per-round results from the gang
+(`BackendExecutor.get_next_results`), persists rank-0 checkpoints, and
+restarts the whole gang from the last checkpoint on worker failure
+(`FailureConfig.max_failures`, `air/config.py:512`) — gang restarts are
+all-or-nothing because a jax multi-controller program cannot resize
+(SURVEY.md §7 "SPMD gang semantics").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.air import session as air_session
+from ray_tpu.train._internal.backend_executor import BackendExecutor, TrainingWorkerError
+from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.base_trainer import BaseTrainer
+
+
+class DataParallelTrainer(BaseTrainer):
+    _default_backend_config: Callable[[], BackendConfig] = BackendConfig
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[Dict[str, Any]], None],
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+            metadata=metadata,
+        )
+        if not callable(train_loop_per_worker):
+            raise TypeError("train_loop_per_worker must be callable")
+        self._train_fn = train_loop_per_worker
+        self._train_loop_config = dict(train_loop_config or {})
+        self.backend_config = backend_config or type(self)._default_backend_config()
+        self._inside_tune = False
+
+    # ------------------------------------------------------------- data ingest
+    def _dataset_shards(self) -> Optional[List[Dict[str, Any]]]:
+        """Split each provided dataset across workers (Data P18 ingest seam).
+
+        Datasets with `.split(n, equal=)` (ray_tpu.data.Dataset) are split;
+        anything else is replicated to every worker.
+        """
+        if not self.datasets:
+            return None
+        n = self.scaling_config.num_workers
+        shards: List[Dict[str, Any]] = [{} for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "split"):
+                parts = ds.split(n, equal=True)
+                for i in range(n):
+                    shards[i][name] = parts[i]
+            else:
+                for i in range(n):
+                    shards[i][name] = ds
+        return shards
+
+    # ---------------------------------------------------------------- fit loop
+    def _fit_impl(self, trial_info: Optional[Dict[str, str]] = None) -> Result:
+        run_dir = self.run_dir()
+        ckpt_mgr = CheckpointManager(run_dir, self.run_config.checkpoint_config)
+        max_failures = self.run_config.failure_config.max_failures
+        latest_ckpt = self.resume_from_checkpoint
+        last_metrics: Optional[Dict[str, Any]] = None
+        failures = 0
+        tune_session = air_session._get_session() if self._inside_tune else None
+
+        mesh_builder = None
+        if hasattr(self.backend_config, "mesh_builder"):
+            mesh_builder = self.backend_config.mesh_builder(self.scaling_config)
+
+        while True:
+            executor = BackendExecutor(
+                self.backend_config, self.scaling_config, trial_info
+            )
+            try:
+                executor.start()
+                executor.start_training(
+                    self._train_fn,
+                    self._train_loop_config,
+                    checkpoint=latest_ckpt,
+                    dataset_shards=self._dataset_shards(),
+                    mesh_builder=mesh_builder,
+                )
+                while True:
+                    results = executor.get_next_results()
+                    if results is None:
+                        break
+                    rank0 = results[0]
+                    last_metrics = rank0.metrics
+                    ckpt = next(
+                        (r.checkpoint for r in results if r.checkpoint is not None),
+                        None,
+                    )
+                    if ckpt is not None:
+                        latest_ckpt = ckpt_mgr.register(ckpt, rank0.metrics)
+                    if tune_session is not None:
+                        # Forward to Tune so schedulers/search see every report.
+                        tune_session.report(
+                            dict(last_metrics or {}),
+                            checkpoint=ckpt if ckpt is not None else None,
+                        )
+                executor.shutdown()
+                return Result(
+                    metrics=last_metrics,
+                    checkpoint=ckpt_mgr.best_checkpoint(),
+                    error=None,
+                    path=run_dir,
+                    best_checkpoints=ckpt_mgr.best_checkpoints(),
+                )
+            except TrainingWorkerError as e:
+                executor.shutdown()
+                failures += 1
+                if max_failures >= 0 and failures > max_failures:
+                    return Result(
+                        metrics=last_metrics,
+                        checkpoint=ckpt_mgr.best_checkpoint(),
+                        error=e,
+                        path=run_dir,
+                    )
+                # Retry the whole gang from the most recent checkpoint.
+                latest_ckpt = ckpt_mgr.latest_checkpoint or latest_ckpt
+            except BaseException as e:  # driver-side bug: no retry
+                executor.shutdown()
+                return Result(
+                    metrics=last_metrics,
+                    checkpoint=ckpt_mgr.best_checkpoint(),
+                    error=e if isinstance(e, Exception) else RuntimeError(str(e)),
+                    path=run_dir,
+                )

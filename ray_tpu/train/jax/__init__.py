@@ -1,0 +1,17 @@
+"""JAX/TPU training backend — the flagship Train integration.
+
+The analogue of `python/ray/train/torch/` (`torch/config.py:29,69,113,155`):
+where `_TorchBackend.on_start` runs `dist.init_process_group` on every worker,
+`_JaxBackend.on_start` runs `jax.distributed.initialize` — after which the
+worker gang is ONE multi-controller SPMD program: `jax.devices()` is global,
+and the mesh built from `ScalingConfig.mesh` spans every TPU chip of the gang,
+with collectives riding ICI inside the user's jitted step.
+"""
+
+from ray_tpu.train.jax.config import JaxConfig, _JaxBackend
+from ray_tpu.train.jax.jax_trainer import JaxTrainer
+from ray_tpu.air import session as _session
+
+get_mesh = _session.get_mesh
+
+__all__ = ["JaxConfig", "JaxTrainer", "get_mesh"]

@@ -1,0 +1,136 @@
+"""JaxConfig/_JaxBackend: gang-wide jax.distributed bring-up + mesh plumbing.
+
+Reference seam: `python/ray/train/torch/config.py` — `_TorchBackend.on_start`
+(`:155`) runs `_setup_torch_process_group` (`:69`) with rank 0 as master. Here
+rank 0's host:port becomes the jax coordinator; every worker enters
+`jax.distributed.initialize(coordinator, num_processes, process_id)`
+concurrently (it blocks until the full gang joins — the same all-or-nothing
+gang semantics, SURVEY.md §7).
+
+After on_start, each worker's `jax.devices()` spans the whole gang. The mesh
+builder (run inside the session thread) reshapes the global device list into
+the `ScalingConfig.mesh` axes (`MeshSpec`, axis order tensor-innermost so TP
+collectives ride the fastest ICI links).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend import Backend, BackendConfig
+
+
+def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int):
+    import jax
+
+    if num_processes <= 1:
+        return len(jax.devices())
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return len(jax.devices())
+
+
+def _shutdown_jax_distributed():
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+def _build_mesh(mesh_axes: Optional[Dict[str, int]]):
+    """Session-thread mesh builder: global devices -> jax.sharding.Mesh."""
+    import jax
+
+    from ray_tpu.parallel import MeshSpec
+
+    devices = jax.devices()
+    if mesh_axes:
+        spec = MeshSpec.from_dict(mesh_axes)
+        if spec.num_devices != len(devices):
+            raise ValueError(
+                f"ScalingConfig.mesh {mesh_axes} wants {spec.num_devices} devices "
+                f"but the gang has {len(devices)}"
+            )
+    else:
+        spec = MeshSpec.for_data_parallel(len(devices))
+    return spec.build(devices)
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """Backend config for JAX SPMD training.
+
+    distributed: force multi-controller bring-up on/off (default: automatic —
+      on iff the gang has more than one worker).
+    """
+
+    distributed: Optional[bool] = None
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+    def mesh_builder(self, scaling_config: ScalingConfig) -> Callable:
+        spec = scaling_config.mesh_spec()
+        axes = None
+        if spec is not None:
+            from ray_tpu.parallel import AXIS_ORDER
+
+            axes = {a: s for a, s in zip(AXIS_ORDER, spec.shape) if s > 1}
+        return functools.partial(_build_mesh, axes)
+
+
+class _JaxBackend(Backend):
+    def on_start(self, executor, backend_config: JaxConfig):
+        wg = executor.worker_group
+        n = len(wg)
+        distributed = (
+            backend_config.distributed
+            if backend_config.distributed is not None
+            else n > 1
+        )
+        if not distributed:
+            return
+        # Rank 0's node hosts the jax coordination service.
+        rank_of = executor.ranks
+        rank0_index = rank_of.index(0)
+        meta = wg._metadata or wg.fetch_metadata()
+        port = wg.execute_single(rank0_index, _free_port_fn)
+        coordinator = f"{meta[rank0_index].node_ip}:{port}"
+        # All workers must enter initialize() together: fire async, then gather.
+        refs = []
+        for i, w in enumerate(wg.workers):
+            refs.append(
+                w.execute.remote(_init_jax_distributed, coordinator, n, rank_of[i])
+            )
+        device_counts = ray_tpu.get(refs)
+        if len(set(device_counts)) != 1:
+            raise RuntimeError(
+                f"workers disagree on global device count: {device_counts}"
+            )
+
+    def on_shutdown(self, executor, backend_config: JaxConfig):
+        if executor.worker_group is not None:
+            try:
+                executor.worker_group.execute(_shutdown_jax_distributed)
+            except Exception:
+                pass
+
+
+def _free_port_fn() -> int:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -1,0 +1,32 @@
+"""JaxTrainer: DataParallelTrainer with the JAX SPMD backend.
+
+The analogue of `python/ray/train/torch/torch_trainer.py` (`TorchTrainer`),
+re-designed TPU-first: `ScalingConfig(num_workers=H, use_tpu=True,
+mesh={"data": D, "tensor": T, ...})` gang-places one worker per TPU host,
+joins them into one multi-controller program, and hands the user loop a global
+`jax.sharding.Mesh` via `ray_tpu.air.session.get_mesh()`.
+
+Example:
+
+    def train_loop(config):
+        mesh = session.get_mesh()
+        state = create_train_state(cfg, key, opt, mesh=mesh)
+        step = make_train_step(cfg, opt, mesh=mesh)
+        for batch in data:
+            state, metrics = step(state, shard_batch(batch, mesh))
+            session.report({"loss": float(metrics["loss"])})
+
+    trainer = JaxTrainer(
+        train_loop, scaling_config=ScalingConfig(num_workers=4, use_tpu=True)
+    )
+    result = trainer.fit()
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax.config import JaxConfig
+
+
+class JaxTrainer(DataParallelTrainer):
+    _default_backend_config = JaxConfig

@@ -1,0 +1,52 @@
+"""Tune: distributed hyperparameter search over the ray_tpu runtime.
+
+Reference: `python/ray/tune/` (P17 in SURVEY.md §2) — `Tuner`, the trial event
+loop (`execution/trial_runner.py:1181`, `step():1358`), trial executor
+(`execution/ray_trial_executor.py:185`), search spaces (`tune/search/`), and
+schedulers (`tune/schedulers/`: ASHA, PBT, FIFO).
+
+Architecture here: every trial runs its function trainable inside one actor
+(reusing Train's thread-based session for report streaming), and the
+`TrialRunner` multiplexes `next_result` futures across live trials with
+`ray_tpu.wait` — the same actor-substrate design the reference uses, minus
+the legacy class-Trainable RPC surface.
+"""
+
+from ray_tpu.tune.search.sample import (
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.tune_config import TuneConfig
+from ray_tpu.tune.tuner import Tuner
+from ray_tpu.tune.experiment.trial import Trial
+
+# `tune.report` parity alias: inside a function trainable, air session is live.
+from ray_tpu.air.session import report, get_checkpoint
+
+__all__ = [
+    "ResultGrid",
+    "Trial",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "lograndint",
+    "loguniform",
+    "qrandint",
+    "quniform",
+    "randint",
+    "randn",
+    "report",
+    "sample_from",
+    "uniform",
+]
