@@ -1,0 +1,42 @@
+"""ray_tpu.data: block-based distributed datasets executed as tasks.
+
+Reference: `python/ray/data/` (P18 in SURVEY.md §2) — `Datastream`
+(`dataset.py:169`), lazy logical plan (`_internal/logical/`, `planner/`),
+block-parallel execution (`_internal/execution/`), shuffle
+(`push_based_shuffle.py`), and the read API (`read_api.py`).
+
+TPU-first: the native block format is columnar dict-of-numpy (what a jax
+input pipeline wants — contiguous host arrays that `device_put` straight onto
+a mesh), with pandas/pyarrow conversion at the edges. `iter_batches` streams
+with a sliding prefetch window; `split` feeds per-host Train ingest
+(`ray_tpu.air.session.get_dataset_shard`).
+"""
+
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.read_api import (
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A001 - parity with the reference API
+    range_tensor,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+
+Datastream = Dataset  # the reference's short-lived rename (`dataset.py:169`)
+
+__all__ = [
+    "Dataset",
+    "Datastream",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+    "read_text",
+]

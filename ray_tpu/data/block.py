@@ -1,0 +1,131 @@
+"""Block: the unit of distributed data — a columnar dict of numpy arrays.
+
+Reference: `python/ray/data/block.py` (`BlockAccessor`) — but where the
+reference centers on Arrow, the TPU-native format is dict-of-numpy: batches
+come out as contiguous host arrays ready for `jax.device_put` onto a mesh.
+Pandas / Arrow / row dicts convert at the boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+
+
+def _to_numpy_column(values: Sequence[Any]) -> np.ndarray:
+    arr = np.asarray(values)
+    if arr.dtype.kind == "U":
+        arr = np.asarray(values, dtype=object)
+    return arr
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self._b = block
+
+    @staticmethod
+    def from_rows(rows: List[Any]) -> Block:
+        """Rows: dicts (columnar-ized) or scalars (an 'item' column)."""
+        if not rows:
+            return {}
+        if isinstance(rows[0], dict):
+            cols = {k: [] for k in rows[0]}
+            for r in rows:
+                if set(r.keys()) != set(cols.keys()):
+                    raise ValueError(f"inconsistent row schema: {set(r)} vs {set(cols)}")
+                for k, v in r.items():
+                    cols[k].append(v)
+            return {k: _to_numpy_column(v) for k, v in cols.items()}
+        return {"item": _to_numpy_column(rows)}
+
+    @staticmethod
+    def from_pandas(df) -> Block:
+        return {str(c): _to_numpy_column(df[c].to_list()) for c in df.columns}
+
+    @staticmethod
+    def from_arrow(table) -> Block:
+        return {
+            name: _to_numpy_column(col.to_pylist())
+            if col.type.equals(__import__("pyarrow").string())
+            else col.to_numpy(zero_copy_only=False)
+            for name, col in zip(table.column_names, table.columns)
+        }
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b and BlockAccessor(b).num_rows()]
+        if not blocks:
+            return {}
+        keys = blocks[0].keys()
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+    # ----------------------------------------------------------------- queries
+    def num_rows(self) -> int:
+        if not self._b:
+            return 0
+        return len(next(iter(self._b.values())))
+
+    def size_bytes(self) -> int:
+        return sum(a.nbytes for a in self._b.values())
+
+    def schema(self) -> Dict[str, np.dtype]:
+        return {k: v.dtype for k, v in self._b.items()}
+
+    def slice(self, start: int, end: int) -> Block:
+        return {k: v[start:end] for k, v in self._b.items()}
+
+    def take_indices(self, idx: np.ndarray) -> Block:
+        return {k: v[idx] for k, v in self._b.items()}
+
+    # ------------------------------------------------------------- conversions
+    def to_numpy(self) -> Block:
+        return self._b
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame({k: list(v) if v.dtype == object else v
+                             for k, v in self._b.items()})
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        return pa.table({k: pa.array(list(v)) for k, v in self._b.items()})
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        n = self.num_rows()
+        keys = list(self._b.keys())
+        for i in range(n):
+            yield {k: self._b[k][i] for k in keys}
+
+    def to_batch(self, batch_format: str = "numpy"):
+        if batch_format == "numpy":
+            return self._b
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format == "pyarrow":
+            return self.to_arrow()
+        raise ValueError(f"unknown batch_format {batch_format}")
+
+    @staticmethod
+    def from_batch(batch) -> Block:
+        import pandas as pd
+
+        if isinstance(batch, dict):
+            return {k: np.asarray(v) if not isinstance(v, np.ndarray) else v
+                    for k, v in batch.items()}
+        if isinstance(batch, pd.DataFrame):
+            return BlockAccessor.from_pandas(batch)
+        try:
+            import pyarrow as pa
+
+            if isinstance(batch, pa.Table):
+                return BlockAccessor.from_arrow(batch)
+        except ImportError:
+            pass
+        if isinstance(batch, list):
+            return BlockAccessor.from_rows(batch)
+        raise TypeError(f"cannot convert batch of type {type(batch)} to a block")

@@ -1,0 +1,522 @@
+"""Dataset: lazy, block-parallel distributed data.
+
+Reference: `python/ray/data/dataset.py:169` (`Datastream`) with the lazy
+logical plan + operator fusion of `_internal/logical/` and
+`_internal/planner/`: consecutive per-block transforms (map/map_batches/
+filter/flat_map/limit) FUSE into one task per block (one task graph stage),
+while global ops (repartition/random_shuffle/sort/zip) are barriers built
+from scatter/gather tasks — `random_shuffle` is the 2-stage push-based
+pattern of `push_based_shuffle.py`.
+
+Consumption streams: `iter_batches` submits per-block task chains inside a
+sliding prefetch window, so transform execution overlaps consumption (the
+streaming-executor behavior of `_internal/execution/streaming_executor.py:45`).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+
+# --------------------------------------------------------------------- remote ops
+PerBlockOp = Tuple[str, Any]  # ("map_batches", (fn, batch_size, fmt)), ...
+
+
+def _apply_chain(block: Block, chain: List[PerBlockOp]) -> Block:
+    """Run a fused chain of per-block ops over one block (one task)."""
+    acc = BlockAccessor(block)
+    for kind, payload in chain:
+        if kind == "map_batches":
+            fn, batch_size, fmt = payload
+            n = acc.num_rows()
+            outs = []
+            step = batch_size or max(n, 1)
+            for s in range(0, max(n, 1), step):
+                if n == 0:
+                    break
+                batch = BlockAccessor(acc.slice(s, min(s + step, n))).to_batch(fmt)
+                outs.append(BlockAccessor.from_batch(fn(batch)))
+            acc = BlockAccessor(BlockAccessor.concat(outs))
+        elif kind == "map":
+            fn = payload
+            acc = BlockAccessor(
+                BlockAccessor.from_rows([fn(r) for r in acc.iter_rows()])
+            )
+        elif kind == "flat_map":
+            fn = payload
+            rows: List[Any] = []
+            for r in acc.iter_rows():
+                rows.extend(fn(r))
+            acc = BlockAccessor(BlockAccessor.from_rows(rows))
+        elif kind == "filter":
+            fn = payload
+            keep = np.array([bool(fn(r)) for r in acc.iter_rows()], dtype=bool)
+            acc = BlockAccessor(acc.take_indices(np.nonzero(keep)[0]))
+        elif kind == "add_column":
+            name, fn = payload
+            b = dict(acc.to_numpy())
+            b[name] = np.asarray(fn(acc.to_batch("numpy")))
+            acc = BlockAccessor(b)
+        elif kind == "drop_columns":
+            cols = set(payload)
+            acc = BlockAccessor(
+                {k: v for k, v in acc.to_numpy().items() if k not in cols}
+            )
+        elif kind == "select_columns":
+            cols = list(payload)
+            acc = BlockAccessor({k: acc.to_numpy()[k] for k in cols})
+        else:
+            raise ValueError(f"unknown per-block op {kind}")
+    return acc.to_numpy()
+
+
+def _num_rows(block: Block) -> int:
+    return BlockAccessor(block).num_rows()
+
+
+def _slice_block(block: Block, start: int, end: int) -> Block:
+    return BlockAccessor(block).slice(start, end)
+
+
+def _concat_blocks(*blocks: Block) -> Block:
+    return BlockAccessor.concat(list(blocks))
+
+
+def _shuffle_scatter(block: Block, n_out: int, seed: int) -> List[Block]:
+    """Stage 1 of push-based shuffle: randomly bucket this block's rows."""
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, n_out, n)
+    return [acc.take_indices(np.nonzero(assign == j)[0]) for j in range(n_out)]
+
+
+def _shuffle_reduce(seed: int, *pieces: Block) -> Block:
+    """Stage 2: concat this partition's pieces and shuffle locally."""
+    merged = BlockAccessor.concat(list(pieces))
+    acc = BlockAccessor(merged)
+    n = acc.num_rows()
+    rng = np.random.default_rng(seed)
+    return acc.take_indices(rng.permutation(n))
+
+
+def _sort_keys(block: Block, key: str) -> np.ndarray:
+    return np.asarray(block[key]) if block else np.array([])
+
+
+def _sort_scatter(block: Block, key: str, bounds: np.ndarray, descending: bool) -> List[Block]:
+    """Range-partition rows by key against the sampled boundaries."""
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return [acc.to_numpy() for _ in range(len(bounds) + 1)]
+    keys = np.asarray(block[key])
+    part = np.searchsorted(bounds, keys, side="right")
+    out = [acc.take_indices(np.nonzero(part == j)[0]) for j in range(len(bounds) + 1)]
+    return out[::-1] if descending else out
+
+
+def _sort_reduce(key: str, descending: bool, *pieces: Block) -> Block:
+    merged = BlockAccessor.concat(list(pieces))
+    if not merged:
+        return merged
+    order = np.argsort(merged[key], kind="stable")
+    if descending:
+        order = order[::-1]
+    return BlockAccessor(merged).take_indices(order)
+
+
+def _stable_hash(v: Any) -> int:
+    """Process-independent hash (Python's str hash is per-process salted, and
+    scatter tasks for one groupby run in different worker processes)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.md5(repr(v).encode()).digest()[:8], "little"
+    )
+
+
+def _groupby_scatter(block: Block, key: str, n_out: int) -> List[Block]:
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return [acc.to_numpy() for _ in range(n_out)]
+    hashes = np.array([_stable_hash(v) % n_out for v in block[key]])
+    return [acc.take_indices(np.nonzero(hashes == j)[0]) for j in range(n_out)]
+
+
+def _groupby_agg(key: str, aggs: List[Tuple[str, str, str]], *pieces: Block) -> Block:
+    """aggs: [(op, col, out_name)]; op in count/sum/mean/min/max/std."""
+    merged = BlockAccessor.concat(list(pieces))
+    if not merged:
+        return {}
+    keys = merged[key]
+    uniq = sorted(set(keys.tolist()))
+    out: Dict[str, List[Any]] = {key: []}
+    for _, _, out_name in aggs:
+        out[out_name] = []
+    for u in uniq:
+        mask = keys == u
+        out[key].append(u)
+        for op, col, out_name in aggs:
+            vals = merged[col][mask] if col else None
+            if op == "count":
+                out[out_name].append(int(mask.sum()))
+            elif op == "sum":
+                out[out_name].append(vals.sum())
+            elif op == "mean":
+                out[out_name].append(vals.mean())
+            elif op == "min":
+                out[out_name].append(vals.min())
+            elif op == "max":
+                out[out_name].append(vals.max())
+            elif op == "std":
+                out[out_name].append(vals.std(ddof=1) if len(vals) > 1 else 0.0)
+            else:
+                raise ValueError(f"unknown aggregation {op}")
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _zip_blocks(a: Block, b: Block) -> Block:
+    out = dict(a)
+    for k, v in b.items():
+        out[k if k not in out else f"{k}_1"] = v
+    return out
+
+
+_remote_cache: Dict[str, Any] = {}
+
+
+def _remote(fn, num_returns: int = 1):
+    key = f"{fn.__name__}:{num_returns}"
+    if key not in _remote_cache:
+        _remote_cache[key] = ray_tpu.remote(num_returns=num_returns)(fn) if num_returns > 1 else ray_tpu.remote(fn)
+    return _remote_cache[key]
+
+
+# ------------------------------------------------------------------------ Dataset
+class Dataset:
+    """A lazy sequence of blocks + pending per-block op chain."""
+
+    def __init__(self, block_refs: List[Any], ops: Optional[List[PerBlockOp]] = None):
+        self._input_refs = list(block_refs)
+        self._ops = list(ops or [])
+        self._materialized: Optional[List[Any]] = None if self._ops else list(block_refs)
+
+    # ------------------------------------------------------------- construction
+    def _derive(self, op: PerBlockOp) -> "Dataset":
+        return Dataset(self._input_refs, self._ops + [op])
+
+    # ------------------------------------------------------------ transformations
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = 4096,
+        batch_format: str = "numpy",
+    ) -> "Dataset":
+        return self._derive(("map_batches", (fn, batch_size, batch_format)))
+
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
+        return self._derive(("map", fn))
+
+    def flat_map(self, fn: Callable[[Dict[str, Any]], List[Dict[str, Any]]]) -> "Dataset":
+        return self._derive(("flat_map", fn))
+
+    def filter(self, fn: Callable[[Dict[str, Any]], bool]) -> "Dataset":
+        return self._derive(("filter", fn))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        return self._derive(("add_column", (name, fn)))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._derive(("drop_columns", cols))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._derive(("select_columns", cols))
+
+    # ------------------------------------------------------------- execution
+    def _execute(self) -> List[Any]:
+        if self._materialized is None:
+            chain = self._ops
+            apply_remote = _remote(_apply_chain)
+            self._materialized = [
+                apply_remote.remote(ref, chain) for ref in self._input_refs
+            ]
+        return self._materialized
+
+    def materialize(self) -> "Dataset":
+        refs = self._execute()
+        return Dataset(refs)
+
+    def num_blocks(self) -> int:
+        return len(self._input_refs)
+
+    # ------------------------------------------------------------- global ops
+    def repartition(self, num_blocks: int) -> "Dataset":
+        refs = self._execute()
+        sizes = ray_tpu.get([_remote(_num_rows).remote(r) for r in refs])
+        total = sum(sizes)
+        target = [total // num_blocks + (1 if i < total % num_blocks else 0)
+                  for i in range(num_blocks)]
+        # Build slices: walk input blocks, carve off target-sized output blocks.
+        out_refs = []
+        cur_block, cur_off = 0, 0
+        slice_remote, concat_remote = _remote(_slice_block), _remote(_concat_blocks)
+        for tgt in target:
+            pieces = []
+            need = tgt
+            while need > 0 and cur_block < len(refs):
+                avail = sizes[cur_block] - cur_off
+                take = min(avail, need)
+                if take > 0:
+                    pieces.append(
+                        slice_remote.remote(refs[cur_block], cur_off, cur_off + take)
+                    )
+                cur_off += take
+                need -= take
+                if cur_off >= sizes[cur_block]:
+                    cur_block += 1
+                    cur_off = 0
+            out_refs.append(
+                pieces[0] if len(pieces) == 1 else concat_remote.remote(*pieces)
+            )
+        return Dataset(out_refs)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        refs = self._execute()
+        n = len(refs)
+        if n == 0:
+            return Dataset([])
+        base = seed if seed is not None else np.random.randint(0, 2**31)
+        scatter = _remote(_shuffle_scatter, num_returns=n)
+        pieces = []  # pieces[i][j] = piece of input i destined for output j
+        for i, r in enumerate(refs):
+            got = scatter.options(num_returns=n).remote(r, n, base + i)
+            pieces.append(got if isinstance(got, list) else [got])
+        reduce_remote = _remote(_shuffle_reduce)
+        out = [
+            reduce_remote.remote(base + 7919 + j, *[pieces[i][j] for i in range(n)])
+            for j in range(n)
+        ]
+        return Dataset(out)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        refs = self._execute()
+        n = len(refs)
+        if n == 0:
+            return Dataset([])
+        # Sample keys to pick n-1 range boundaries (sample sort).
+        keys = ray_tpu.get([_remote(_sort_keys).remote(r, key) for r in refs])
+        allk = np.sort(np.concatenate([k for k in keys if len(k)]))
+        if len(allk) == 0:
+            return Dataset(refs)
+        bounds = allk[[int(len(allk) * (i + 1) / n) - 1 for i in range(n - 1)]] if n > 1 else np.array([])
+        scatter = _remote(_sort_scatter, num_returns=n)
+        pieces = [
+            scatter.options(num_returns=n).remote(r, key, bounds, descending)
+            if n > 1 else [r]
+            for r in refs
+        ]
+        if n == 1:
+            return Dataset([_remote(_sort_reduce).remote(key, descending, refs[0])])
+        reduce_remote = _remote(_sort_reduce)
+        out = [
+            reduce_remote.remote(key, descending, *[pieces[i][j] for i in range(n)])
+            for j in range(n)
+        ]
+        return Dataset(out)
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = self._execute()
+        for o in others:
+            refs = refs + o._execute()
+        return Dataset(refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        a = self.repartition(self.num_blocks())._execute()
+        b = other.repartition(self.num_blocks())._execute()
+        if len(a) != len(b):
+            raise ValueError("zip requires equal block counts after repartition")
+        z = _remote(_zip_blocks)
+        return Dataset([z.remote(x, y) for x, y in zip(a, b)])
+
+    def limit(self, n: int) -> "Dataset":
+        refs = self._execute()
+        sizes = ray_tpu.get([_remote(_num_rows).remote(r) for r in refs])
+        out, got = [], 0
+        slice_remote = _remote(_slice_block)
+        for r, s in zip(refs, sizes):
+            if got >= n:
+                break
+            take = min(s, n - got)
+            out.append(r if take == s else slice_remote.remote(r, 0, take))
+            got += take
+        return Dataset(out)
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        if equal:
+            total = self.count()
+            per = total // n  # equal split truncates the remainder (reference)
+            # Repartition to n even blocks, then trim each to exactly `per` rows.
+            parts = self.repartition(n)._execute()
+            slice_remote = _remote(_slice_block)
+            return [
+                Dataset([slice_remote.remote(parts[i], 0, per)]) for i in range(n)
+            ]
+        refs = self._execute()
+        out: List[List[Any]] = [[] for _ in range(n)]
+        for i, r in enumerate(refs):
+            out[i % n].append(r)
+        return [Dataset(rs) for rs in out]
+
+    # ------------------------------------------------------------- consumption
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        prefetch_blocks: int = 2,
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        """Streaming iteration: per-block task chains are submitted a window
+        ahead of consumption; leftover rows carry across block boundaries."""
+        chain = self._ops
+        apply_remote = _remote(_apply_chain)
+        pending = list(
+            self._materialized if self._materialized is not None else self._input_refs
+        )
+        window: List[Any] = []
+        carry: List[Block] = []
+        carry_rows = 0
+
+        def submit_next():
+            if pending:
+                ref = pending.pop(0)
+                window.append(
+                    ref if self._materialized is not None
+                    else apply_remote.remote(ref, chain)
+                )
+
+        for _ in range(max(prefetch_blocks, 1)):
+            submit_next()
+        while window:
+            block = ray_tpu.get(window.pop(0))
+            submit_next()
+            carry.append(block)
+            carry_rows += BlockAccessor(block).num_rows()
+            step = batch_size or carry_rows
+            while step and carry_rows >= step:
+                merged = BlockAccessor.concat(carry)
+                acc = BlockAccessor(merged)
+                yield BlockAccessor(acc.slice(0, step)).to_batch(batch_format)
+                rest = acc.slice(step, acc.num_rows())
+                carry = [rest]
+                carry_rows = BlockAccessor(rest).num_rows()
+        if carry_rows and not drop_last:
+            merged = BlockAccessor.concat(carry)
+            if BlockAccessor(merged).num_rows():
+                yield BlockAccessor(merged).to_batch(batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for batch in self.iter_batches(batch_size=None):
+            yield from BlockAccessor(batch).iter_rows()
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        return list(itertools.islice(self.iter_rows(), n))
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        refs = self._execute()
+        return sum(ray_tpu.get([_remote(_num_rows).remote(r) for r in refs]))
+
+    def schema(self) -> Optional[Dict[str, Any]]:
+        for r in self._execute():
+            b = ray_tpu.get(r)
+            if b:
+                return BlockAccessor(b).schema()
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        return list(s.keys()) if s else None
+
+    def to_pandas(self):
+        import pandas as pd
+
+        dfs = [
+            BlockAccessor(b).to_pandas()
+            for b in ray_tpu.get(self._execute())
+            if BlockAccessor(b).num_rows()
+        ]
+        return pd.concat(dfs, ignore_index=True) if dfs else pd.DataFrame()
+
+    def sum(self, on: str) -> float:
+        tot = 0.0
+        for batch in self.iter_batches(batch_size=None):
+            if on in batch:
+                tot += batch[on].sum()
+        return tot
+
+    def min(self, on: str):
+        return min(b[on].min() for b in self.iter_batches(batch_size=None) if on in b)
+
+    def max(self, on: str):
+        return max(b[on].max() for b in self.iter_batches(batch_size=None) if on in b)
+
+    def mean(self, on: str) -> float:
+        n = self.count()
+        return self.sum(on) / n if n else float("nan")
+
+    def __repr__(self):
+        ops = " -> ".join(k for k, _ in self._ops) or "materialized"
+        return f"Dataset(blocks={len(self._input_refs)}, plan={ops})"
+
+
+class GroupedData:
+    """Hash-partitioned groupby (reference: `data/grouped_data.py`)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _aggregate(self, aggs: List[Tuple[str, str, str]]) -> Dataset:
+        refs = self._ds._execute()
+        n = max(len(refs), 1)
+        scatter = _remote(_groupby_scatter, num_returns=n)
+        pieces = [
+            scatter.options(num_returns=n).remote(r, self._key, n) if n > 1 else [r]
+            for r in refs
+        ]
+        agg_remote = _remote(_groupby_agg)
+        out = [
+            agg_remote.remote(self._key, aggs, *[pieces[i][j] for i in range(len(refs))])
+            for j in range(n)
+        ]
+        return Dataset(out)
+
+    def count(self) -> Dataset:
+        return self._aggregate([("count", None, "count()")])
+
+    def sum(self, on: str) -> Dataset:
+        return self._aggregate([("sum", on, f"sum({on})")])
+
+    def mean(self, on: str) -> Dataset:
+        return self._aggregate([("mean", on, f"mean({on})")])
+
+    def min(self, on: str) -> Dataset:
+        return self._aggregate([("min", on, f"min({on})")])
+
+    def max(self, on: str) -> Dataset:
+        return self._aggregate([("max", on, f"max({on})")])
+
+    def std(self, on: str) -> Dataset:
+        return self._aggregate([("std", on, f"std({on})")])

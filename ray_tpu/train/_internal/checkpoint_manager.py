@@ -63,12 +63,14 @@ class CheckpointManager:
                 victim = 0  # FIFO: oldest goes first
             else:
                 # Drop the worst-scoring; never drop the most recent (resume).
+                # A checkpoint missing the score attribute counts as worst, so
+                # unscored checkpoints are pruned before any scored one.
                 order = self.config.checkpoint_score_order
                 candidates = list(enumerate(self._kept[:-1]))
                 victim = (
-                    min(candidates, key=lambda kv: kv[1][1].get(attr, float("inf")))
+                    min(candidates, key=lambda kv: kv[1][1].get(attr, float("-inf")))
                     if order == "max"
-                    else max(candidates, key=lambda kv: kv[1][1].get(attr, float("-inf")))
+                    else max(candidates, key=lambda kv: kv[1][1].get(attr, float("inf")))
                 )[0]
             path, _ = self._kept.pop(victim)
             shutil.rmtree(path, ignore_errors=True)

@@ -32,13 +32,6 @@ class RayTrainWorker:
             "pid": os.getpid(),
         }
 
-    def free_port(self) -> int:
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
-
     # ------------------------------------------------------- session control
     def init_session(self, args: SessionArgs) -> None:
         session_mod.init_session(args)

@@ -75,7 +75,10 @@ class DataParallelTrainer(BaseTrainer):
 
     # ---------------------------------------------------------------- fit loop
     def _fit_impl(self, trial_info: Optional[Dict[str, str]] = None) -> Result:
-        run_dir = self.run_dir()
+        # Inside a Tune sweep each trial must checkpoint into its own trial
+        # directory, never the shared trainer run_dir (concurrent trials would
+        # overwrite/prune each other's checkpoint_NNNNNN entries).
+        run_dir = (trial_info or {}).get("trial_dir") or self.run_dir()
         ckpt_mgr = CheckpointManager(run_dir, self.run_config.checkpoint_config)
         max_failures = self.run_config.failure_config.max_failures
         latest_ckpt = self.resume_from_checkpoint
@@ -140,6 +143,8 @@ class DataParallelTrainer(BaseTrainer):
                 latest_ckpt = ckpt_mgr.latest_checkpoint or latest_ckpt
             except BaseException as e:  # driver-side bug: no retry
                 executor.shutdown()
+                if not isinstance(e, Exception):
+                    raise  # KeyboardInterrupt/SystemExit must propagate
                 return Result(
                     metrics=last_metrics,
                     checkpoint=ckpt_mgr.best_checkpoint(),

@@ -1,0 +1,174 @@
+"""The Tune event loop: multiplexes live trials, applies scheduler decisions.
+
+Reference: `python/ray/tune/execution/trial_runner.py:1181` (`TrialRunner`,
+event loop `step():1358`) + `ray_trial_executor.py:185`. Each trial's function
+trainable runs inside one actor (Train's thread-session streams its reports);
+the loop waits on the outstanding `next_result` futures of all running trials
+(`ray_tpu.wait`), so a slow trial never blocks a fast one — the property
+ASHA's asynchronous pruning depends on.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.result import Result
+from ray_tpu.train._internal.session import DONE, ERROR, REPORT, SessionArgs
+from ray_tpu.train._internal.worker_group import RayTrainWorker
+from ray_tpu.tune.experiment import trial as trial_mod
+from ray_tpu.tune.experiment.trial import Trial
+from ray_tpu.tune.schedulers.trial_scheduler import (
+    CONTINUE,
+    RESTART,
+    STOP,
+    FIFOScheduler,
+    TrialScheduler,
+)
+
+
+class TrialRunner:
+    def __init__(
+        self,
+        train_fn: Callable[[Dict[str, Any]], None],
+        trials: List[Trial],
+        scheduler: Optional[TrialScheduler] = None,
+        max_concurrent: Optional[int] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        stop: Optional[Dict[str, float]] = None,
+        experiment_name: str = "",
+    ):
+        self._train_fn = train_fn
+        self.trials = trials
+        self._scheduler = scheduler or FIFOScheduler()
+        self._max_concurrent = max_concurrent or 8
+        self._resources = dict(resources_per_trial or {"CPU": 1.0})
+        self._stop = dict(stop or {})
+        self._experiment_name = experiment_name
+        self._actors: Dict[str, Any] = {}  # trial_id -> actor handle
+        self._refs: Dict[Any, Trial] = {}  # outstanding next_result ref -> trial
+        for t in trials:
+            self._scheduler.on_trial_add(self, t)
+
+    # ------------------------------------------------------------------ launch
+    def _actor_options(self) -> Dict[str, Any]:
+        res = dict(self._resources)
+        opts: Dict[str, Any] = {"num_cpus": res.pop("CPU", 1.0)}
+        if "TPU" in res:
+            opts["num_tpus"] = res.pop("TPU")
+        if res:
+            opts["resources"] = res
+        return opts
+
+    def _launch(self, trial: Trial) -> None:
+        actor = ray_tpu.remote(RayTrainWorker).options(**self._actor_options()).remote()
+        args = SessionArgs(
+            train_fn=self._train_fn,
+            config=dict(trial.config),
+            world_rank=0,
+            world_size=1,
+            local_rank=0,
+            local_world_size=1,
+            node_rank=0,
+            trial_name=trial.name,
+            trial_id=trial.trial_id,
+            trial_dir=trial.local_dir,
+            experiment_name=self._experiment_name,
+            checkpoint=trial.restore_checkpoint or trial.checkpoint,
+        )
+        ray_tpu.get(actor.init_session.remote(args))
+        trial.restore_checkpoint = None
+        trial.status = trial_mod.RUNNING
+        self._actors[trial.trial_id] = actor
+        self._refs[actor.next_result.remote()] = trial
+
+    def _teardown(self, trial: Trial) -> None:
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is not None:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        for ref, t in list(self._refs.items()):
+            if t is trial:
+                del self._refs[ref]
+
+    # -------------------------------------------------------------------- run
+    def run(self) -> None:
+        pending = [t for t in self.trials if t.status == trial_mod.PENDING]
+        while pending or self._refs:
+            while pending and len(self._actors) < self._max_concurrent:
+                self._launch(pending.pop(0))
+            if not self._refs:
+                continue
+            ready, _ = ray_tpu.wait(
+                list(self._refs.keys()), num_returns=1, timeout=5.0
+            )
+            for ref in ready:
+                trial = self._refs.pop(ref)
+                try:
+                    tr = ray_tpu.get(ref)
+                except Exception as e:  # actor died
+                    trial.status = trial_mod.ERROR
+                    trial.error = str(e)
+                    self._teardown(trial)
+                    self._scheduler.on_trial_complete(self, trial)
+                    continue
+                if tr.type == ERROR:
+                    trial.status = trial_mod.ERROR
+                    trial.error = tr.error
+                    self._teardown(trial)
+                    self._scheduler.on_trial_complete(self, trial)
+                elif tr.type == DONE:
+                    trial.status = trial_mod.TERMINATED
+                    self._teardown(trial)
+                    self._scheduler.on_trial_complete(self, trial)
+                else:  # REPORT
+                    trial.num_results += 1
+                    metrics = dict(tr.metrics or {})
+                    metrics.setdefault("training_iteration", trial.num_results)
+                    metrics.setdefault("trial_id", trial.trial_id)
+                    metrics["config"] = dict(trial.config)
+                    trial.last_result = metrics
+                    if tr.checkpoint is not None:
+                        trial.checkpoint_manager.register(tr.checkpoint, metrics)
+                    if self._should_stop(metrics):
+                        decision = STOP
+                    else:
+                        decision = self._scheduler.on_trial_result(self, trial, metrics)
+                    if decision == STOP:
+                        trial.status = trial_mod.TERMINATED
+                        self._teardown(trial)
+                        self._scheduler.on_trial_complete(self, trial)
+                    elif decision == RESTART:
+                        trial.restarts += 1
+                        self._teardown(trial)
+                        self._launch(trial)
+                    else:
+                        actor = self._actors[trial.trial_id]
+                        self._refs[actor.next_result.remote()] = trial
+
+    def _should_stop(self, metrics: Dict[str, Any]) -> bool:
+        for k, v in self._stop.items():
+            if k in metrics and metrics[k] >= v:
+                return True
+        return False
+
+    # ----------------------------------------------------------------- results
+    def results(self) -> List[Result]:
+        out = []
+        for t in self.trials:
+            err = None
+            if t.status == trial_mod.ERROR:
+                err = RuntimeError(t.error or "trial failed")
+            out.append(
+                Result(
+                    metrics=t.last_result,
+                    checkpoint=t.checkpoint_manager.best_checkpoint(),
+                    error=err,
+                    path=t.local_dir,
+                    best_checkpoints=t.checkpoint_manager.best_checkpoints(),
+                )
+            )
+        return out

@@ -1,0 +1,3 @@
+from ray_tpu.tune.experiment.trial import Trial
+
+__all__ = ["Trial"]

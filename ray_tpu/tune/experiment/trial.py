@@ -1,0 +1,57 @@
+"""Trial: one hyperparameter configuration's lifecycle record.
+
+Reference: `python/ray/tune/experiment/trial.py` — status machine
+(PENDING/RUNNING/PAUSED/TERMINATED/ERROR), per-trial directory, last result,
+and checkpoint bookkeeping.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    def __init__(self, config: Dict[str, Any], experiment_dir: str, index: int,
+                 experiment_name: str = ""):
+        self.trial_id = f"{uuid.uuid4().hex[:8]}"
+        self.index = index
+        self.config = config
+        self.experiment_name = experiment_name
+        self.name = f"trial_{index:04d}_{self.trial_id}"
+        self.local_dir = os.path.join(experiment_dir, self.name)
+        os.makedirs(self.local_dir, exist_ok=True)
+        self.status = PENDING
+        self.last_result: Optional[Dict[str, Any]] = None
+        self.error: Optional[str] = None
+        self.num_results = 0
+        self.restarts = 0
+        self.checkpoint_manager = CheckpointManager(self.local_dir)
+        # Set when (re)starting with a donor checkpoint (PBT exploit / resume).
+        self.restore_checkpoint: Optional[Checkpoint] = None
+
+    @property
+    def checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint_manager.latest_checkpoint
+
+    def metric(self, name: str, default: float = float("nan")) -> float:
+        if not self.last_result:
+            return default
+        v = self.last_result.get(name, default)
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return default
+
+    def __repr__(self):
+        return f"Trial({self.name}, {self.status}, results={self.num_results})"

@@ -1,0 +1,16 @@
+from ray_tpu.tune.schedulers.trial_scheduler import (
+    FIFOScheduler,
+    TrialScheduler,
+)
+from ray_tpu.tune.schedulers.async_hyperband import AsyncHyperBandScheduler
+from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "FIFOScheduler",
+    "PopulationBasedTraining",
+    "TrialScheduler",
+]

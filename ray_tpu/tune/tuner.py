@@ -1,0 +1,91 @@
+"""Tuner: the user-facing sweep API.
+
+Reference: `python/ray/tune/tuner.py` (`Tuner(trainable, param_space,
+tune_config, run_config)`, `.fit() -> ResultGrid`). Accepts a plain function
+trainable `fn(config)` (reporting via `ray_tpu.air.session.report`) or a
+`BaseTrainer` (its `as_trainable()`; `param_space["train_loop_config"]`
+overrides the trainer's loop config per trial — the reference's Trainer+Tuner
+composition, `base_trainer.py:557`).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Union
+
+from ray_tpu.air.config import RunConfig
+from ray_tpu.train.base_trainer import BaseTrainer, default_storage_path
+from ray_tpu.tune.execution.trial_runner import TrialRunner
+from ray_tpu.tune.experiment.trial import Trial
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.tune_config import TuneConfig
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Union[Callable[[Dict[str, Any]], None], BaseTrainer],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def _resolve_trainable(self) -> Callable[[Dict[str, Any]], None]:
+        if isinstance(self._trainable, BaseTrainer):
+            return self._trainable.as_trainable()
+        if callable(self._trainable):
+            return self._trainable
+        raise TypeError(f"invalid trainable: {type(self._trainable)}")
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+        from ray_tpu._private.worker import _auto_init
+
+        _auto_init()
+        name = self.run_config.name or f"tune_{int(time.time())}"
+        base = self.run_config.storage_path or default_storage_path()
+        experiment_dir = os.path.join(os.path.expanduser(base), name)
+        os.makedirs(experiment_dir, exist_ok=True)
+
+        gen = BasicVariantGenerator(seed=self.tune_config.search_seed)
+        configs = list(
+            gen.generate(self._param_space, self.tune_config.num_samples)
+        )
+        if not configs:
+            configs = [{}]
+        trials = [
+            Trial(cfg, experiment_dir, i, experiment_name=name)
+            for i, cfg in enumerate(configs)
+        ]
+
+        scheduler = self.tune_config.scheduler
+        if scheduler is not None and hasattr(scheduler, "set_objective"):
+            scheduler.set_objective(self.tune_config.metric, self.tune_config.mode)
+
+        max_conc = self.tune_config.max_concurrent_trials
+        if max_conc is None:
+            # Don't oversubscribe: bound by what the cluster can actually run.
+            cpus = ray_tpu.cluster_resources().get("CPU", 1.0)
+            per_trial = self.tune_config.resources_per_trial.get("CPU", 1.0) or 1.0
+            max_conc = max(1, int(cpus / per_trial))
+
+        runner = TrialRunner(
+            self._resolve_trainable(),
+            trials,
+            scheduler=scheduler,
+            max_concurrent=max_conc,
+            resources_per_trial=self.tune_config.resources_per_trial,
+            stop=self.run_config.stop,
+            experiment_name=name,
+        )
+        runner.run()
+        return ResultGrid(
+            runner.results(), metric=self.tune_config.metric, mode=self.tune_config.mode
+        )
