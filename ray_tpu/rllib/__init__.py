@@ -1,0 +1,32 @@
+"""ray_tpu.rllib: reinforcement learning on the actor substrate, JAX-native.
+
+Reference: `rllib/` (P20 in SURVEY.md §2) — `Algorithm(Trainable)`
+(`algorithms/algorithm.py:149`, `training_step:1336`), sampling workers
+(`evaluation/rollout_worker.py:166`), and the new Learner stack
+(`core/learner/learner.py:100`, `learner_group.py:48`, `core/rl_module/`).
+
+TPU-first: where the reference's `TorchLearner` wraps modules in DDP for grad
+sync (`torch_learner.py:143-194`), `JaxLearner`'s update is ONE jitted SPMD
+function over a device mesh — grads sync via the mesh's data axis inside XLA
+(psum over ICI), not an external DDP hook. Sampling stays on CPU actors
+(vectorized gymnasium envs); only the learner touches accelerator devices.
+"""
+
+from ray_tpu.rllib.core.rl_module import MLPModule, RLModule
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.env.env_runner import EnvRunner
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "EnvRunner",
+    "JaxLearner",
+    "LearnerGroup",
+    "MLPModule",
+    "PPO",
+    "PPOConfig",
+    "RLModule",
+]

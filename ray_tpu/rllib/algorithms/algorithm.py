@@ -1,0 +1,176 @@
+"""Algorithm + AlgorithmConfig: the RLlib training driver.
+
+Reference: `rllib/algorithms/algorithm.py:149` (`Algorithm(Trainable)`,
+`training_step:1336`) and `algorithm_config.py` (fluent config:
+`.environment().training().env_runners().resources()`). `train()` runs one
+iteration: sync weights -> parallel sampling on EnvRunner actors -> learner
+update(s) -> aggregated metrics.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+
+class AlgorithmConfig:
+    def __init__(self):
+        self.env: Union[str, Callable, None] = None
+        self.env_config: Dict[str, Any] = {}
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 512
+        self.seed = 0
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_fragment_length = 64
+        self.num_learners = 0  # 0 = local learner in the driver process
+        self.model: Dict[str, Any] = {"hiddens": (64, 64)}
+        self.framework_str = "jax"
+
+    # ------------------------------------------------------------ fluent API
+    def environment(self, env=None, *, env_config: Optional[dict] = None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option '{k}'")
+            setattr(self, k, v)
+        return self
+
+    def env_runners(
+        self,
+        num_env_runners: Optional[int] = None,
+        num_envs_per_runner: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_runner is not None:
+            self.num_envs_per_runner = num_envs_per_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def learners(self, num_learners: Optional[int] = None) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def framework(self, framework: str) -> "AlgorithmConfig":
+        if framework != "jax":
+            raise ValueError("this build is jax-native; framework must be 'jax'")
+        self.framework_str = framework
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        algo_cls = getattr(self, "_algo_cls", None) or Algorithm
+        return algo_cls(self.copy())
+
+    def env_creator(self) -> Callable[[], Any]:
+        env, cfg = self.env, self.env_config
+        if callable(env):
+            return lambda: env(cfg) if cfg else env()
+        if isinstance(env, str):
+            import gymnasium as gym
+
+            return lambda: gym.make(env, **cfg)
+        raise ValueError("config.environment(env=...) is required")
+
+
+class Algorithm:
+    """Base driver; subclasses implement make_loss() + training_step()."""
+
+    def __init__(self, config: AlgorithmConfig):
+        import gymnasium as gym
+
+        from ray_tpu.rllib.core.learner_group import LearnerGroup
+        from ray_tpu.rllib.core.rl_module import MLPModule
+        from ray_tpu.rllib.env.env_runner import EnvRunner
+        import ray_tpu
+
+        self.config = config
+        self.iteration = 0
+        creator = config.env_creator()
+        probe = creator()
+        obs_space, act_space = probe.observation_space, probe.action_space
+        probe.close()
+        if not isinstance(act_space, gym.spaces.Discrete):
+            raise NotImplementedError("round-1 supports Discrete action spaces")
+        self.module = MLPModule(
+            int(np.prod(obs_space.shape)),
+            int(act_space.n),
+            hiddens=tuple(config.model.get("hiddens", (64, 64))),
+        )
+        self.learner_group = LearnerGroup(
+            self.module,
+            self.make_loss(),
+            num_learners=config.num_learners,
+            learning_rate=config.lr,
+            seed=config.seed,
+        )
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.env_runners: List[Any] = [
+            runner_cls.options(num_cpus=1).remote(
+                creator,
+                self.module,
+                num_envs=config.num_envs_per_runner,
+                rollout_length=config.rollout_fragment_length,
+                seed=config.seed + 1000 * (i + 1),
+                gamma=config.gamma,
+            )
+            for i in range(config.num_env_runners)
+        ]
+
+    # -------------------------------------------------------------- interface
+    def make_loss(self) -> Callable:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        self.iteration += 1
+        metrics = self.training_step()
+        metrics["training_iteration"] = self.iteration
+        metrics["time_this_iter_s"] = time.time() - t0
+        return metrics
+
+    # ------------------------------------------------------------ checkpoints
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algo_state.pkl"), "wb") as fh:
+            pickle.dump(
+                {"iteration": self.iteration, "learner": self.learner_group.state()},
+                fh,
+            )
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(os.path.join(path, "algo_state.pkl"), "rb") as fh:
+            state = pickle.load(fh)
+        self.iteration = state["iteration"]
+        self.learner_group.load_state(state["learner"])
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self.env_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,130 @@
+"""LearnerGroup: local learner or a gang of remote learner actors.
+
+Reference: `rllib/core/learner/learner_group.py:48-51` — "local or N remote
+learners". Remote mode shards each update batch across learner actors; grad
+sync is all-or-nothing weight averaging after each round (equivalent to
+gradient averaging for equal shard sizes under the same optimizer state
+trajectory — each learner applies the SAME averaged update because weights
+are re-broadcast every round).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.rl_module import RLModule
+
+
+class _RemoteLearner:
+    """Actor wrapping one JaxLearner (one host / one chip set)."""
+
+    def __init__(self, module, loss_fn, learning_rate: float, seed: int):
+        self.learner = JaxLearner(
+            module, loss_fn, learning_rate=learning_rate, seed=seed
+        )
+
+    def update(self, batch):
+        return self.learner.update(batch)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, w):
+        self.learner.set_weights(w)
+
+    def state(self):
+        return self.learner.state()
+
+    def load_state(self, s):
+        self.learner.load_state(s)
+
+
+class LearnerGroup:
+    def __init__(
+        self,
+        module: RLModule,
+        loss_fn: Callable,
+        *,
+        num_learners: int = 0,
+        learning_rate: float = 3e-4,
+        mesh=None,
+        seed: int = 0,
+    ):
+        self._num = num_learners
+        if num_learners == 0:
+            self._local = JaxLearner(
+                module, loss_fn, learning_rate=learning_rate, mesh=mesh, seed=seed
+            )
+            self._remote: List = []
+        else:
+            import ray_tpu
+
+            self._local = None
+            cls = ray_tpu.remote(_RemoteLearner)
+            self._remote = [
+                cls.options(num_cpus=1).remote(module, loss_fn, learning_rate, seed)
+                for _ in range(num_learners)
+            ]
+
+    @property
+    def is_local(self) -> bool:
+        return self._local is not None
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self._local is not None:
+            return self._local.update(batch)
+        import ray_tpu
+
+        n = len(self._remote)
+        size = len(next(iter(batch.values())))
+        per = size // n
+        shards = [
+            {k: v[i * per:(i + 1) * per] for k, v in batch.items()} for i in range(n)
+        ]
+        metrics = ray_tpu.get(
+            [lr.update.remote(s) for lr, s in zip(self._remote, shards)]
+        )
+        # Weight-average sync: every learner ends the round with identical
+        # weights (the DDP-equivalence described in the module docstring).
+        weights = ray_tpu.get([lr.get_weights.remote() for lr in self._remote])
+        import jax
+
+        avg = jax.tree.map(lambda *xs: np.mean(np.stack(xs), axis=0), *weights)
+        ray_tpu.get([lr.set_weights.remote(avg) for lr in self._remote])
+        out: Dict[str, float] = {}
+        for k in metrics[0]:
+            out[k] = float(np.mean([m[k] for m in metrics]))
+        return out
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        import ray_tpu
+
+        return ray_tpu.get(self._remote[0].get_weights.remote())
+
+    def set_weights(self, w) -> None:
+        if self._local is not None:
+            self._local.set_weights(w)
+        else:
+            import ray_tpu
+
+            ray_tpu.get([lr.set_weights.remote(w) for lr in self._remote])
+
+    def state(self):
+        if self._local is not None:
+            return self._local.state()
+        import ray_tpu
+
+        return ray_tpu.get(self._remote[0].state.remote())
+
+    def load_state(self, s) -> None:
+        if self._local is not None:
+            self._local.load_state(s)
+        else:
+            import ray_tpu
+
+            ray_tpu.get([lr.load_state.remote(s) for lr in self._remote])

@@ -1,0 +1,88 @@
+"""RLModule: the neural-net interface of the new RLlib stack, as jax pytrees.
+
+Reference: `rllib/core/rl_module/rl_module.py` — a module exposes
+forward_exploration / forward_inference / forward_train. Here a module is a
+pair (init_params, pure apply fns) over jax pytrees so the learner can jit,
+grad, and shard it freely; `MLPModule` is the default policy+value net
+(the analogue of `rllib/models/jax/fcnet.py`, the reference's only jax net).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import numpy as np
+
+
+class RLModule:
+    """Interface: subclasses define init(key) -> params and pure forwards."""
+
+    def init(self, key) -> Any:
+        raise NotImplementedError
+
+    def forward(self, params, obs):
+        """Returns (action_logits, value_estimate)."""
+        raise NotImplementedError
+
+    def action_dist(self, params, obs, key, explore: bool = True):
+        """Sample actions + logp under the current policy (jit-safe)."""
+        import jax
+        import jax.numpy as jnp
+
+        logits, value = self.forward(params, obs)
+        if explore:
+            action = jax.random.categorical(key, logits, axis=-1)
+        else:
+            action = jnp.argmax(logits, axis=-1)
+        logp = jax.nn.log_softmax(logits)
+        act_logp = jnp.take_along_axis(logp, action[..., None], axis=-1)[..., 0]
+        return action, act_logp, value
+
+
+class MLPModule(RLModule):
+    """Policy + value MLP with shared-nothing towers (categorical actions)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        def tower(key, sizes):
+            layers = []
+            for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
+                key, sub = jax.random.split(key)
+                scale = jnp.sqrt(2.0 / m)
+                layers.append(
+                    {
+                        "w": jax.random.normal(sub, (m, n), jnp.float32) * scale,
+                        "b": jnp.zeros((n,), jnp.float32),
+                    }
+                )
+            return layers
+
+        kp, kv = jax.random.split(key)
+        pi_sizes = (self.obs_dim, *self.hiddens, self.num_actions)
+        vf_sizes = (self.obs_dim, *self.hiddens, 1)
+        params = {"pi": tower(kp, pi_sizes), "vf": tower(kv, vf_sizes)}
+        # Near-zero policy head -> near-uniform initial policy (PPO-friendly).
+        params["pi"][-1]["w"] = params["pi"][-1]["w"] * 0.01
+        return params
+
+    def forward(self, params, obs):
+        import jax.numpy as jnp
+
+        def run(layers, x, final_linear):
+            for i, lyr in enumerate(layers):
+                x = x @ lyr["w"] + lyr["b"]
+                if i < len(layers) - 1 or not final_linear:
+                    x = jnp.tanh(x)
+            return x
+
+        logits = run(params["pi"], obs, final_linear=True)
+        value = run(params["vf"], obs, final_linear=True)[..., 0]
+        return logits, value

@@ -1,0 +1,115 @@
+"""EnvRunner: sampling actor over vectorized gymnasium envs.
+
+Reference: `rllib/evaluation/rollout_worker.py:166` (`sample():879`) and the
+new-stack `rllib/env/env_runner.py`. Collects fixed-size rollout fragments
+with the current policy weights (synced before each round), returning flat
+numpy batches ready for GAE + learner sharding. Policy forward runs jitted on
+the runner's CPU — sampling never touches the learner's devices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import RLModule
+
+
+class EnvRunner:
+    def __init__(
+        self,
+        env_creator: Callable[[], Any],
+        module: RLModule,
+        num_envs: int = 4,
+        rollout_length: int = 128,
+        seed: int = 0,
+        gamma: float = 0.99,
+    ):
+        import gymnasium as gym
+        import jax
+
+        self._envs = gym.vector.SyncVectorEnv(
+            [env_creator for _ in range(num_envs)]
+        )
+        self.module = module
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        self.gamma = gamma
+        self._key = jax.random.PRNGKey(seed)
+        self._params = module.init(jax.random.PRNGKey(seed))
+        self._obs, _ = self._envs.reset(seed=seed)
+        self._episode_returns = np.zeros(num_envs)
+        self._episode_lengths = np.zeros(num_envs, dtype=np.int64)
+        self._completed: list = []
+        self._act = jax.jit(
+            lambda p, o, k, explore: module.action_dist(p, o, k, explore)
+        , static_argnums=(3,))
+
+    def set_weights(self, weights) -> None:
+        self._params = weights
+
+    def sample(self, explore: bool = True) -> Dict[str, np.ndarray]:
+        """One rollout fragment: (T*num_envs) flat transition batch."""
+        import jax
+
+        T, N = self.rollout_length, self.num_envs
+        obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            action, logp, value = self._act(
+                self._params, self._obs.astype(np.float32), sub, explore
+            )
+            action = np.asarray(action)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            nxt, rew, term, trunc, _ = self._envs.step(action)
+            done = np.logical_or(term, trunc)
+            rew_buf[t] = rew
+            done_buf[t] = done.astype(np.float32)
+            self._episode_returns += rew
+            self._episode_lengths += 1
+            for i in np.nonzero(done)[0]:
+                self._completed.append(
+                    (float(self._episode_returns[i]), int(self._episode_lengths[i]))
+                )
+                self._episode_returns[i] = 0.0
+                self._episode_lengths[i] = 0
+            self._obs = nxt
+        # Bootstrap value for the final observation of each env.
+        self._key, sub = jax.random.split(self._key)
+        _, _, last_val = self._act(
+            self._params, self._obs.astype(np.float32), sub, explore
+        )
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "last_values": np.asarray(last_val, np.float32),
+        }
+
+    def episode_stats(self, clear: bool = True) -> Dict[str, float]:
+        eps = self._completed
+        if clear:
+            self._completed = []
+        if not eps:
+            return {"episodes": 0}
+        rets = [r for r, _ in eps]
+        lens = [l for _, l in eps]
+        return {
+            "episodes": len(eps),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+            "episode_return_min": float(np.min(rets)),
+            "episode_len_mean": float(np.mean(lens)),
+        }

@@ -1,0 +1,44 @@
+"""ray_tpu.serve: model serving on the actor substrate.
+
+Reference: `python/ray/serve/` (P19 in SURVEY.md §2) — controller actor
+reconciling replica actors (`controller.py:73`, `deployment_state.py:1009`),
+HTTP proxy (`http_proxy.py:250`), power-of-two router (`router.py:263`),
+deployment graph composition (`deployment_graph_build.py`), autoscaling
+(`autoscaling_policy.py`).
+
+TPU-serving note: a deployment whose replicas hold a jax model keeps params
+device-resident in the replica process; requests batch naturally per replica
+(one ordered queue), and replica count maps to chips via
+`ray_actor_options={"num_tpus": ...}`.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_deployment_handle,
+    http_port,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve._private.common import AutoscalingConfig
+from ray_tpu.serve._private.http_proxy import ProxyRequest
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "ProxyRequest",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "http_port",
+    "run",
+    "shutdown",
+    "status",
+]

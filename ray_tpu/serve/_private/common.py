@@ -1,0 +1,48 @@
+"""Shared Serve dataclasses.
+
+Reference: `python/ray/serve/_private/common.py` (DeploymentInfo,
+ReplicaState) and `serve/config.py` (AutoscalingConfig).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+PROXY_NAME = "SERVE_PROXY"
+DEFAULT_HTTP_PORT = 8000
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_num_ongoing_requests_per_replica: float = 1.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 10.0
+
+    def __post_init__(self):
+        if not (0 < self.min_replicas <= self.max_replicas):
+            raise ValueError("need 0 < min_replicas <= max_replicas")
+
+
+@dataclass
+class DeploymentInfo:
+    name: str
+    blob: bytes  # cloudpickled user class/function
+    init_args: Tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+    num_replicas: int = 1
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    route_prefix: Optional[str] = None
+    is_ingress: bool = False
+    version: int = 0
+
+
+@dataclass
+class ReplicaInfo:
+    replica_id: str
+    actor_id: Any  # ActorID — picklable
+    deployment: str

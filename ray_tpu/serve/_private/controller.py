@@ -1,0 +1,181 @@
+"""ServeController: the reconciling control plane of Serve.
+
+Reference: `python/ray/serve/_private/controller.py:73` (`ServeController`)
++ `deployment_state.py:1009` (`DeploymentState` reconciler) +
+`autoscaling_policy.py`. One named actor holds the desired state
+(deployments -> replica sets), starts/stops replica actors to match, serves
+routing tables to routers (their poll replaces the reference's LongPollHost
+push, `long_poll.py:185`), and runs the autoscaling loop off router-reported
+load.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve._private.common import DeploymentInfo, ReplicaInfo
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, DeploymentInfo] = {}
+        self._replicas: Dict[str, List[ReplicaInfo]] = {}
+        self._replica_counter = 0
+        self._routes: Dict[str, str] = {}  # route_prefix -> deployment name
+        # deployment -> {router_id -> (inflight, timestamp)}
+        self._load: Dict[str, Dict[str, Any]] = {}
+        self._downscale_since: Dict[str, Optional[float]] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._autoscale_loop, daemon=True, name="serve-autoscaler"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- deployment
+    def deploy(self, info: DeploymentInfo) -> None:
+        with self._lock:
+            existing = self._deployments.get(info.name)
+            if existing is not None:
+                info.version = existing.version + 1
+            self._deployments[info.name] = info
+            if info.route_prefix:
+                self._routes[info.route_prefix] = info.name
+            if info.autoscaling_config:
+                target = max(
+                    info.autoscaling_config.min_replicas,
+                    min(info.num_replicas, info.autoscaling_config.max_replicas),
+                )
+            else:
+                target = info.num_replicas
+            if existing is not None:
+                # Redeploy: replace existing replicas with the new version.
+                self._scale_to(info.name, 0)
+            self._scale_to(info.name, target)
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            self._scale_to(name, 0)
+            self._deployments.pop(name, None)
+            self._replicas.pop(name, None)
+            self._routes = {p: d for p, d in self._routes.items() if d != name}
+
+    def _scale_to(self, name: str, target: int) -> None:
+        import ray_tpu
+        from ray_tpu.serve._private.replica import ServeReplica
+
+        info = self._deployments[name]
+        replicas = self._replicas.setdefault(name, [])
+        while len(replicas) < target:
+            self._replica_counter += 1
+            rid = f"{name}#{self._replica_counter}"
+            opts = dict(info.ray_actor_options or {})
+            opts.setdefault("num_cpus", 0.1)
+            opts["name"] = f"SERVE_REPLICA::{rid}"
+            handle = (
+                ray_tpu.remote(ServeReplica)
+                .options(**opts)
+                .remote(name, info.blob, info.init_args, info.init_kwargs)
+            )
+            # Block until constructed so routing tables only list live replicas.
+            ray_tpu.get(handle.__ray_ready__.remote())
+            replicas.append(ReplicaInfo(rid, handle._actor_id, name))
+        while len(replicas) > target:
+            rep = replicas.pop()
+            self._kill_replica(rep)
+
+    def _kill_replica(self, rep: ReplicaInfo) -> None:
+        import ray_tpu
+        from ray_tpu.actor import ActorHandle
+
+        try:
+            ray_tpu.kill(ActorHandle(rep.actor_id, "ServeReplica"))
+        except Exception:
+            pass
+
+    # ---------------------------------------------------------------- routing
+    def get_replicas(self, name: str) -> List[ReplicaInfo]:
+        with self._lock:
+            return list(self._replicas.get(name, []))
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                name: {
+                    "num_replicas": len(self._replicas.get(name, [])),
+                    "route_prefix": info.route_prefix,
+                    "version": info.version,
+                    "autoscaling": info.autoscaling_config is not None,
+                }
+                for name, info in self._deployments.items()
+            }
+
+    def report_failure(self, name: str, replica_id: str) -> None:
+        """Router saw a dead replica: replace it (reference: replica recovery
+        in DeploymentState reconciliation)."""
+        with self._lock:
+            replicas = self._replicas.get(name, [])
+            before = len(replicas)
+            replicas[:] = [r for r in replicas if r.replica_id != replica_id]
+            if len(replicas) < before and name in self._deployments:
+                self._scale_to(name, before)
+
+    # ------------------------------------------------------------ autoscaling
+    def report_load(self, name: str, router_id: str, inflight: int) -> None:
+        with self._lock:
+            self._load.setdefault(name, {})[router_id] = (inflight, time.time())
+
+    def _autoscale_loop(self):
+        while not self._stop.wait(0.5):
+            try:
+                self._autoscale_once()
+            except Exception:
+                pass
+
+    def _autoscale_once(self):
+        now = time.time()
+        with self._lock:
+            for name, info in list(self._deployments.items()):
+                cfg = info.autoscaling_config
+                if cfg is None:
+                    continue
+                loads = self._load.get(name, {})
+                total = sum(v for v, ts in loads.values() if now - ts < 5.0)
+                cur = len(self._replicas.get(name, []))
+                desired = max(
+                    cfg.min_replicas,
+                    min(
+                        cfg.max_replicas,
+                        -(-total // max(cfg.target_num_ongoing_requests_per_replica, 1e-9))
+                        if total
+                        else cfg.min_replicas,
+                    ),
+                )
+                desired = int(desired)
+                if desired > cur:
+                    self._downscale_since[name] = None
+                    self._scale_to(name, desired)
+                elif desired < cur:
+                    since = self._downscale_since.get(name)
+                    if since is None:
+                        self._downscale_since[name] = now
+                    elif now - since >= cfg.downscale_delay_s:
+                        self._scale_to(name, desired)
+                        self._downscale_since[name] = None
+                else:
+                    self._downscale_since[name] = None
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for name in list(self._deployments):
+                self._scale_to(name, 0)
+            self._deployments.clear()
+            self._replicas.clear()
+            self._routes.clear()
+        self._stop.set()

@@ -1,0 +1,149 @@
+"""HTTP proxy: the HTTP front door, one actor (per node at scale).
+
+Reference: `python/ray/serve/_private/http_proxy.py:250` (`HTTPProxy`, served
+by uvicorn at `:434`). Here the server is aiohttp running on a background
+thread inside the proxy actor; each request resolves its route by longest
+prefix match against the controller's route table (cached), then hops to a
+replica through the same Router/power-of-two path as Python handles, with
+the blocking result fetch pushed onto the loop's executor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclass
+class ProxyRequest:
+    """What a deployment's __call__ receives for an HTTP request."""
+
+    method: str
+    path: str  # path with the route prefix stripped
+    full_path: str
+    query_params: Dict[str, str] = field(default_factory=dict)
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+    def json(self) -> Any:
+        return json.loads(self.body) if self.body else None
+
+    @property
+    def text(self) -> str:
+        return self.body.decode()
+
+
+class HTTPProxy:
+    def __init__(self, controller):
+        self._controller = controller
+        self._handles: Dict[str, Any] = {}
+        self._routes: Dict[str, str] = {}
+        self._routes_fetched = 0.0
+        self._port: Optional[int] = None
+        self._started = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self, host: str = "127.0.0.1", port: int = 8000) -> int:
+        """Start serving; returns the bound port (0 picks a free one)."""
+        t = threading.Thread(
+            target=self._serve_thread, args=(host, port), daemon=True, name="http"
+        )
+        t.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("HTTP proxy failed to start in 30s")
+        return self._port
+
+    def port(self) -> Optional[int]:
+        return self._port
+
+    def _serve_thread(self, host: str, port: int):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app, access_log=None)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, host, port)
+        loop.run_until_complete(site.start())
+        self._port = site._server.sockets[0].getsockname()[1]
+        self._started.set()
+        loop.run_forever()
+
+    # ---------------------------------------------------------------- routing
+    def _route_table(self) -> Dict[str, str]:
+        import time
+
+        import ray_tpu
+
+        if time.time() - self._routes_fetched > 2.0:
+            self._routes = ray_tpu.get(self._controller.get_routes.remote())
+            self._routes_fetched = time.time()
+        return self._routes
+
+    def _match(self, path: str) -> Optional[Tuple[str, str]]:
+        routes = self._route_table()
+        best = None
+        for prefix, dep in routes.items():
+            norm = prefix.rstrip("/") or ""
+            if path == norm or path.startswith(norm + "/") or norm == "":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, dep)
+        if best is None:
+            return None
+        rest = path[len(best[0]):] or "/"
+        return best[1], rest
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        match = self._match(request.path)
+        if match is None:
+            return web.json_response(
+                {"error": f"no route for {request.path}"}, status=404
+            )
+        dep, rest = match
+        body = await request.read()
+        preq = ProxyRequest(
+            method=request.method,
+            path=rest,
+            full_path=request.path,
+            query_params=dict(request.query),
+            headers=dict(request.headers),
+            body=body,
+        )
+        handle = self._handles.get(dep)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(dep, self._controller)
+            self._handles[dep] = handle
+        loop = asyncio.get_event_loop()
+        try:
+            resp = handle.remote(preq)
+            result = await loop.run_in_executor(None, resp.result)
+        except Exception as e:  # noqa: BLE001 — surface as a 500
+            return web.json_response({"error": str(e)}, status=500)
+        return self._to_response(result)
+
+    @staticmethod
+    def _to_response(result):
+        from aiohttp import web
+
+        if isinstance(result, web.Response):
+            return result
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        if isinstance(result, str):
+            return web.Response(text=result)
+        try:
+            return web.json_response(result)
+        except TypeError:
+            return web.Response(text=str(result))

@@ -1,0 +1,52 @@
+"""Replica: the actor wrapping one copy of a deployment's user callable.
+
+Reference: `python/ray/serve/_private/replica.py:276` (`RayServeReplica`) —
+resolves the user class/function, injects handle arguments, executes requests.
+One request at a time (the actor's ordered queue); concurrency comes from
+replica count, balanced by the router's power-of-two choice.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Tuple
+
+
+class ServeReplica:
+    def __init__(self, deployment_name: str, blob: bytes, init_args: Tuple,
+                 init_kwargs: Dict[str, Any]):
+        from ray_tpu._private import serialization
+
+        self.deployment_name = deployment_name
+        target = serialization.loads(blob)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            if init_args or init_kwargs:
+                raise ValueError("function deployments take no init args")
+            self._callable = target
+        self._requests = 0
+        self._started = time.time()
+
+    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict[str, Any]):
+        self._requests += 1
+        if method_name == "__call__":
+            target = self._callable
+            if not callable(target):
+                raise AttributeError(
+                    f"deployment {self.deployment_name} object is not callable"
+                )
+        else:
+            target = getattr(self._callable, method_name)
+        return target(*args, **kwargs)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "deployment": self.deployment_name,
+            "requests": self._requests,
+            "uptime_s": time.time() - self._started,
+        }
+
+    def reconfigure(self, user_config: Any) -> None:
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)

@@ -1,0 +1,250 @@
+"""Serve public API: deployments, applications, run/shutdown.
+
+Reference: `python/ray/serve/api.py` (`@serve.deployment`, `serve.run:460`)
+and `_private/deployment_graph_build.py` (bound DAG -> deployments). A
+`Deployment.bind(...)` builds an `Application` node; `serve.run` deploys the
+graph bottom-up (bound children become `DeploymentHandle`s in the parent's
+init args), marks the top node as ingress, and exposes it over HTTP.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.serve._private.common import (
+    CONTROLLER_NAME,
+    DEFAULT_HTTP_PORT,
+    PROXY_NAME,
+    AutoscalingConfig,
+    DeploymentInfo,
+)
+from ray_tpu.serve.handle import DeploymentHandle
+
+_VALID_DEPLOYMENT_OPTIONS = {
+    "name",
+    "num_replicas",
+    "ray_actor_options",
+    "autoscaling_config",
+    "route_prefix",
+    "max_concurrent_queries",
+    "user_config",
+    "version",
+}
+
+
+class Application:
+    """A bound deployment graph node (reference: `serve/deployment.py`
+    `Application`/`BuiltApplication`)."""
+
+    def __init__(self, deployment: "Deployment", args: Tuple, kwargs: Dict[str, Any]):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, target, options: Optional[Dict[str, Any]] = None):
+        self._target = target
+        opts = dict(options or {})
+        for k in opts:
+            if k not in _VALID_DEPLOYMENT_OPTIONS:
+                raise ValueError(f"invalid deployment option: {k}")
+        self._options = opts
+
+    @property
+    def name(self) -> str:
+        return self._options.get("name") or self._target.__name__
+
+    def options(self, **opts) -> "Deployment":
+        merged = dict(self._options)
+        merged.update(opts)
+        return Deployment(self._target, merged)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Deployment {self.name} cannot be called directly; deploy it with "
+            "serve.run() and use the returned handle."
+        )
+
+
+def deployment(_target=None, **opts) -> Union[Deployment, Any]:
+    """`@serve.deployment` decorator (bare or parameterized)."""
+    if _target is not None:
+        return Deployment(_target)
+
+    def wrap(target):
+        return Deployment(target, opts)
+
+    return wrap
+
+
+# ---------------------------------------------------------------- runtime state
+_client: Dict[str, Any] = {}
+
+
+def _get_controller(create: bool = True):
+    from ray_tpu.serve._private.controller import ServeController
+
+    if "controller" in _client:
+        return _client["controller"]
+    try:
+        handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        from ray_tpu.actor import ActorHandle
+
+        handle = ActorHandle(handle._actor_id, "ServeController")
+    except ValueError:
+        if not create:
+            raise RuntimeError("Serve is not running (call serve.run/start first)")
+        handle = (
+            ray_tpu.remote(ServeController)
+            .options(name=CONTROLLER_NAME, num_cpus=0.1, get_if_exists=True)
+            .remote()
+        )
+        ray_tpu.get(handle.__ray_ready__.remote())
+    _client["controller"] = handle
+    return handle
+
+
+def _get_proxy(create: bool = True, port: int = DEFAULT_HTTP_PORT):
+    from ray_tpu.serve._private.http_proxy import HTTPProxy
+
+    if "proxy" in _client:
+        return _client["proxy"]
+    controller = _get_controller()
+    try:
+        handle = ray_tpu.get_actor(PROXY_NAME)
+        from ray_tpu.actor import ActorHandle
+
+        handle = ActorHandle(handle._actor_id, "HTTPProxy")
+    except ValueError:
+        if not create:
+            return None
+        handle = (
+            ray_tpu.remote(HTTPProxy)
+            .options(name=PROXY_NAME, num_cpus=0.1, get_if_exists=True)
+            .remote(controller)
+        )
+        bound = ray_tpu.get(handle.start.remote(port=port))
+        _client["http_port"] = bound
+    _client["proxy"] = handle
+    return handle
+
+
+def http_port() -> Optional[int]:
+    if "http_port" in _client:
+        return _client["http_port"]
+    proxy = _get_proxy(create=False)
+    if proxy is None:
+        return None
+    port = ray_tpu.get(proxy.port.remote())
+    _client["http_port"] = port
+    return port
+
+
+# ------------------------------------------------------------------------- run
+def _collect_apps(app: Application, out: List[Application]) -> None:
+    """Post-order: children first, so handles exist before parents deploy."""
+    for a in list(app.args) + list(app.kwargs.values()):
+        if isinstance(a, Application):
+            _collect_apps(a, out)
+    if app not in out:
+        out.append(app)
+
+
+def run(
+    target: Union[Application, Deployment],
+    *,
+    route_prefix: Optional[str] = "/",
+    host: str = "127.0.0.1",
+    port: int = DEFAULT_HTTP_PORT,
+    _blocking_http: bool = True,
+) -> DeploymentHandle:
+    """Deploy an application (graph); returns a handle to the ingress."""
+    ray_tpu._private.worker._auto_init()
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects an Application, got {type(target)}")
+
+    controller = _get_controller()
+    order: List[Application] = []
+    _collect_apps(target, order)
+    for app in order:
+        dep = app.deployment
+        resolved_args = tuple(
+            DeploymentHandle(a.deployment.name, controller)
+            if isinstance(a, Application)
+            else a
+            for a in app.args
+        )
+        resolved_kwargs = {
+            k: DeploymentHandle(v.deployment.name, controller)
+            if isinstance(v, Application)
+            else v
+            for k, v in app.kwargs.items()
+        }
+        is_ingress = app is target
+        info = DeploymentInfo(
+            name=dep.name,
+            blob=serialization.dumps(dep._target),
+            init_args=resolved_args,
+            init_kwargs=resolved_kwargs,
+            num_replicas=int(dep._options.get("num_replicas", 1)),
+            ray_actor_options=dep._options.get("ray_actor_options") or {},
+            autoscaling_config=_coerce_autoscaling(
+                dep._options.get("autoscaling_config")
+            ),
+            route_prefix=(
+                dep._options.get("route_prefix", route_prefix) if is_ingress
+                else dep._options.get("route_prefix")
+            ),
+            is_ingress=is_ingress,
+        )
+        ray_tpu.get(controller.deploy.remote(info))
+    if _blocking_http:
+        _get_proxy(create=True, port=port)
+    return DeploymentHandle(target.deployment.name, controller)
+
+
+def _coerce_autoscaling(cfg) -> Optional[AutoscalingConfig]:
+    if cfg is None or isinstance(cfg, AutoscalingConfig):
+        return cfg
+    if isinstance(cfg, dict):
+        return AutoscalingConfig(**cfg)
+    raise TypeError(f"invalid autoscaling_config: {cfg!r}")
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, _get_controller(create=False))
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_controller(create=False)
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def delete(name: str) -> None:
+    controller = _get_controller(create=False)
+    ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def shutdown() -> None:
+    if "controller" in _client:
+        try:
+            ray_tpu.get(_client["controller"].shutdown.remote())
+            ray_tpu.kill(_client["controller"])
+        except Exception:
+            pass
+    if "proxy" in _client:
+        try:
+            ray_tpu.kill(_client["proxy"])
+        except Exception:
+            pass
+    _client.clear()
