@@ -314,7 +314,13 @@ class Dataset:
         allk = np.sort(np.concatenate([k for k in keys if len(k)]))
         if len(allk) == 0:
             return Dataset(refs)
-        bounds = allk[[int(len(allk) * (i + 1) / n) - 1 for i in range(n - 1)]] if n > 1 else np.array([])
+        # Clamp to >=0: with fewer rows than blocks the raw index is -1, which
+        # would pick the max key as the FIRST boundary (non-monotonic bounds).
+        bounds = (
+            allk[[max(0, int(len(allk) * (i + 1) / n) - 1) for i in range(n - 1)]]
+            if n > 1
+            else np.array([])
+        )
         scatter = _remote(_sort_scatter, num_returns=n)
         pieces = [
             scatter.options(num_returns=n).remote(r, key, bounds, descending)
@@ -340,10 +346,13 @@ class Dataset:
         return Dataset(refs)
 
     def zip(self, other: "Dataset") -> "Dataset":
+        n_self, n_other = self.count(), other.count()
+        if n_self != n_other:
+            raise ValueError(
+                f"zip requires equal row counts: {n_self} vs {n_other}"
+            )
         a = self.repartition(self.num_blocks())._execute()
         b = other.repartition(self.num_blocks())._execute()
-        if len(a) != len(b):
-            raise ValueError("zip requires equal block counts after repartition")
         z = _remote(_zip_blocks)
         return Dataset([z.remote(x, y) for x, y in zip(a, b)])
 

@@ -120,6 +120,7 @@ class Algorithm:
             self.make_loss(),
             num_learners=config.num_learners,
             learning_rate=config.lr,
+            optimizer=self.make_optimizer(),
             seed=config.seed,
         )
         runner_cls = ray_tpu.remote(EnvRunner)
@@ -139,6 +140,10 @@ class Algorithm:
     def make_loss(self) -> Callable:
         raise NotImplementedError
 
+    def make_optimizer(self):
+        """Optional optax transform; None -> LearnerGroup's default adam(lr)."""
+        return None
+
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
 
@@ -151,11 +156,22 @@ class Algorithm:
         return metrics
 
     # ------------------------------------------------------------ checkpoints
+    def _extra_state(self) -> Dict[str, Any]:
+        """Algorithm-specific state beyond learner weights (e.g. PPO kl_coeff)."""
+        return {}
+
+    def _load_extra_state(self, state: Dict[str, Any]) -> None:
+        pass
+
     def save(self, path: str) -> str:
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "algo_state.pkl"), "wb") as fh:
             pickle.dump(
-                {"iteration": self.iteration, "learner": self.learner_group.state()},
+                {
+                    "iteration": self.iteration,
+                    "learner": self.learner_group.state(),
+                    "extra": self._extra_state(),
+                },
                 fh,
             )
         return path
@@ -165,6 +181,7 @@ class Algorithm:
             state = pickle.load(fh)
         self.iteration = state["iteration"]
         self.learner_group.load_state(state["learner"])
+        self._load_extra_state(state.get("extra", {}))
 
     def stop(self) -> None:
         import ray_tpu

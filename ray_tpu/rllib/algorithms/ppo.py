@@ -1,0 +1,243 @@
+"""PPO: Proximal Policy Optimization on the JaxLearner stack.
+
+Reference: `rllib/algorithms/ppo/ppo.py:56` (PPOConfig: `lambda_=1.0,
+kl_coeff=0.2, sgd_minibatch_size=128, num_sgd_iter=30, clip_param=0.3,
+vf_clip_param=10.0, kl_target=0.01` at ppo.py:100-111) and the loss in
+`rllib/algorithms/ppo/ppo_torch_policy.py` (clipped surrogate over
+logp_ratio, KL(prev||curr) from stored behavior dist inputs, clipped value
+loss, entropy bonus); adaptive KL rule from `rllib/policy/torch_mixins.py:87`
+(coeff *= 1.5 above 2*target, *= 0.5 below target/2).
+
+TPU-first redesign: the whole loss (policy forward, surrogate, KL, value
+loss) is one pure function jitted inside JaxLearner with donated state; on a
+mesh the minibatch shards over the data axis and gradient all-reduce happens
+inside XLA over ICI. GAE postprocessing stays on the host (numpy over the
+(T, N) rollout buffers) — it is O(T*N) bookkeeping, not MXU work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lambda_ = 0.95
+        self.kl_coeff = 0.2
+        self.kl_target = 0.01
+        self.clip_param = 0.3
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.minibatch_size = 128
+        self.num_epochs = 4
+        self.grad_clip = 0.5
+        self.use_critic = True
+        self._algo_cls = PPO
+
+    def training(self, **kwargs) -> "PPOConfig":
+        # Accept the reference's old-stack names as aliases.
+        aliases = {"sgd_minibatch_size": "minibatch_size", "num_sgd_iter": "num_epochs"}
+        kwargs = {aliases.get(k, k): v for k, v in kwargs.items()}
+        super().training(**kwargs)
+        return self
+
+
+def compute_gae(
+    rollout: Dict[str, np.ndarray], gamma: float, lambda_: float
+) -> Dict[str, np.ndarray]:
+    """GAE(lambda) over a (T, N) rollout fragment with bootstrapped tails.
+
+    Reference semantics: `rllib/evaluation/postprocessing.py`
+    (`compute_advantages`) — advantages from reversed TD(lambda) residuals,
+    value targets = advantages + values.
+    """
+    rewards, values, dones = rollout["rewards"], rollout["values"], rollout["dones"]
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    lastgaelam = np.zeros(rewards.shape[1], np.float32)
+    for t in reversed(range(T)):
+        next_values = rollout["last_values"] if t == T - 1 else values[t + 1]
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_values * nonterminal - values[t]
+        lastgaelam = delta + gamma * lambda_ * nonterminal * lastgaelam
+        adv[t] = lastgaelam
+    return {"advantages": adv, "value_targets": adv + values}
+
+
+def _flatten(rollout: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """(T, N, ...) buffers -> (T*N, ...) flat transition batch."""
+    out = {}
+    for k, v in rollout.items():
+        if k == "last_values":
+            continue
+        out[k] = v.reshape((-1,) + v.shape[2:])
+    return out
+
+
+def make_ppo_loss(config: PPOConfig) -> Callable:
+    """Pure (module, params, batch) -> (loss, aux) for JaxLearner.jit."""
+    clip = config.clip_param
+    vf_clip = config.vf_clip_param
+    vf_coeff = config.vf_loss_coeff
+    ent_coeff = config.entropy_coeff
+    use_critic = config.use_critic
+
+    def loss(module, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        logits, values = module.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        curr_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        logp_ratio = jnp.exp(curr_logp - batch["logp"])
+        adv = batch["advantages"]
+        surrogate = jnp.minimum(
+            adv * logp_ratio,
+            adv * jnp.clip(logp_ratio, 1.0 - clip, 1.0 + clip),
+        )
+        # True KL(prev || curr) over the categorical dist, from the behavior
+        # logits the runner stored (= reference's ACTION_DIST_INPUTS path).
+        prev_logp_all = jax.nn.log_softmax(batch["behavior_logits"])
+        kl = jnp.sum(
+            jnp.exp(prev_logp_all) * (prev_logp_all - logp_all), axis=-1
+        )
+        mean_kl = jnp.mean(kl)
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        mean_entropy = jnp.mean(entropy)
+        if use_critic:
+            vf_err = jnp.square(values - batch["value_targets"])
+            vf_loss = jnp.clip(vf_err, 0.0, vf_clip)
+            mean_vf = jnp.mean(vf_loss)
+        else:
+            mean_vf = jnp.asarray(0.0)
+        # kl_coeff rides in the batch (per-row broadcast scalar) so the
+        # adaptive-KL update never retriggers a jit compile.
+        kl_coeff = jnp.mean(batch["kl_coeff"])
+        policy_loss = -jnp.mean(surrogate)
+        total = (
+            policy_loss
+            + kl_coeff * mean_kl
+            + vf_coeff * mean_vf
+            - ent_coeff * mean_entropy
+        )
+        aux = {
+            "policy_loss": policy_loss,
+            "vf_loss": mean_vf,
+            "mean_kl": mean_kl,
+            "entropy": mean_entropy,
+        }
+        return total, aux
+
+    return loss
+
+
+class PPO(Algorithm):
+    def __init__(self, config: PPOConfig):
+        super().__init__(config)
+        self.kl_coeff = float(config.kl_coeff)
+
+    def make_loss(self) -> Callable:
+        return make_ppo_loss(self.config)
+
+    def make_optimizer(self):
+        import optax
+
+        return optax.chain(
+            optax.clip_by_global_norm(self.config.grad_clip),
+            optax.adam(self.config.lr),
+        )
+
+    # ----------------------------------------------------------- one iteration
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.config
+        # 1. Push current weights to all samplers.
+        weights = self.learner_group.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.env_runners])
+        # 2. Parallel rollouts.
+        rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
+        # 3. GAE on the host, then one flat train batch.
+        flats: List[Dict[str, np.ndarray]] = []
+        for ro in rollouts:
+            ro = dict(ro)
+            ro.update(compute_gae(ro, cfg.gamma, cfg.lambda_))
+            flats.append(_flatten(ro))
+        # Only the keys the loss consumes ride into the jitted update.
+        keys = (
+            "obs",
+            "actions",
+            "logp",
+            "behavior_logits",
+            "advantages",
+            "value_targets",
+        )
+        batch = {k: np.concatenate([f[k] for f in flats]) for k in keys}
+        # Standardize advantages (reference: standardize_fields=["advantages"]).
+        a = batch["advantages"]
+        batch["advantages"] = (a - a.mean()) / max(1e-4, a.std())
+        B = len(batch["advantages"])
+        # 4. Multi-epoch minibatch SGD.
+        mb = min(cfg.minibatch_size, B)
+        if cfg.num_learners > 1:
+            # Each remote learner gets an equal shard of every minibatch.
+            mb = max(cfg.num_learners, mb - mb % cfg.num_learners)
+        metrics_acc: List[Dict[str, float]] = []
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        mb_per_epoch = 0
+        for epoch in range(cfg.num_epochs):
+            perm = rng.permutation(B)
+            mb_per_epoch = 0
+            for start in range(0, B - mb + 1, mb):
+                idx = perm[start : start + mb]
+                minibatch = {k: v[idx] for k, v in batch.items()}
+                minibatch["kl_coeff"] = np.full(mb, self.kl_coeff, np.float32)
+                metrics_acc.append(self.learner_group.update(minibatch))
+                mb_per_epoch += 1
+        out: Dict[str, Any] = {
+            k: float(np.mean([m[k] for m in metrics_acc])) for k in metrics_acc[0]
+        }
+        # 5. Adaptive KL coefficient (torch_mixins.py:87 rule) on the KL
+        # sampled over the final epoch's minibatches.
+        sampled_kl = float(
+            np.mean([m["mean_kl"] for m in metrics_acc[-mb_per_epoch:]])
+        )
+        if sampled_kl > 2.0 * cfg.kl_target:
+            self.kl_coeff *= 1.5
+        elif sampled_kl < 0.5 * cfg.kl_target:
+            self.kl_coeff *= 0.5
+        out["kl_coeff"] = self.kl_coeff
+        out["num_env_steps_sampled"] = B
+        # 6. Episode stats across runners.
+        stats = ray_tpu.get([r.episode_stats.remote() for r in self.env_runners])
+        episodes = [s for s in stats if s.get("episodes", 0) > 0]
+        if episodes:
+            out["episode_return_mean"] = float(
+                np.average(
+                    [s["episode_return_mean"] for s in episodes],
+                    weights=[s["episodes"] for s in episodes],
+                )
+            )
+            out["episode_len_mean"] = float(
+                np.average(
+                    [s["episode_len_mean"] for s in episodes],
+                    weights=[s["episodes"] for s in episodes],
+                )
+            )
+            out["episodes_this_iter"] = int(sum(s["episodes"] for s in episodes))
+        return out
+
+    # -------------------------------------------------------------- checkpoint
+    def _extra_state(self) -> Dict[str, Any]:
+        return {"kl_coeff": self.kl_coeff}
+
+    def _load_extra_state(self, state: Dict[str, Any]) -> None:
+        self.kl_coeff = float(state.get("kl_coeff", self.config.kl_coeff))

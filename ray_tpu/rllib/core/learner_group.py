@@ -21,9 +21,9 @@ from ray_tpu.rllib.core.rl_module import RLModule
 class _RemoteLearner:
     """Actor wrapping one JaxLearner (one host / one chip set)."""
 
-    def __init__(self, module, loss_fn, learning_rate: float, seed: int):
+    def __init__(self, module, loss_fn, learning_rate: float, seed: int, optimizer=None):
         self.learner = JaxLearner(
-            module, loss_fn, learning_rate=learning_rate, seed=seed
+            module, loss_fn, learning_rate=learning_rate, seed=seed, optimizer=optimizer
         )
 
     def update(self, batch):
@@ -51,12 +51,18 @@ class LearnerGroup:
         num_learners: int = 0,
         learning_rate: float = 3e-4,
         mesh=None,
+        optimizer=None,
         seed: int = 0,
     ):
         self._num = num_learners
         if num_learners == 0:
             self._local = JaxLearner(
-                module, loss_fn, learning_rate=learning_rate, mesh=mesh, seed=seed
+                module,
+                loss_fn,
+                learning_rate=learning_rate,
+                mesh=mesh,
+                optimizer=optimizer,
+                seed=seed,
             )
             self._remote: List = []
         else:
@@ -65,7 +71,9 @@ class LearnerGroup:
             self._local = None
             cls = ray_tpu.remote(_RemoteLearner)
             self._remote = [
-                cls.options(num_cpus=1).remote(module, loss_fn, learning_rate, seed)
+                cls.options(num_cpus=1).remote(
+                    module, loss_fn, learning_rate, seed, optimizer
+                )
                 for _ in range(num_learners)
             ]
 

@@ -25,7 +25,12 @@ class RLModule:
         raise NotImplementedError
 
     def action_dist(self, params, obs, key, explore: bool = True):
-        """Sample actions + logp under the current policy (jit-safe)."""
+        """Sample actions + logp under the current policy (jit-safe).
+
+        Returns (action, logp, value, logits); the behavior logits ride along
+        so PPO can compute the true KL(prev || curr) the way the reference does
+        with stored ACTION_DIST_INPUTS (`ppo_torch_policy.py` loss).
+        """
         import jax
         import jax.numpy as jnp
 
@@ -36,7 +41,7 @@ class RLModule:
             action = jnp.argmax(logits, axis=-1)
         logp = jax.nn.log_softmax(logits)
         act_logp = jnp.take_along_axis(logp, action[..., None], axis=-1)[..., 0]
-        return action, act_logp, value
+        return action, act_logp, value, logits
 
 
 class MLPModule(RLModule):

@@ -60,12 +60,16 @@ class EnvRunner:
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
+        logits_buf: Optional[np.ndarray] = None
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
-            action, logp, value = self._act(
+            action, logp, value, logits = self._act(
                 self._params, self._obs.astype(np.float32), sub, explore
             )
             action = np.asarray(action)
+            if logits_buf is None:
+                logits_buf = np.zeros((T, N) + np.shape(logits)[1:], np.float32)
+            logits_buf[t] = np.asarray(logits)
             obs_buf[t] = self._obs
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
@@ -85,13 +89,14 @@ class EnvRunner:
             self._obs = nxt
         # Bootstrap value for the final observation of each env.
         self._key, sub = jax.random.split(self._key)
-        _, _, last_val = self._act(
+        _, _, last_val, _ = self._act(
             self._params, self._obs.astype(np.float32), sub, explore
         )
         return {
             "obs": obs_buf,
             "actions": act_buf,
             "logp": logp_buf,
+            "behavior_logits": logits_buf,
             "values": val_buf,
             "rewards": rew_buf,
             "dones": done_buf,

@@ -65,39 +65,35 @@ class Router:
         except Exception:
             pass
 
-    def route(self, method_name: str, args, kwargs):
-        """Pick a replica (power of two choices) and submit; retry once on a
-        dead replica after reporting it."""
-        import ray_tpu
+    def route(self, method_name: str, args, kwargs, force_refresh: bool = False):
+        """Pick a replica (power of two choices) and submit.
+
+        Returns ``(ref, replica_id)`` so the response can report the replica
+        on actor-death and resubmit (dead-replica retry lives in
+        DeploymentResponse.result()).
+        """
         from ray_tpu.actor import ActorHandle
 
-        for attempt in (0, 1):
-            with self._lock:
-                self._refresh(force=attempt > 0)
-                if not self._replicas:
-                    raise RuntimeError(
-                        f"no replicas for deployment '{self._name}'"
-                    )
-                self._sweep()
-                if len(self._replicas) == 1:
-                    chosen = self._replicas[0]
-                else:
-                    a, b = random.sample(self._replicas, 2)
-                    chosen = (
-                        a
-                        if len(self._inflight.get(a.replica_id, []))
-                        <= len(self._inflight.get(b.replica_id, []))
-                        else b
-                    )
-                handle = ActorHandle(chosen.actor_id, "ServeReplica")
-                ref = handle.handle_request.remote(method_name, tuple(args), kwargs)
-                self._inflight.setdefault(chosen.replica_id, []).append(ref)
-                self._report_load()
-            # Liveness probe outside the lock: if the replica already died the
-            # submit surfaces as a failed get on first touch; we only eagerly
-            # verify on retry-worthy errors at get() time, so return the ref.
-            return ref
-        raise RuntimeError("unreachable")
+        with self._lock:
+            self._refresh(force=force_refresh)
+            if not self._replicas:
+                raise RuntimeError(f"no replicas for deployment '{self._name}'")
+            self._sweep()
+            if len(self._replicas) == 1:
+                chosen = self._replicas[0]
+            else:
+                a, b = random.sample(self._replicas, 2)
+                chosen = (
+                    a
+                    if len(self._inflight.get(a.replica_id, []))
+                    <= len(self._inflight.get(b.replica_id, []))
+                    else b
+                )
+            handle = ActorHandle(chosen.actor_id, "ServeReplica")
+            ref = handle.handle_request.remote(method_name, tuple(args), kwargs)
+            self._inflight.setdefault(chosen.replica_id, []).append(ref)
+            self._report_load()
+        return ref, chosen.replica_id
 
     def report_failure(self, replica_id: str):
         import ray_tpu
@@ -115,16 +111,43 @@ class Router:
 
 class DeploymentResponse:
     """Lazy response: `.result()` blocks, `ray_tpu.get(resp.ref)` also works
-    (reference: `serve/handle.py` DeploymentResponse)."""
+    (reference: `serve/handle.py` DeploymentResponse).
 
-    def __init__(self, ref, router: Router, replica_id: Optional[str] = None):
+    On actor-death at fetch time the dead replica is reported to the
+    controller (which replaces it) and the request is resubmitted once to
+    another replica (reference: router replica recovery)."""
+
+    def __init__(
+        self,
+        ref,
+        router: Router,
+        replica_id: Optional[str] = None,
+        request: Optional[tuple] = None,
+    ):
         self.ref = ref
         self._router = router
+        self._replica_id = replica_id
+        self._request = request  # (method_name, args, kwargs)
 
     def result(self, timeout: Optional[float] = None):
         import ray_tpu
+        from ray_tpu.exceptions import RayActorError, WorkerCrashedError
 
-        return ray_tpu.get(self.ref, timeout=timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            return ray_tpu.get(self.ref, timeout=timeout)
+        except (RayActorError, WorkerCrashedError):
+            if self._request is None or self._replica_id is None:
+                raise
+            self._router.report_failure(self._replica_id)
+            method, args, kwargs = self._request
+            self.ref, self._replica_id = self._router.route(
+                method, args, kwargs, force_refresh=True
+            )
+            remaining = (
+                None if deadline is None else max(0.0, deadline - time.monotonic())
+            )
+            return ray_tpu.get(self.ref, timeout=remaining)
 
 
 class DeploymentHandle:
@@ -146,8 +169,10 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         router = self._ensure_router()
-        ref = router.route(self._method, args, kwargs)
-        return DeploymentResponse(ref, router)
+        ref, replica_id = router.route(self._method, args, kwargs)
+        return DeploymentResponse(
+            ref, router, replica_id, (self._method, args, kwargs)
+        )
 
     def __reduce__(self):
         return (

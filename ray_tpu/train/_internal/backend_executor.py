@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.exceptions import RayTpuError
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import ScalingConfig
 from ray_tpu.train._internal.session import DONE, ERROR, REPORT, SessionArgs, TrainingResult
@@ -49,12 +50,18 @@ class BackendExecutor:
             raise TrainingWorkerError(
                 f"placement group {bundles} not schedulable on this cluster"
             )
-        self.worker_group = WorkerGroup(
-            self._scaling.num_workers,
-            resources_per_worker=self._scaling._resources,
-            placement_group=self._pg,
-        )
-        meta = self.worker_group.fetch_metadata()
+        try:
+            self.worker_group = WorkerGroup(
+                self._scaling.num_workers,
+                resources_per_worker=self._scaling._resources,
+                placement_group=self._pg,
+            )
+            meta = self.worker_group.fetch_metadata()
+        except Exception as e:
+            # Worker/actor death during gang bring-up must consume the
+            # FailureConfig budget (gang restart), not surface as a
+            # driver-side bug (reference retries startup failures too).
+            raise TrainingWorkerError(f"gang startup failed: {e}") from e
         # Rank assignment: stable by (node ip, pid) so local ranks are contiguous
         # per node (the reference sorts workers by node for the same reason).
         order = sorted(range(len(meta)), key=lambda i: (meta[i].node_ip, meta[i].pid))
@@ -71,7 +78,10 @@ class BackendExecutor:
                     "local_world_size": len(by_node[ip]),
                     "node_rank": node_rank,
                 }
-        self._backend.on_start(self, self._backend_config)
+        try:
+            self._backend.on_start(self, self._backend_config)
+        except RayTpuError as e:
+            raise TrainingWorkerError(f"gang startup failed: {e}") from e
 
     @property
     def ranks(self) -> List[int]:
@@ -92,7 +102,10 @@ class BackendExecutor:
         dataset_shards: Optional[List[Dict[str, Any]]] = None,
         mesh_builder: Optional[Callable] = None,
     ):
-        self._backend.on_training_start(self, self._backend_config)
+        try:
+            self._backend.on_training_start(self, self._backend_config)
+        except RayTpuError as e:
+            raise TrainingWorkerError(f"gang startup failed: {e}") from e
         refs = []
         for i, w in enumerate(self.worker_group.workers):
             info = self.world_info(i)
@@ -112,7 +125,10 @@ class BackendExecutor:
                 **self._trial_info,
             )
             refs.append(w.init_session.remote(args))
-        ray_tpu.get(refs)
+        try:
+            ray_tpu.get(refs)
+        except Exception as e:
+            raise TrainingWorkerError(f"gang startup failed: {e}") from e
 
     def get_next_results(self) -> Optional[List[TrainingResult]]:
         """One result per worker (ordered by world rank), or None when all DONE.
