@@ -1,26 +1,46 @@
-"""Headline benchmark: GPT-2 small training throughput/MFU on the local TPU chip.
+"""Headline benchmark: GPT-2 small training throughput/MFU THROUGH the framework.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
- - value: training tokens/sec/chip for GPT-2 small (124M), batch 16 x seq 1024.
- - vs_baseline: measured MFU / 0.40 — the BASELINE.json north star is >=40% MFU
-   ("Ray Train data-parallel GPT-2 at >=40% MFU", the reference's parity
-   standard transplanted to TPU); >1.0 beats the bar.
+Runs the workload twice on the local TPU chip:
+  1. via ``JaxTrainer.fit()`` — a real 1-worker gang (worker actor, backend
+     bring-up, session reporting): the number the framework is judged on;
+  2. the identical bare-jax step loop in a clean subprocess: the native
+     baseline, mirroring the reference's Ray-vs-native parity method
+     (`doc/source/ray-air/benchmarks.rst:178-212` — framework overhead over
+     native DDP must be within noise).
 
-Timing note: through the axon relay, block_until_ready does not synchronize, so
-we force a scalar fetch after a pipelined window of steps (fetch RTT ~75ms is
-amortized over the window).
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} (plus
+diagnostic fields):
+ - value: tokens/sec/chip for GPT-2 small (124M), batch 16 x seq 1024,
+   measured THROUGH JaxTrainer.
+ - vs_baseline: measured MFU / 0.40 — BASELINE.json north star is >=40% MFU.
+ - overhead_pct: (bare - framework) / bare * 100, the parity diagnostic.
+
+Timing note: through the axon relay, block_until_ready does not synchronize,
+so a scalar fetch after a pipelined window of steps forces the sync (fetch RTT
+is amortized over the window).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
-import time
+
+B, S, WARMUP, ITERS = 16, 1024, 3, 20
 
 
-def main() -> None:
+def _timed_tokens_per_sec():
+    """Build GPT-2 small on a DP mesh over all local devices, run the
+    warmup+timed step loop, and return (tokens_per_sec_total, n_devices).
+
+    This exact function body is the workload for BOTH the framework run
+    (inside the Train worker) and the bare-jax subprocess, so the comparison
+    isolates framework overhead from model/compile differences.
+    """
+    import time
+
     import jax
-
     import numpy as np
 
     from ray_tpu.models import (
@@ -29,16 +49,9 @@ def main() -> None:
         default_optimizer,
         make_train_step,
         shard_batch,
-        train_flops_per_token,
     )
     from ray_tpu.parallel import MeshSpec
 
-    # v5e bf16 peak; override for other generations via env if needed.
-    import os
-
-    peak_flops = float(os.environ.get("RAY_TPU_PEAK_FLOPS", 197e12))
-
-    B, S, warmup, iters = 16, 1024, 3, 20
     cfg = GPTConfig.gpt2_small()
     devices = jax.devices()
     mesh = MeshSpec(data=len(devices)).build(devices)
@@ -51,26 +64,91 @@ def main() -> None:
         {"tokens": rng.integers(0, cfg.vocab_size - 1, (B, S + 1)).astype(np.int32)},
         mesh,
     )
-    for _ in range(warmup):
+    for _ in range(WARMUP):
         state, m = step(state, batch)
     _ = float(m["loss"])  # sync
-
     t0 = time.time()
-    for _ in range(iters):
+    for _ in range(ITERS):
         state, m = step(state, batch)
     _ = float(m["loss"])  # sync
-    dt = (time.time() - t0) / iters
+    dt = (time.time() - t0) / ITERS
+    return B * S / dt, len(devices)
 
-    tokens_per_sec = B * S / dt
-    mfu = train_flops_per_token(cfg, S) * B * S / dt / (peak_flops * len(devices))
+
+def _train_loop(config):
+    """The JaxTrainer per-worker loop: run the workload, report throughput."""
+    from ray_tpu.air import session
+
+    tps, n = _timed_tokens_per_sec()
+    session.report({"tokens_per_sec": tps, "n_devices": n})
+
+
+def _framework_run():
+    """tokens/s + device count measured through JaxTrainer.fit()."""
+    import ray_tpu
+    from ray_tpu.air import RunConfig, ScalingConfig
+    from ray_tpu.train.jax import JaxTrainer
+
+    ray_tpu.init()
+    try:
+        trainer = JaxTrainer(
+            _train_loop,
+            scaling_config=ScalingConfig(num_workers=1, use_tpu=True),
+            run_config=RunConfig(name="bench_gpt2"),
+        )
+        result = trainer.fit()
+        if result.error is not None:
+            raise result.error
+        return result.metrics["tokens_per_sec"], int(result.metrics["n_devices"])
+    finally:
+        ray_tpu.shutdown()
+
+
+def _bare_run():
+    """The same workload in a clean subprocess (no framework on the path)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--bare"],
+        stdout=subprocess.PIPE,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"bare baseline subprocess failed rc={proc.returncode}")
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    return out["tokens_per_sec"], out["n_devices"]
+
+
+def main() -> None:
+    from ray_tpu.models import GPTConfig, train_flops_per_token
+
+    # v5e bf16 peak; override for other generations via env.
+    peak_flops = float(os.environ.get("RAY_TPU_PEAK_FLOPS", 197e12))
+
+    fw_tps, n_dev = _framework_run()
+    try:
+        bare_tps, _ = _bare_run()
+    except Exception as e:
+        # Parity diagnostic unavailable; the headline number is still valid.
+        print(f"bare baseline failed: {e!r}", file=sys.stderr)
+        bare_tps = None
+
+    cfg = GPTConfig.gpt2_small()
+    mfu = train_flops_per_token(cfg, S) * fw_tps / (peak_flops * n_dev)
     result = {
-        "metric": "gpt2_small_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec / len(devices), 1),
+        "metric": "gpt2_small_train_tokens_per_sec_per_chip_via_JaxTrainer",
+        "value": round(fw_tps / n_dev, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 3),
     }
+    if bare_tps is not None:
+        result["bare_tokens_per_sec_per_chip"] = round(bare_tps / n_dev, 1)
+        result["overhead_pct"] = round((bare_tps - fw_tps) / bare_tps * 100, 2)
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if "--bare" in sys.argv:
+        tps, n = _timed_tokens_per_sec()
+        print(json.dumps({"tokens_per_sec": tps, "n_devices": n}))
+    else:
+        main()

@@ -30,6 +30,16 @@ def detect_num_tpu_chips() -> int:
                 return int(os.environ[var])
             except ValueError:
                 pass
+    # Tunneled chips (axon relay): one chip per pool endpoint. The device
+    # files live on the far side of the relay, so /dev scanning can't see
+    # them; the pool env var is the passive signal that they exist.
+    pool_ips = [
+        ip
+        for ip in os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")
+        if ip.strip()
+    ]
+    if pool_ips:
+        return len(pool_ips)
     bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS") or os.environ.get(
         "TPU_CHIPS_PER_PROCESS_BOUNDS"
     )

@@ -256,9 +256,11 @@ class Dataset:
         return len(self._input_refs)
 
     # ------------------------------------------------------------- global ops
-    def repartition(self, num_blocks: int) -> "Dataset":
+    def repartition(self, num_blocks: int, *, _sizes: Optional[List[int]] = None) -> "Dataset":
         refs = self._execute()
-        sizes = ray_tpu.get([_remote(_num_rows).remote(r) for r in refs])
+        sizes = _sizes if _sizes is not None else ray_tpu.get(
+            [_remote(_num_rows).remote(r) for r in refs]
+        )
         total = sum(sizes)
         target = [total // num_blocks + (1 if i < total % num_blocks else 0)
                   for i in range(num_blocks)]
@@ -346,13 +348,21 @@ class Dataset:
         return Dataset(refs)
 
     def zip(self, other: "Dataset") -> "Dataset":
-        n_self, n_other = self.count(), other.count()
-        if n_self != n_other:
+        # One size-fetch round per side: validate totals, then reuse the same
+        # sizes for the repartition (avoids re-fetching identical counts).
+        sizes_self = ray_tpu.get(
+            [_remote(_num_rows).remote(r) for r in self._execute()]
+        )
+        sizes_other = ray_tpu.get(
+            [_remote(_num_rows).remote(r) for r in other._execute()]
+        )
+        if sum(sizes_self) != sum(sizes_other):
             raise ValueError(
-                f"zip requires equal row counts: {n_self} vs {n_other}"
+                f"zip requires equal row counts: {sum(sizes_self)} vs "
+                f"{sum(sizes_other)}"
             )
-        a = self.repartition(self.num_blocks())._execute()
-        b = other.repartition(self.num_blocks())._execute()
+        a = self.repartition(self.num_blocks(), _sizes=sizes_self)._execute()
+        b = other.repartition(self.num_blocks(), _sizes=sizes_other)._execute()
         z = _remote(_zip_blocks)
         return Dataset([z.remote(x, y) for x, y in zip(a, b)])
 

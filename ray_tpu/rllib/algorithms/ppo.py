@@ -58,14 +58,29 @@ def compute_gae(
     value targets = advantages + values.
     """
     rewards, values, dones = rollout["rewards"], rollout["values"], rollout["dones"]
+    # Truncation (time limit) is not termination: the advantage chain still
+    # stops at the boundary, but the TD residual bootstraps through
+    # V(final_obs) instead of zero (reference: compute_advantages uses
+    # vf(last_obs) at time-limit cuts). Rollouts lacking the split fall back
+    # to treating every done as terminal.
+    terminateds = rollout.get("terminateds")
+    boot = rollout.get("bootstrap_values")
+    if terminateds is None or boot is None:
+        # Without BOTH the term/trunc split and the final-obs values there is
+        # nothing safe to bootstrap truncations through — treat every done as
+        # terminal rather than leak V(reset_obs) across episode boundaries.
+        terminateds, boot = dones, None
     T = rewards.shape[0]
     adv = np.zeros_like(rewards)
     lastgaelam = np.zeros(rewards.shape[1], np.float32)
     for t in reversed(range(T)):
         next_values = rollout["last_values"] if t == T - 1 else values[t + 1]
-        nonterminal = 1.0 - dones[t]
+        if boot is not None:
+            truncated = dones[t] * (1.0 - terminateds[t])
+            next_values = np.where(truncated > 0, boot[t], next_values)
+        nonterminal = 1.0 - terminateds[t]
         delta = rewards[t] + gamma * next_values * nonterminal - values[t]
-        lastgaelam = delta + gamma * lambda_ * nonterminal * lastgaelam
+        lastgaelam = delta + gamma * lambda_ * (1.0 - dones[t]) * lastgaelam
         adv[t] = lastgaelam
     return {"advantages": adv, "value_targets": adv + values}
 
@@ -190,6 +205,11 @@ class PPO(Algorithm):
         if cfg.num_learners > 1:
             # Each remote learner gets an equal shard of every minibatch.
             mb = max(cfg.num_learners, mb - mb % cfg.num_learners)
+        if mb > B:
+            raise ValueError(
+                f"train batch of {B} rows is smaller than num_learners="
+                f"{cfg.num_learners}; sample more steps per iteration"
+            )
         metrics_acc: List[Dict[str, float]] = []
         rng = np.random.default_rng(cfg.seed + self.iteration)
         mb_per_epoch = 0

@@ -29,9 +29,29 @@ class EnvRunner:
         import gymnasium as gym
         import jax
 
-        self._envs = gym.vector.SyncVectorEnv(
-            [env_creator for _ in range(num_envs)]
-        )
+        # gymnasium >=1.0 defaults vector envs to NEXT_STEP autoreset, where
+        # the step after done ignores the action and returns the reset obs —
+        # recording that row would corrupt the train batch. Pin the classic
+        # SAME_STEP mode (reset obs returned in the done step itself, final
+        # obs in infos); pre-1.0 gymnasium already behaves that way.
+        if hasattr(gym.vector, "AutoresetMode"):
+            self._envs = gym.vector.SyncVectorEnv(
+                [env_creator for _ in range(num_envs)],
+                autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+            )
+        elif int(gym.__version__.split(".")[0]) >= 1:
+            # gymnasium 1.0.x switched the default to NEXT_STEP but only grew
+            # AutoresetMode in 1.1 — building without the kwarg there would
+            # silently corrupt rollouts, so refuse instead.
+            raise RuntimeError(
+                f"gymnasium {gym.__version__} lacks AutoresetMode.SAME_STEP "
+                "but defaults vector envs to NEXT_STEP autoreset, which "
+                "corrupts rollout batches; install gymnasium>=1.1 or <1.0"
+            )
+        else:
+            self._envs = gym.vector.SyncVectorEnv(
+                [env_creator for _ in range(num_envs)]
+            )
         self.module = module
         self.num_envs = num_envs
         self.rollout_length = rollout_length
@@ -60,6 +80,11 @@ class EnvRunner:
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
+        term_buf = np.zeros((T, N), np.float32)
+        # V(final_obs) where an episode hit its time limit: GAE bootstraps
+        # truncated episodes through this value (reference: compute_advantages
+        # bootstraps with vf(last_obs) at time-limit boundaries).
+        boot_buf = np.zeros((T, N), np.float32)
         logits_buf: Optional[np.ndarray] = None
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
@@ -74,10 +99,20 @@ class EnvRunner:
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
-            nxt, rew, term, trunc, _ = self._envs.step(action)
+            nxt, rew, term, trunc, infos = self._envs.step(action)
             done = np.logical_or(term, trunc)
             rew_buf[t] = rew
             done_buf[t] = done.astype(np.float32)
+            term_buf[t] = np.asarray(term, np.float32)
+            trunc_only = np.logical_and(trunc, np.logical_not(term))
+            if trunc_only.any():
+                final_obs = self._final_observations(infos, nxt)
+                self._key, sub = jax.random.split(self._key)
+                _, _, fvals, _ = self._act(
+                    self._params, final_obs.astype(np.float32), sub, False
+                )
+                idx = np.nonzero(trunc_only)[0]
+                boot_buf[t, idx] = np.asarray(fvals, np.float32)[idx]
             self._episode_returns += rew
             self._episode_lengths += 1
             for i in np.nonzero(done)[0]:
@@ -100,8 +135,25 @@ class EnvRunner:
             "values": val_buf,
             "rewards": rew_buf,
             "dones": done_buf,
+            "terminateds": term_buf,
+            "bootstrap_values": boot_buf,
             "last_values": np.asarray(last_val, np.float32),
         }
+
+    def _final_observations(self, infos, nxt: np.ndarray) -> np.ndarray:
+        """Per-env final observations for done envs (SAME_STEP autoreset puts
+        them in infos; fall back to the post-step obs when absent)."""
+        finals = None
+        for key in ("final_obs", "final_observation"):
+            if key in infos:
+                finals = infos[key]
+                break
+        out = np.array(nxt, copy=True)
+        if finals is not None:
+            for i, f in enumerate(finals):
+                if f is not None:
+                    out[i] = f
+        return out
 
     def episode_stats(self, clear: bool = True) -> Dict[str, float]:
         eps = self._completed

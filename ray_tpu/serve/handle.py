@@ -139,6 +139,16 @@ class DeploymentResponse:
         except (RayActorError, WorkerCrashedError):
             if self._request is None or self._replica_id is None:
                 raise
+            # The retry's controller round-trips are not individually bounded;
+            # at minimum don't start them with the caller's budget already
+            # spent.
+            if deadline is not None and time.monotonic() >= deadline:
+                from ray_tpu.exceptions import GetTimeoutError
+
+                raise GetTimeoutError(
+                    f"request to dead replica {self._replica_id} had no "
+                    f"budget left to retry within timeout={timeout}s"
+                )
             self._router.report_failure(self._replica_id)
             method, args, kwargs = self._request
             self.ref, self._replica_id = self._router.route(

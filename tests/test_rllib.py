@@ -144,6 +144,87 @@ def test_gae_matches_manual():
     )
 
 
+def test_gae_truncation_bootstraps_final_value():
+    """A time-limit cut bootstraps through V(final_obs); a termination does
+    not — and neither leaks the advantage chain across the boundary."""
+    from ray_tpu.rllib.algorithms.ppo import compute_gae
+
+    gamma, lam = 0.9, 0.8
+    rewards = np.array([[1.0], [1.0], [1.0]], np.float32)
+    values = np.array([[0.5], [0.4], [0.3]], np.float32)
+    dones = np.array([[0.0], [1.0], [0.0]], np.float32)  # truncated at t=1
+    terminateds = np.zeros((3, 1), np.float32)
+    boot = np.array([[0.0], [2.0], [0.0]], np.float32)  # V(final_obs) at t=1
+    last_values = np.array([0.6], np.float32)
+    out = compute_gae(
+        {
+            "rewards": rewards,
+            "values": values,
+            "dones": dones,
+            "terminateds": terminateds,
+            "bootstrap_values": boot,
+            "last_values": last_values,
+        },
+        gamma,
+        lam,
+    )
+    # t=2 (fragment end, not done): delta2 = 1 + .9*.6 - .3 = 1.24
+    # t=1 (truncated): delta1 = 1 + .9*2.0 - .4 = 2.4; chain resets: adv1 = 2.4
+    # t=0: delta0 = 1 + .9*.4 - .5 = .86; adv0 = .86 + .72*2.4 = 2.588
+    np.testing.assert_allclose(
+        out["advantages"][:, 0], [2.588, 2.4, 1.24], rtol=1e-5
+    )
+    # Terminated instead: the bootstrap is masked to zero.
+    out_term = compute_gae(
+        {
+            "rewards": rewards,
+            "values": values,
+            "dones": dones,
+            "terminateds": dones,
+            "bootstrap_values": boot,
+            "last_values": last_values,
+        },
+        gamma,
+        lam,
+    )
+    # t=1 terminal: delta1 = 1 - .4 = .6
+    np.testing.assert_allclose(out_term["advantages"][1, 0], 0.6, rtol=1e-5)
+
+
+def test_env_runner_no_phantom_autoreset_rows():
+    """gymnasium >=1.0 NEXT_STEP autoreset must not inject reset-step rows:
+    every recorded (obs, action) pair is a real transition, and episode
+    lengths match the env's time limit."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib.core.rl_module import MLPModule
+    from ray_tpu.rllib.env.env_runner import EnvRunner
+
+    def make_env():
+        return gym.make("CartPole-v1", max_episode_steps=10)
+
+    runner = EnvRunner(
+        make_env, MLPModule(4, 2), num_envs=2, rollout_length=35, seed=0
+    )
+    batch = runner.sample()
+    stats = runner.episode_stats()
+    # 2 envs x 35 steps with a 10-step limit -> at least 3 episodes per env
+    # (early pole-fall terminations only make episodes shorter/more).
+    assert stats["episodes"] >= 6
+    # No episode may exceed the time limit: a NEXT_STEP phantom reset row
+    # would stretch the done-to-done gap to 11 (and under-count episodes).
+    for env in range(2):
+        idx = np.nonzero(batch["dones"][:, env])[0]
+        prev = -1
+        for i in idx:
+            assert i - prev <= 10, f"episode of {i - prev} steps exceeds limit"
+            prev = int(i)
+    # Truncations recorded as done-but-not-terminated with a bootstrap value.
+    truncs = (batch["dones"] - batch["terminateds"]) > 0
+    assert truncs.sum() >= 2
+    assert np.all(batch["bootstrap_values"][truncs] != 0.0)
+
+
 def test_ppo_loss_clipping_semantics():
     """The clipped surrogate is flat outside the trust region (reference
     ppo_torch_policy.py loss)."""
