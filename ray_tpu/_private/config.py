@@ -34,6 +34,12 @@ class Config:
     object_store_memory: int = 2 * 1024 * 1024 * 1024
     # LRU-evict sealed-but-unreferenced secondary copies when full.
     object_store_full_delay_ms: int = 100
+    # Ceiling on one inter-node object pull (relay through the head).
+    object_pull_timeout_s: float = 300.0
+    # Testing hook: treat every segment sealed on another node as remote even if
+    # its path happens to be readable (single-machine multi-daemon clusters share
+    # a filesystem), so the inter-node pull path is exercised.
+    force_object_pulls: bool = False
 
     # --- scheduling ---
     # Hybrid policy threshold: pack onto the best node until its utilization

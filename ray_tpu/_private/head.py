@@ -1,0 +1,91 @@
+"""Head server process: GCS + scheduler as a standalone daemon.
+
+The analogue of the reference's `gcs_server` binary + head raylet
+(`/root/reference/src/ray/gcs/gcs_server/gcs_server_main.cc`,
+`python/ray/_private/services.py:1273`): drivers connect with
+`ray_tpu.init(address="HOST:PORT")`, node daemons join over the same port
+(`node_daemon.py`), and the head machine itself is registered as the head node
+so local tasks run in-process-spawned workers (unix-socket fast path).
+
+Run as:  python -m ray_tpu._private.head [--port P] [--host H] [--num-cpus N] ...
+Prints one line on stdout when ready:
+  RAY_TPU_HEAD_READY {"address": ..., "session_dir": ..., "authkey_hex": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import threading
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0, help="TCP port (0 = ephemeral)")
+    parser.add_argument("--host", default="127.0.0.1", help="advertise host")
+    parser.add_argument(
+        "--bind-host",
+        default=None,
+        help="interface to bind (defaults to the advertise host; use 0.0.0.0 for multi-homed heads)",
+    )
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--resources", default="{}", help="extra JSON resource map")
+    parser.add_argument("--system-config", default="{}", help="JSON Config overrides")
+    ns = parser.parse_args()
+
+    from ray_tpu._private.accelerators import tpu as tpu_accel
+    from ray_tpu._private.config import Config, set_config
+    from ray_tpu._private.gcs import GCS
+    from ray_tpu._private.scheduler import Scheduler
+
+    cfg = Config().apply_overrides(json.loads(ns.system_config) or None)
+    set_config(cfg)
+
+    num_cpus = ns.num_cpus if ns.num_cpus is not None else float(max(os.cpu_count() or 1, 4))
+    num_tpus = ns.num_tpus if ns.num_tpus is not None else float(tpu_accel.detect_num_tpu_chips())
+    resources = {"CPU": float(num_cpus), "memory": float(cfg.object_store_memory)}
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    resources.update(json.loads(ns.resources))
+
+    session_dir = os.path.join(
+        "/dev/shm", f"ray_tpu_head_{os.getpid()}_{int(time.time() * 1000)}"
+    )
+    os.makedirs(os.path.join(session_dir, "shm"), exist_ok=True)
+
+    gcs = GCS()
+    scheduler = Scheduler(
+        gcs, cfg, session_dir, tcp_port=ns.port, advertise_host=ns.host, bind_host=ns.bind_host
+    )
+    scheduler.start()
+    scheduler.call("add_node", (resources, {"head": "1"})).result()
+
+    stop = threading.Event()
+
+    def _signal(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _signal)
+    signal.signal(signal.SIGINT, _signal)
+
+    ready = {
+        "address": f"{scheduler.tcp_address[0]}:{scheduler.tcp_address[1]}",
+        "session_dir": session_dir,
+        "authkey_hex": scheduler.authkey.hex(),
+    }
+    print("RAY_TPU_HEAD_READY " + json.dumps(ready), flush=True)
+
+    stop.wait()
+    scheduler.stop()
+    shutil.rmtree(session_dir, ignore_errors=True)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,190 @@
+"""Per-node daemon: the raylet analogue for daemon-managed nodes.
+
+The reference runs a C++ `raylet` per node (`/root/reference/src/ray/raylet/
+main.cc:78`) that leases workers to the cluster scheduler and hosts the local
+plasma store. This daemon keeps that seam with a much smaller surface:
+
+ - registers its node (resources, labels, shm dir) with the head over TCP;
+ - spawns worker processes on ("spawn_worker", ...) commands — workers dial the
+   head directly, the daemon only manages their OS processes;
+ - reports worker exits so the head can retry tasks / restart actors;
+ - serves ("read_object", token, path) segment reads so objects sealed on this
+   node can be pulled by readers elsewhere (the data-plane seam of the
+   reference's `object_manager.cc` push/pull).
+
+Run as: python -m ray_tpu._private.node_daemon --address HOST:PORT --shm-dir D \
+            --resources '{"CPU": 4}' [--labels '{...}'] [--log-dir D]
+Auth rides RAY_TPU_AUTHKEY_HEX, like workers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict
+
+from ray_tpu._private import serialization
+
+
+class NodeDaemon:
+    def __init__(self, head_host: str, head_port: int, shm_dir: str,
+                 resources: Dict[str, float], labels: Dict[str, str], log_dir: str):
+        self.head_host = head_host
+        self.head_port = head_port
+        self.shm_dir = shm_dir
+        self.resources = resources
+        self.labels = labels
+        self.log_dir = log_dir
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.conn = None
+        self.node_id_hex = ""
+
+    def connect(self):
+        from multiprocessing.connection import Client
+
+        authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", ""))
+        self.conn = Client((self.head_host, self.head_port), authkey=authkey)
+        self.conn.send_bytes(
+            serialization.dumps(
+                ("daemon", {"resources": self.resources, "labels": self.labels, "shm_dir": self.shm_dir})
+            )
+        )
+        reply = serialization.loads(self.conn.recv_bytes())
+        if reply[0] != "ok":
+            raise RuntimeError(f"head rejected daemon registration: {reply!r}")
+        self.node_id_hex = reply[1]
+
+    def _send(self, msg) -> bool:
+        with self._lock:
+            try:
+                self.conn.send_bytes(serialization.dumps(msg))
+                return True
+            except (OSError, ValueError, BrokenPipeError):
+                return False
+
+    # ------------------------------------------------------------------ commands
+    def _spawn_worker(self, info: dict):
+        worker_id_hex = info["worker_id_hex"]
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        os.makedirs(self.log_dir, exist_ok=True)
+        out = open(os.path.join(self.log_dir, f"worker-{worker_id_hex[:8]}.log"), "wb")
+        try:
+            popen = subprocess.Popen(
+                [
+                    sys.executable, "-m", "ray_tpu._private.worker_entry",
+                    "--address", f"tcp://{self.head_host}:{self.head_port}",
+                    "--args", info["args_blob"],
+                ],
+                env=env,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                cwd=repo_root,
+            )
+        except OSError as e:
+            self._send(("spawn_failed", worker_id_hex, repr(e)))
+            return
+        finally:
+            out.close()
+        with self._lock:
+            self.procs[worker_id_hex] = popen
+
+    def _kill_worker(self, worker_id_hex: str):
+        with self._lock:
+            popen = self.procs.pop(worker_id_hex, None)
+        if popen is not None:
+            try:
+                popen.kill()
+            except ProcessLookupError:
+                pass
+
+    def _read_object(self, token: int, path: str):
+        # Off-thread: a large segment read must not block spawn/kill commands.
+        def _read():
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+                self._send(("object_data", token, True, data))
+            except OSError as e:
+                self._send(("object_data", token, False, repr(e)))
+
+        threading.Thread(target=_read, daemon=True, name="read-object").start()
+
+    # ------------------------------------------------------------------ loops
+    def _reaper_loop(self):
+        """Report dead worker processes to the head (the raylet's worker-death
+        notification path)."""
+        while not self._stop.is_set():
+            dead = []
+            with self._lock:
+                for wid, popen in list(self.procs.items()):
+                    if popen.poll() is not None:
+                        dead.append(wid)
+                        del self.procs[wid]
+            for wid in dead:
+                self._send(("worker_exit", wid))
+            time.sleep(0.2)
+
+    def serve(self):
+        reaper = threading.Thread(target=self._reaper_loop, daemon=True, name="reaper")
+        reaper.start()
+        try:
+            while True:
+                msg = serialization.loads(self.conn.recv_bytes())
+                kind = msg[0]
+                if kind == "spawn_worker":
+                    self._spawn_worker(msg[1])
+                elif kind == "kill_worker":
+                    self._kill_worker(msg[1])
+                elif kind == "read_object":
+                    self._read_object(msg[1], msg[2])
+                elif kind == "shutdown":
+                    break
+        except (EOFError, OSError):
+            pass  # head gone: tear down
+        finally:
+            self._stop.set()
+            with self._lock:
+                procs = list(self.procs.values())
+                self.procs.clear()
+            for popen in procs:
+                try:
+                    popen.kill()
+                except ProcessLookupError:
+                    pass
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True, help="head TCP address HOST:PORT")
+    parser.add_argument("--shm-dir", required=True)
+    parser.add_argument("--resources", default="{}", help="JSON resource map")
+    parser.add_argument("--labels", default="{}", help="JSON label map")
+    parser.add_argument("--log-dir", default="")
+    ns = parser.parse_args()
+
+    host, _, port = ns.address.rpartition(":")
+    daemon = NodeDaemon(
+        head_host=host,
+        head_port=int(port),
+        shm_dir=ns.shm_dir,
+        resources=json.loads(ns.resources),
+        labels=json.loads(ns.labels),
+        log_dir=ns.log_dir or os.path.join(ns.shm_dir, "..", "logs"),
+    )
+    os.makedirs(ns.shm_dir, exist_ok=True)
+    daemon.connect()
+    print(f"RAY_TPU_NODE_READY {daemon.node_id_hex}", flush=True)
+    daemon.serve()
+
+
+if __name__ == "__main__":
+    main()

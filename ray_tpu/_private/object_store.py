@@ -51,6 +51,10 @@ class ObjectMeta:
     buffer_layout: Optional[List[Tuple[int, int]]] = None
     # Error payloads are stored like inline objects but marked, so `get` re-raises.
     is_error: bool = False
+    # NodeID.binary() of the node whose store holds the segment. Readers on other
+    # nodes use it to route a pull (the analogue of the reference's object
+    # directory, `/root/reference/src/ray/object_manager/ownership_based_object_directory.h`).
+    node_id: Optional[bytes] = None
 
 
 class SharedSegment:
@@ -115,6 +119,40 @@ def write_segment(dir_path: str, object_id: ObjectID, sv: SerializedValue) -> Ob
     )
 
 
+def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn, force_remote: bool) -> ObjectMeta:
+    """Return a meta whose segment is readable from this process, pulling the
+    bytes through `pull_fn(object_key) -> (meta, bytes)` when the segment lives
+    on another node. The single implementation behind every reader path (worker
+    task args, driver get, client-driver get) so pull semantics cannot drift.
+
+    - Same-node (or same-filesystem) segments are used in place: zero-copy.
+    - `force_remote` (Config.force_object_pulls) treats other-node segments as
+      unreadable even on a shared filesystem, to exercise the wire path.
+    - Pulled bytes are cached under the segment's basename in the local store
+      dir; later reads hit the cache instead of re-transferring.
+    """
+    import dataclasses
+
+    if meta.segment is None:
+        return meta
+    remote = force_remote and meta.node_id is not None and meta.node_id != store.node_id
+    if not remote and os.path.exists(meta.segment):
+        return meta
+    local_path = os.path.join(store.shm_dir, os.path.basename(meta.segment))
+    if os.path.exists(local_path):
+        return dataclasses.replace(meta, segment=local_path)
+    fetched, data = pull_fn(meta.object_id.binary())
+    if fetched.segment is None:
+        return fetched  # became inline (e.g. error overwrite)
+    local_path = os.path.join(store.shm_dir, os.path.basename(fetched.segment))
+    if not os.path.exists(local_path):
+        tmp = f"{local_path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data or b"")
+        os.replace(tmp, local_path)
+    return dataclasses.replace(fetched, segment=local_path)
+
+
 class LocalObjectStore:
     """Per-process facade over inline values and shm segments.
 
@@ -122,8 +160,11 @@ class LocalObjectStore:
     deserialized view may reference them; the owner decides when to unlink.
     """
 
-    def __init__(self, shm_dir: str):
+    def __init__(self, shm_dir: str, node_id: Optional[bytes] = None):
         self.shm_dir = shm_dir
+        # Stamped onto every segment-backed meta this process writes, so remote
+        # readers know which node's store to pull from.
+        self.node_id = node_id
         os.makedirs(shm_dir, exist_ok=True)
         self._segments: Dict[str, SharedSegment] = {}
         self._lock = threading.Lock()
@@ -137,7 +178,9 @@ class LocalObjectStore:
                 inband=sv.inband,
                 inline_buffers=[bytes(b) for b in sv.buffers],
             )
-        return write_segment(self.shm_dir, object_id, sv)
+        meta = write_segment(self.shm_dir, object_id, sv)
+        meta.node_id = self.node_id
+        return meta
 
     def put(self, object_id: ObjectID, value, inline_threshold: int) -> ObjectMeta:
         return self.put_serialized(object_id, serialize(value), inline_threshold)

@@ -76,6 +76,77 @@ class _Proc:
             pass
 
 
+class _RemoteProc:
+    """Process surface for a worker living on a daemon-managed node. Liveness is
+    driven by the daemon's ("worker_exit", ...) notifications rather than local
+    polling; terminate() relays a kill to the daemon."""
+
+    def __init__(self, daemon: "DaemonHandle", worker_id_hex: str):
+        self._daemon = daemon
+        self._worker_id_hex = worker_id_hex
+        self._alive = True
+
+    @property
+    def pid(self) -> int:
+        return -1
+
+    def is_alive(self) -> bool:
+        return self._alive
+
+    def mark_dead(self) -> None:
+        self._alive = False
+
+    def terminate(self) -> None:
+        self._alive = False
+        self._daemon.send(("kill_worker", self._worker_id_hex))
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        pass
+
+
+class _ConnSender:
+    """Shared locked-send over a multiprocessing connection."""
+
+    def __init__(self, conn):
+        self.conn = conn
+        self._send_lock = threading.Lock()
+
+    def send(self, msg) -> bool:
+        data = serialization.dumps(msg)
+        with self._send_lock:
+            try:
+                self.conn.send_bytes(data)
+                return True
+            except (OSError, ValueError, BrokenPipeError):
+                return False
+
+
+class DaemonHandle(_ConnSender):
+    """Control connection to a per-node daemon process (the raylet analogue,
+    `/root/reference/src/ray/raylet/main.cc:78`): spawns workers on its machine,
+    reports their exits, and serves shm-segment reads for object pulls."""
+
+    def __init__(self, node_id: NodeID, conn):
+        super().__init__(conn)
+        self.node_id = node_id
+
+
+class DriverHandle(_ConnSender):
+    """Connection from a driver process in client mode (`init(address=...)`).
+    Quacks enough like a WorkerHandle for the shared `_req_*` handlers: it has
+    `send`, a non-"busy" `state`, and a function cache."""
+
+    def __init__(self, conn, pull_node_id: Optional[bytes]):
+        super().__init__(conn)
+        self.state = "driver"
+        self.node_id: Optional[NodeID] = None
+        self.current_task: Optional[TaskID] = None
+        self.known_functions: set = set()
+        # Pseudo-node id under which this driver's shm segments are published;
+        # pulls for it route back over this connection.
+        self.pull_node_id = pull_node_id
+
+
 @dataclass
 class WorkerHandle:
     worker_id: WorkerID
@@ -130,6 +201,9 @@ class NodeState:
     workers: Dict[WorkerID, WorkerHandle] = field(default_factory=dict)
     idle: List[WorkerID] = field(default_factory=list)
     alive: bool = True
+    # Set for nodes backed by a separate daemon process; None for the head's
+    # in-process node and virtual test nodes.
+    daemon: Optional[DaemonHandle] = None
 
     def utilization(self) -> float:
         total = sum(v for v in self.resources.values() if v > 0) or 1.0
@@ -206,7 +280,15 @@ def _release(avail: Dict[str, float], req: Dict[str, float]) -> None:
 
 
 class Scheduler:
-    def __init__(self, gcs: GCS, config: Config, session_dir: str):
+    def __init__(
+        self,
+        gcs: GCS,
+        config: Config,
+        session_dir: str,
+        tcp_port: int = 0,
+        advertise_host: str = "127.0.0.1",
+        bind_host: Optional[str] = None,
+    ):
         self.gcs = gcs
         self.config = config
         self.session_dir = session_dir
@@ -223,36 +305,72 @@ class Scheduler:
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._conn_to_worker: Dict[Any, WorkerHandle] = {}
+        self._conn_to_daemon: Dict[Any, DaemonHandle] = {}
+        self._conn_to_driver: Dict[Any, DriverHandle] = {}
         self._workers_by_id: Dict[str, WorkerHandle] = {}
+        # Object-pull plumbing: node_id bytes -> connection that can read that
+        # node's segments; outstanding reads keyed by token.
+        self._pull_sources: Dict[bytes, _ConnSender] = {}
+        self._pending_pulls: Dict[int, Tuple[Callable[[bool, Any], None], ObjectMeta]] = {}
+        self._pull_token = 0
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._acceptor: Optional[threading.Thread] = None
+        self._acceptors: List[threading.Thread] = []
         self._rr_counter = 0
-        self._authkey = os.urandom(16)
+        env_key = os.environ.get("RAY_TPU_AUTHKEY_HEX")
+        self._authkey = bytes.fromhex(env_key) if env_key else os.urandom(16)
         self._sock_path = os.path.join(session_dir, "worker.sock")
         from multiprocessing.connection import Listener
 
         self._listener = Listener(self._sock_path, family="AF_UNIX", authkey=self._authkey)
+        # TCP listener: node daemons, remote workers, and client-mode drivers
+        # dial this (the analogue of the reference's gRPC ports). Bound to the
+        # advertise host (loopback by default) so a plain single-machine
+        # `init()` never exposes a network port; multi-host heads pass their
+        # reachable interface explicitly.
+        self._tcp_listener = Listener(
+            (bind_host if bind_host is not None else advertise_host, tcp_port),
+            family="AF_INET",
+            authkey=self._authkey,
+        )
+        self.tcp_address = (advertise_host, self._tcp_listener.address[1])
+
+    @property
+    def authkey(self) -> bytes:
+        return self._authkey
 
     # ------------------------------------------------------------------ lifecycle
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True, name="scheduler")
         self._thread.start()
-        self._acceptor = threading.Thread(target=self._accept_loop, daemon=True, name="acceptor")
-        self._acceptor.start()
+        for name, listener in (("acceptor-unix", self._listener), ("acceptor-tcp", self._tcp_listener)):
+            t = threading.Thread(target=self._accept_loop, args=(listener,), daemon=True, name=name)
+            t.start()
+            self._acceptors.append(t)
 
-    def _accept_loop(self):
-        """Accept worker connect-backs (workers are subprocesses of
-        `worker_entry.py`, which dial the unix socket on startup)."""
+    def _accept_loop(self, listener):
+        """Accept connect-backs. The first message identifies the peer:
+        ("worker", worker_id_hex) | ("daemon", info) | ("driver", info)."""
         while not self._stopped.is_set():
             try:
-                conn = self._listener.accept()
-                worker_id_hex = conn.recv_bytes().decode()
+                conn = listener.accept()
+                hello = serialization.loads(conn.recv_bytes())
             except (OSError, EOFError, Exception):
                 if self._stopped.is_set():
                     return
                 continue
-            self.call("attach_worker", (worker_id_hex, conn))
+            kind = hello[0]
+            if kind == "worker":
+                self.call("attach_worker", (hello[1], conn))
+            elif kind == "daemon":
+                self.call("attach_daemon", (hello[1], conn))
+            elif kind == "driver":
+                self.call("attach_driver", (hello[1], conn))
+            else:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def _cmd_attach_worker(self, payload):
         worker_id_hex, conn = payload
@@ -269,6 +387,82 @@ class Scheduler:
         self._conn_to_worker[conn] = wh
         return True
 
+    def _cmd_attach_daemon(self, payload):
+        """A node daemon registered: create a real node backed by it (the seam
+        the reference crosses in `services.py:1346` when a raylet starts)."""
+        info, conn = payload
+        node_id = NodeID.from_random()
+        resources = dict(info["resources"])
+        node = NodeState(
+            node_id=node_id,
+            resources=resources,
+            available=dict(resources),
+            shm_dir=info["shm_dir"],
+            labels=dict(info.get("labels") or {}),
+        )
+        daemon = DaemonHandle(node_id, conn)
+        node.daemon = daemon
+        self.nodes[node_id] = node
+        self.node_order.append(node_id)
+        self._conn_to_daemon[conn] = daemon
+        self._pull_sources[node_id.binary()] = daemon
+        daemon.send(("ok", node_id.hex()))
+        return node_id
+
+    def _cmd_attach_driver(self, payload):
+        info, conn = payload
+        pull_hex = info.get("pull_node_id")
+        dh = DriverHandle(conn, bytes.fromhex(pull_hex) if pull_hex else None)
+        self._conn_to_driver[conn] = dh
+        if dh.pull_node_id:
+            self._pull_sources[dh.pull_node_id] = dh
+        head = self.nodes.get(self.node_order[0]) if self.node_order else None
+        dh.send(
+            (
+                "ok",
+                {
+                    "session_dir": self.session_dir,
+                    "shm_dir": head.shm_dir if head else os.path.join(self.session_dir, "shm"),
+                    "head_node_id": head.node_id.hex() if head else "",
+                    "config": self.config,
+                },
+            )
+        )
+        return True
+
+    def _on_daemon_death(self, daemon: DaemonHandle):
+        self._conn_to_daemon.pop(daemon.conn, None)
+        self._pull_sources.pop(daemon.node_id.binary(), None)
+        self._fail_pulls_from(daemon.node_id.binary())
+        try:
+            daemon.conn.close()
+        except OSError:
+            pass
+        node = self.nodes.get(daemon.node_id)
+        if node is not None:
+            for wh in list(node.workers.values()):
+                if isinstance(wh.process, _RemoteProc):
+                    wh.process.mark_dead()
+            self._cmd_remove_node(daemon.node_id)
+
+    def _on_driver_death(self, dh: DriverHandle):
+        self._conn_to_driver.pop(dh.conn, None)
+        if dh.pull_node_id:
+            self._pull_sources.pop(dh.pull_node_id, None)
+            self._fail_pulls_from(dh.pull_node_id)
+        try:
+            dh.conn.close()
+        except OSError:
+            pass
+
+    def _fail_pulls_from(self, source_node_id: bytes):
+        """Fail outstanding pulls whose source just died, so readers error out
+        instead of hanging on a response that will never arrive."""
+        for token, (respond, meta) in list(self._pending_pulls.items()):
+            if meta.node_id == source_node_id:
+                del self._pending_pulls[token]
+                respond(False, ConnectionError("object source node died during pull"))
+
     def stop(self):
         fut = self.call("_stop", None)
         try:
@@ -276,10 +470,11 @@ class Scheduler:
         except Exception:
             pass
         self._stopped.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        for listener in (self._listener, self._tcp_listener):
+            try:
+                listener.close()
+            except OSError:
+                pass
         self._wake()
         if self._thread:
             self._thread.join(timeout=5)
@@ -303,9 +498,12 @@ class Scheduler:
 
         last_health_check = time.time()
         while not self._stopped.is_set():
-            waitables = [self._wake_r] + [
-                w.conn for n in self.nodes.values() for w in n.workers.values() if w.conn is not None
-            ]
+            waitables = (
+                [self._wake_r]
+                + [w.conn for n in self.nodes.values() for w in n.workers.values() if w.conn is not None]
+                + list(self._conn_to_daemon)
+                + list(self._conn_to_driver)
+            )
             try:
                 ready = mpc.wait(waitables, timeout=0.25)
             except OSError:
@@ -327,9 +525,16 @@ class Scheduler:
                         pass
                     continue
                 wh = self._conn_to_worker.get(obj)
-                if wh is None:
+                if wh is not None:
+                    self._drain_worker(wh)
                     continue
-                self._drain_worker(wh)
+                daemon = self._conn_to_daemon.get(obj)
+                if daemon is not None:
+                    self._drain_daemon(daemon)
+                    continue
+                dh = self._conn_to_driver.get(obj)
+                if dh is not None:
+                    self._drain_driver(dh)
             # Drain commands.
             while True:
                 try:
@@ -365,8 +570,48 @@ class Scheduler:
         except (EOFError, OSError):
             self._on_worker_death(wh)
 
+    def _drain_daemon(self, daemon: DaemonHandle):
+        try:
+            while daemon.conn.poll():
+                msg = serialization.loads(daemon.conn.recv_bytes())
+                self._on_daemon_message(daemon, msg)
+        except (EOFError, OSError):
+            self._on_daemon_death(daemon)
+
+    def _on_daemon_message(self, daemon: DaemonHandle, msg):
+        kind = msg[0]
+        if kind == "worker_exit" or kind == "spawn_failed":
+            wh = self._workers_by_id.get(msg[1])
+            if wh is not None and isinstance(wh.process, _RemoteProc):
+                wh.process.mark_dead()
+                # If the worker never connected back, its EOF will never arrive:
+                # reap it here. Connected workers are reaped via conn EOF.
+                if wh.conn is None:
+                    self._on_worker_death(wh)
+        elif kind == "object_data":
+            _, token, ok, data = msg
+            self._finish_pull(token, ok, data)
+        elif kind == "heartbeat":
+            pass
+
+    def _drain_driver(self, dh: DriverHandle):
+        try:
+            while dh.conn.poll():
+                msg = serialization.loads(dh.conn.recv_bytes())
+                kind = msg[0]
+                if kind == "req":
+                    _, req_id, method, payload = msg
+                    self._on_worker_request(dh, req_id, method, payload)
+                elif kind == "object_data":
+                    _, token, ok, data = msg
+                    self._finish_pull(token, ok, data)
+        except (EOFError, OSError):
+            self._on_driver_death(dh)
+
     def _shutdown_workers(self):
         for node in self.nodes.values():
+            if node.daemon is not None:
+                node.daemon.send(("shutdown",))
             for wh in list(node.workers.values()):
                 wh.send(("shutdown",))
         deadline = time.time() + 2.0
@@ -400,6 +645,14 @@ class Scheduler:
         if node is None:
             return False
         node.alive = False
+        if node.daemon is not None:
+            node.daemon.send(("shutdown",))
+            self._conn_to_daemon.pop(node.daemon.conn, None)
+            self._pull_sources.pop(node_id.binary(), None)
+            try:
+                node.daemon.conn.close()
+            except OSError:
+                pass
         for wh in list(node.workers.values()):
             try:
                 wh.process.terminate()
@@ -448,6 +701,8 @@ class Scheduler:
     # ------------------------------------------------------------------ workers
     def _spawn_worker(self, node: NodeState, actor_id: Optional[ActorID] = None,
                       env_vars: Optional[Dict[str, str]] = None) -> WorkerHandle:
+        if node.daemon is not None:
+            return self._spawn_remote_worker(node, actor_id, env_vars)
         worker_id = WorkerID.from_random()
         args = WorkerArgs(
             worker_id_hex=worker_id.hex(),
@@ -487,6 +742,39 @@ class Scheduler:
         self._workers_by_id[worker_id.hex()] = wh
         if actor_id is None:
             node.idle.append(worker_id)
+        return wh
+
+    def _spawn_remote_worker(self, node: NodeState, actor_id: Optional[ActorID],
+                             env_vars: Optional[Dict[str, str]]) -> WorkerHandle:
+        """Lease a worker on a daemon-managed node: the daemon execs the worker
+        process, which dials back over TCP (reference: raylet WorkerPool start,
+        `/root/reference/src/ray/raylet/worker_pool.h:77`)."""
+        worker_id = WorkerID.from_random()
+        args = WorkerArgs(
+            worker_id_hex=worker_id.hex(),
+            node_id_hex=node.node_id.hex(),
+            shm_dir=node.shm_dir,
+            session_name=os.path.basename(self.session_dir),
+            config=self.config,
+            env_vars=env_vars or {},
+            is_actor_worker=actor_id is not None,
+        )
+        wh = WorkerHandle(
+            worker_id=worker_id,
+            node_id=node.node_id,
+            process=_RemoteProc(node.daemon, worker_id.hex()),
+            state="idle" if actor_id is None else "busy",
+            actor_id=actor_id,
+        )
+        node.workers[worker_id] = wh
+        self._workers_by_id[worker_id.hex()] = wh
+        if actor_id is None:
+            node.idle.append(worker_id)
+        blob = base64.b64encode(pickle.dumps(args)).decode()
+        if not node.daemon.send(("spawn_worker", {"worker_id_hex": worker_id.hex(), "args_blob": blob})):
+            # Daemon unreachable: the health/reap path collects this handle and
+            # the daemon-EOF path removes the node.
+            wh.process.mark_dead()
         return wh
 
     def _on_worker_death(self, wh: WorkerHandle):
@@ -945,6 +1233,91 @@ class Scheduler:
 
     def _req_cluster_resources(self, wh: WorkerHandle, req_id: int, _):
         self._respond(wh, req_id, True, self._cmd_cluster_resources(None))
+
+    # Simple synchronous commands a client-mode driver may invoke over its
+    # connection (the in-process driver calls _cmd_* directly).
+    _DRIVER_CMDS = frozenset(
+        {
+            "free", "register_function", "remove_pg", "cancel", "task_events",
+            "list_actors", "get_nodes", "add_node", "remove_node",
+        }
+    )
+
+    def _req_driver_cmd(self, wh, req_id: int, payload):
+        name, arg = payload
+        if name not in self._DRIVER_CMDS:
+            self._respond(wh, req_id, False, ValueError(f"not a driver command: {name}"))
+            return
+        self._respond(wh, req_id, True, getattr(self, "_cmd_" + name)(arg))
+
+    # ------------------------------------------------------------------ object pulls
+    def _req_pull_object(self, wh, req_id: int, object_key: bytes):
+        """A reader is missing a sealed object's segment locally: relay the bytes
+        from whichever node (daemon or client driver) holds them. The 2-hop relay
+        keeps round 2 simple; a direct node-to-node data plane can replace it
+        behind this request without touching callers (reference pulls peer-direct:
+        `/root/reference/src/ray/object_manager/object_manager.cc`)."""
+
+        def respond(ok: bool, payload):
+            self._respond(wh, req_id, ok, payload)
+
+        self._pull_object(object_key, respond)
+
+    def _cmd_pull_object(self, payload):
+        object_key, fut = payload
+
+        def respond(ok: bool, result):
+            if fut.done():
+                return
+            if ok:
+                fut.set_result(result)
+            else:
+                fut.set_exception(result if isinstance(result, BaseException) else OSError(str(result)))
+
+        self._pull_object(object_key, respond)
+        return _ASYNC
+
+    def _pull_object(self, object_key: bytes, respond: Callable[[bool, Any], None]):
+        meta = self.object_table.get(object_key)
+        if meta is None:
+            respond(False, KeyError("object is not sealed in the object table"))
+            return
+        if meta.segment is None:
+            respond(True, (meta, None))
+            return
+        source = self._pull_sources.get(meta.node_id or b"")
+        if source is None:
+            # Head-local: virtual nodes and the head node share the head's shm
+            # dir, so the segment is directly readable here. Read off-thread —
+            # a multi-GB read must not stall the scheduling loop (responses are
+            # lock-protected sends, safe from other threads).
+            def _read_and_respond():
+                try:
+                    with open(meta.segment, "rb") as f:
+                        data = f.read()
+                except OSError as e:
+                    respond(False, e)
+                    return
+                respond(True, (meta, data))
+
+            threading.Thread(target=_read_and_respond, daemon=True, name="pull-read").start()
+            return
+        self._pull_token += 1
+        token = self._pull_token
+        self._pending_pulls[token] = (respond, meta)
+        if not source.send(("read_object", token, meta.segment)):
+            self._pending_pulls.pop(token, None)
+            respond(False, ConnectionError("object source node is unreachable"))
+
+    def _finish_pull(self, token: int, ok: bool, data):
+        ent = self._pending_pulls.pop(token, None)
+        if ent is None:
+            return
+        respond, meta = ent
+        if ok:
+            respond(True, (meta, data))
+        else:
+            respond(False, OSError(f"remote segment read failed: {data}"))
 
     def _mark_blocked(self, wh: WorkerHandle):
         """Release the CPU held by the task running on `wh` while it blocks in

@@ -102,6 +102,7 @@ class _WorkerState:
         self._task_counter = 0
         self._lock = threading.Lock()
         self.namespace: str = "default"
+        self._client_tmp_dir: Optional[str] = None
 
     def next_put_id(self) -> ObjectID:
         with self._lock:
@@ -201,6 +202,158 @@ class DriverContext:
     def list_actors(self):
         return self.scheduler.call("list_actors", None).result()
 
+    def free(self, ids: List[bytes]):
+        return self.scheduler.call("free", ids).result()
+
+    def cancel(self, task_id, force: bool):
+        return self.scheduler.call("cancel", (task_id, force)).result()
+
+    def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
+        from ray_tpu._private.object_store import resolve_for_read
+
+        def pull(key: bytes):
+            # Segment lives on a daemon node of a different machine: pull
+            # through the head into this process's store dir.
+            inner: concurrent.futures.Future = concurrent.futures.Future()
+            self.scheduler.call("pull_object", (key, inner)).result()
+            try:
+                return inner.result(timeout=get_config().object_pull_timeout_s)
+            except concurrent.futures.TimeoutError:
+                raise exceptions.GetTimeoutError(
+                    f"object pull timed out after {get_config().object_pull_timeout_s}s"
+                ) from None
+
+        return resolve_for_read(
+            global_worker.store, meta, pull, get_config().force_object_pulls
+        )
+
+
+class RemoteDriverContext:
+    """Driver in client mode: `init(address=...)` against a head server process
+    (the analogue of connecting to an existing cluster in the reference,
+    `_private/worker.py:1115` with address="auto"). Speaks the same req/resp
+    protocol workers use, plus it serves "read_object" pulls for objects this
+    driver put into its own store dir (remote-driver case)."""
+
+    def __init__(self, wc, head_address: str):
+        self.wc = wc  # worker_main.WorkerConnection over the TCP conn
+        self.head_address = head_address
+        wc.misc_handler = self._on_misc
+
+    def _on_misc(self, msg):
+        if msg[0] == "read_object":
+            _, token, path = msg
+
+            def _read():
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                    self.wc.send(("object_data", token, True, data))
+                except OSError as e:
+                    self.wc.send(("object_data", token, False, repr(e)))
+
+            threading.Thread(target=_read, daemon=True).start()
+
+    def close(self):
+        try:
+            self.wc.conn.close()
+        except OSError:
+            pass
+
+    # --- core ops (worker-style req/resp) ---
+    def submit(self, rec):
+        self.wc.request("submit", rec)
+
+    def submit_actor_task(self, req: ExecRequest):
+        self.wc.request("submit_actor_task", req)
+
+    def create_actor(self, payload):
+        self.wc.request("create_actor", payload)
+
+    def get_metas(self, ids, timeout):
+        try:
+            return self.wc.request("get_metas", ids, timeout=timeout)
+        except TimeoutError:
+            raise exceptions.GetTimeoutError(f"get() timed out after {timeout}s") from None
+
+    def wait(self, ids, num_returns, timeout):
+        try:
+            return self.wc.request("wait", (ids, num_returns), timeout=timeout)
+        except TimeoutError:
+            peeked = self.wc.request("peek_metas", ids)
+            return list(peeked.keys())
+
+    def put_meta(self, meta):
+        self.wc.request("put_meta", meta)
+
+    def kv(self, op, *args):
+        return self.wc.request("kv", (op, args))
+
+    def get_actor_by_name(self, name):
+        return self.wc.request("get_actor_by_name", name)
+
+    def kill_actor(self, actor_id, no_restart):
+        return self.wc.request("kill_actor", (actor_id, no_restart))
+
+    def register_function(self, function_id, blob):
+        return self.wc.request("driver_cmd", ("register_function", (function_id, blob)))
+
+    def create_pg(self, pg_record):
+        return self.wc.request("create_pg", pg_record)
+
+    def pg_ready(self, pg_id, timeout):
+        try:
+            return self.wc.request("pg_ready", pg_id, timeout=timeout)
+        except TimeoutError:
+            return False
+
+    def remove_pg(self, pg_id):
+        return self.wc.request("driver_cmd", ("remove_pg", pg_id))
+
+    def available_resources(self):
+        return self.wc.request("available_resources", None)
+
+    def cluster_resources(self):
+        return self.wc.request("cluster_resources", None)
+
+    def nodes(self):
+        return self.wc.request("driver_cmd", ("get_nodes", None))
+
+    def task_events(self):
+        return self.wc.request("driver_cmd", ("task_events", None))
+
+    def list_actors(self):
+        return self.wc.request("driver_cmd", ("list_actors", None))
+
+    def free(self, ids):
+        return self.wc.request("driver_cmd", ("free", ids))
+
+    def cancel(self, task_id, force: bool):
+        return self.wc.request("driver_cmd", ("cancel", (task_id, force)))
+
+    def add_node(self, payload):
+        return self.wc.request("driver_cmd", ("add_node", payload))
+
+    def remove_node(self, node_id):
+        return self.wc.request("driver_cmd", ("remove_node", node_id))
+
+    def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
+        from ray_tpu._private.object_store import resolve_for_read
+
+        def pull(key: bytes):
+            try:
+                return self.wc.request(
+                    "pull_object", key, timeout=get_config().object_pull_timeout_s
+                )
+            except TimeoutError:
+                raise exceptions.GetTimeoutError(
+                    f"object pull timed out after {get_config().object_pull_timeout_s}s"
+                ) from None
+
+        return resolve_for_read(
+            global_worker.store, meta, pull, get_config().force_object_pulls
+        )
+
 
 class WorkerProcContext:
     """Context bound inside a worker process; all ops go over the pipe."""
@@ -273,6 +426,15 @@ class WorkerProcContext:
 
     def list_actors(self):
         return []
+
+    def free(self, ids):
+        return []
+
+    def cancel(self, task_id, force: bool):
+        return self.rt.wc.request("driver_cmd", ("cancel", (task_id, force)))
+
+    def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
+        return self.rt.ensure_local(meta)
 
 
 def _connect_worker_process(runtime):
@@ -359,6 +521,9 @@ def init(
             return RuntimeContext()
         raise RuntimeError("ray_tpu.init() called twice; use ignore_reinit_error=True")
 
+    if address is not None:
+        return _init_client_mode(address, namespace=namespace)
+
     cfg = Config().apply_overrides(_system_config)
     set_config(cfg)
 
@@ -383,15 +548,68 @@ def init(
     gcs = GCS()
     scheduler = Scheduler(gcs, cfg, session_dir)
     scheduler.start()
-    scheduler.call("add_node", (node_resources, {"head": "1"})).result()
+    head_node_id = scheduler.call("add_node", (node_resources, {"head": "1"})).result()
 
     global_worker.mode = DRIVER_MODE
     global_worker.job_id = JobID.from_int(1)
     global_worker.session_dir = session_dir
-    global_worker.store = LocalObjectStore(os.path.join(session_dir, "shm"))
+    global_worker.store = LocalObjectStore(
+        os.path.join(session_dir, "shm"), node_id=head_node_id.binary()
+    )
     global_worker.context = DriverContext(scheduler)
     global_worker.namespace = namespace or "default"
     global_worker.node = scheduler
+
+    atexit.register(_atexit_shutdown)
+    return RuntimeContext()
+
+
+def _init_client_mode(address: str, namespace: Optional[str]):
+    """Connect this driver to an existing head server over TCP (`head.py`).
+    The head's authkey must be in RAY_TPU_AUTHKEY_HEX (printed by the head on
+    startup; `cluster_utils.Cluster(real=True)` wires it automatically)."""
+    import tempfile
+
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.worker_main import WorkerConnection
+    from ray_tpu._private.worker_entry import dial
+
+    if not address.startswith("tcp://"):
+        address = "tcp://" + address
+    authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", ""))
+    conn = dial(address, authkey)
+    pull_node_id = NodeID.from_random()
+    conn.send_bytes(serialization.dumps(("driver", {"pull_node_id": pull_node_id.hex()})))
+    reply = serialization.loads(conn.recv_bytes())
+    if reply[0] != "ok":
+        raise ConnectionError(f"head rejected driver connection: {reply!r}")
+    info = reply[1]
+    set_config(info["config"])
+
+    wc = WorkerConnection(conn)
+    ctx = RemoteDriverContext(wc, address)
+    reader = threading.Thread(target=wc.reader_loop, daemon=True, name="driver-reader")
+    reader.start()
+
+    head_shm = info["shm_dir"]
+    if os.path.isdir(head_shm):
+        # Colocated with the head: write into the head node's store directly so
+        # its workers read our objects zero-copy.
+        store = LocalObjectStore(head_shm, node_id=bytes.fromhex(info["head_node_id"]) or None)
+        own_dir = None
+    else:
+        # Remote driver: own store dir; head routes pulls back over this conn.
+        own_dir = tempfile.mkdtemp(prefix="ray_tpu_driver_")
+        store = LocalObjectStore(own_dir, node_id=pull_node_id.binary())
+
+    global_worker.mode = DRIVER_MODE
+    global_worker.job_id = JobID.from_int(1)
+    global_worker.session_dir = None  # owned by the head, not us
+    global_worker.store = store
+    global_worker.context = ctx
+    global_worker.namespace = namespace or "default"
+    global_worker.node = None
+    global_worker._client_tmp_dir = own_dir
 
     atexit.register(_atexit_shutdown)
     return RuntimeContext()
@@ -409,15 +627,24 @@ def shutdown():
     if global_worker.mode is None:
         return
     if global_worker.mode == DRIVER_MODE:
-        ctx: DriverContext = global_worker.context
-        try:
-            ctx.scheduler.stop()
-        except Exception:
-            pass
-        if global_worker.store is not None:
-            global_worker.store.detach_all()
-        if global_worker.session_dir:
-            shutil.rmtree(global_worker.session_dir, ignore_errors=True)
+        ctx = global_worker.context
+        if isinstance(ctx, RemoteDriverContext):
+            # Client mode: leave the head (and its session dir) running.
+            ctx.close()
+            if global_worker.store is not None:
+                global_worker.store.detach_all()
+            tmp = getattr(global_worker, "_client_tmp_dir", None)
+            if tmp:
+                shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            try:
+                ctx.scheduler.stop()
+            except Exception:
+                pass
+            if global_worker.store is not None:
+                global_worker.store.detach_all()
+            if global_worker.session_dir:
+                shutil.rmtree(global_worker.session_dir, ignore_errors=True)
     global_worker.mode = None
     global_worker.context = None
     global_worker.store = None
@@ -455,7 +682,7 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
     metas = global_worker.context.get_metas(ids, timeout)
     values = []
     for meta in metas:
-        value = global_worker.store.get(meta)
+        value = global_worker.store.get(global_worker.context.ensure_local(meta))
         if meta.is_error:
             if isinstance(value, exceptions.RayTaskError):
                 raise value.as_instanceof_cause()
@@ -497,14 +724,10 @@ def kill(actor, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    """Best-effort cancellation of a pending task."""
-    # Round-1 subset: pending tasks are dropped; running tasks are only killed
-    # with force=True (worker process is terminated, no retry).
-    ctx = global_worker.context
-    if isinstance(ctx, DriverContext):
-        ctx.scheduler.call("cancel", (ref.task_id, force)).result()
-    else:
-        raise NotImplementedError("cancel() from inside tasks lands in round 2")
+    """Best-effort cancellation of a pending task (reference: `worker.py:2674`).
+    Pending tasks are dropped; running non-actor tasks are killed with
+    force=True. Works from the driver and from inside tasks/actors."""
+    global_worker.context.cancel(ref.task_id, force)
 
 
 def get_actor(name: str, namespace: Optional[str] = None):

@@ -49,6 +49,14 @@ class WorkerConnection:
         self._pending: Dict[int, "queue.SimpleQueue"] = {}
         self.task_queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._closed = threading.Event()
+        # Hook for message kinds beyond exec/resp/shutdown (e.g. a client-mode
+        # driver serving "read_object" pulls for objects it put).
+        self.misc_handler = None
+        # Worker processes die with their control connection: once the head is
+        # unreachable nothing can collect results, and a task stuck in user code
+        # (e.g. a long sleep) would otherwise outlive its node daemon forever.
+        # Drivers leave this False — an EOF there surfaces as request errors.
+        self.exit_on_eof = False
 
     def send(self, msg) -> None:
         with self._send_lock:
@@ -89,8 +97,11 @@ class WorkerConnection:
                 elif kind == "shutdown":
                     self.task_queue.put(None)
                     return
+                elif self.misc_handler is not None:
+                    self.misc_handler(msg)
         except (EOFError, OSError):
-            pass
+            if self.exit_on_eof:
+                os._exit(1)
         finally:
             self._closed.set()
             self.task_queue.put(None)
@@ -107,7 +118,7 @@ class WorkerRuntime:
     def __init__(self, args: WorkerArgs, wc: WorkerConnection):
         self.args = args
         self.wc = wc
-        self.store = LocalObjectStore(args.shm_dir)
+        self.store = LocalObjectStore(args.shm_dir, node_id=bytes.fromhex(args.node_id_hex))
         self.functions: Dict[str, Any] = {}
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
@@ -118,6 +129,19 @@ class WorkerRuntime:
     def next_put_index(self) -> int:
         self._put_counter += 1
         return self._put_counter
+
+    def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
+        """Make a segment-backed object readable on this node, pulling the bytes
+        from the owning node through the head if the path is not present (the
+        reader-side of the reference's PullManager, `pull_manager.h:52`)."""
+        from ray_tpu._private.object_store import resolve_for_read
+
+        def pull(key: bytes):
+            return self.wc.request(
+                "pull_object", key, timeout=self.args.config.object_pull_timeout_s
+            )
+
+        return resolve_for_read(self.store, meta, pull, self.args.config.force_object_pulls)
 
     def load_function(self, function_id: str, blob: Optional[bytes]):
         fn = self.functions.get(function_id)
@@ -141,8 +165,8 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
     for k, v in spec.env_vars.items():
         os.environ[k] = v
     try:
-        args = [rt.store.get(m) for m in req.arg_metas]
-        kwargs = {k: rt.store.get(m) for k, m in req.kwarg_metas.items()}
+        args = [rt.store.get(rt.ensure_local(m)) for m in req.arg_metas]
+        kwargs = {k: rt.store.get(rt.ensure_local(m)) for k, m in req.kwarg_metas.items()}
         # Resolve any ObjectRefs that arrived as *resolved values already* — the
         # driver substitutes top-level refs with their value metas, so nothing to
         # do here; nested refs were rebuilt by the unpickler as live ObjectRefs.
@@ -216,6 +240,7 @@ def worker_loop(conn, args: WorkerArgs):
     for k, v in args.env_vars.items():
         os.environ.setdefault(k, v)
     wc = WorkerConnection(conn)
+    wc.exit_on_eof = True
     rt = WorkerRuntime(args, wc)
 
     # Bind the module-level API (ray_tpu.get/put/remote/...) to this worker.

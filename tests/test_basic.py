@@ -1,4 +1,8 @@
-"""Core API tests, modeled on the reference's `python/ray/tests/test_basic.py`."""
+"""Core API tests, modeled on the reference's `python/ray/tests/test_basic.py`.
+
+Runs each test twice: against the in-process control plane and against an
+out-of-process head server (`_private/head.py`) reached over TCP.
+"""
 
 import time
 
@@ -6,6 +10,18 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from conftest import head_process_runtime
+
+
+@pytest.fixture(params=["inproc", "head_process"])
+def ray_start_regular(request):
+    if request.param == "inproc":
+        ctx = ray_tpu.init(num_cpus=4)
+        yield ctx
+        ray_tpu.shutdown()
+    else:
+        with head_process_runtime(num_cpus=4) as ctx:
+            yield ctx
 
 
 def test_put_get_roundtrip(ray_start_regular):

@@ -1,11 +1,37 @@
 """Fault-tolerance tests: worker crashes, retries, node removal, cancellation.
-Modeled on the reference's `test_component_failures.py` / `test_chaos.py` pattern."""
+Modeled on the reference's `test_component_failures.py` / `test_chaos.py` pattern.
+
+Single-node tests run against both the in-process control plane and an
+out-of-process head server; cluster tests run against both virtual nodes and
+real node-daemon processes.
+"""
 
 import time
 
 import pytest
 
 import ray_tpu
+from conftest import head_process_runtime
+
+
+@pytest.fixture(params=["inproc", "head_process"])
+def ray_start_regular(request):
+    if request.param == "inproc":
+        ctx = ray_tpu.init(num_cpus=4)
+        yield ctx
+        ray_tpu.shutdown()
+    else:
+        with head_process_runtime(num_cpus=4) as ctx:
+            yield ctx
+
+
+@pytest.fixture(params=["virtual", "real"])
+def ray_start_cluster(request):
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1}, real=request.param == "real")
+    yield cluster
+    cluster.shutdown()
 
 
 def test_worker_crash_no_retries(ray_start_regular):
