@@ -146,6 +146,11 @@ class NodeDaemon:
                     self._kill_worker(msg[1])
                 elif kind == "read_object":
                     self._read_object(msg[1], msg[2])
+                elif kind == "delete_object":
+                    try:
+                        os.unlink(msg[1])
+                    except OSError:
+                        pass
                 elif kind == "shutdown":
                     break
         except (EOFError, OSError):

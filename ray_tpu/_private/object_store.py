@@ -55,6 +55,10 @@ class ObjectMeta:
     # nodes use it to route a pull (the analogue of the reference's object
     # directory, `/root/reference/src/ray/object_manager/ownership_based_object_directory.h`).
     node_id: Optional[bytes] = None
+    # ObjectRef ids pickled inside this value: the control plane keeps them
+    # pinned while this object lives (reference: contained-object tracking,
+    # `core_worker/reference_count.h`).
+    contained_ids: Optional[List[bytes]] = None
 
 
 class SharedSegment:
@@ -171,15 +175,18 @@ class LocalObjectStore:
 
     # --- write path ---
     def put_serialized(self, object_id: ObjectID, sv: SerializedValue, inline_threshold: int) -> ObjectMeta:
+        contained = sv.contained_ids or None
         if sv.total_size <= inline_threshold or not sv.buffers:
             return ObjectMeta(
                 object_id=object_id,
                 size=sv.total_size,
                 inband=sv.inband,
                 inline_buffers=[bytes(b) for b in sv.buffers],
+                contained_ids=contained,
             )
         meta = write_segment(self.shm_dir, object_id, sv)
         meta.node_id = self.node_id
+        meta.contained_ids = contained
         return meta
 
     def put(self, object_id: ObjectID, value, inline_threshold: int) -> ObjectMeta:

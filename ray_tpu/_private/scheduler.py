@@ -145,6 +145,8 @@ class DriverHandle(_ConnSender):
         # Pseudo-node id under which this driver's shm segments are published;
         # pulls for it route back over this connection.
         self.pull_node_id = pull_node_id
+        # Identity under which this driver's ObjectRefs are counted.
+        self.holder_id = "driver-" + os.urandom(4).hex()
 
 
 @dataclass
@@ -227,6 +229,10 @@ class TaskRecord:
     acquired_pg: Optional[Tuple[PlacementGroupID, int]] = None
     unresolved: int = 0
     submitted_at: float = field(default_factory=time.time)
+    # Object-lifecycle bookkeeping: dependency ids pinned for the task's
+    # lifetime, released exactly once when it reaches a terminal state.
+    dep_ids: List[bytes] = field(default_factory=list)
+    pins_released: bool = False
 
 
 @dataclass
@@ -313,6 +319,19 @@ class Scheduler:
         self._pull_sources: Dict[bytes, _ConnSender] = {}
         self._pending_pulls: Dict[int, Tuple[Callable[[bool, Any], None], ObjectMeta]] = {}
         self._pull_token = 0
+        # Object lifecycle (reference: ownership refcounting in
+        # `core_worker/reference_count.h:59`, plasma capacity/eviction in
+        # `object_manager/plasma/eviction_policy.h`, lineage reconstruction in
+        # `core_worker/object_recovery_manager.h:41`):
+        #  holders: processes (driver/worker ids) holding live ObjectRefs
+        #  pins: task-dependency + containment counts
+        #  contained_pins: object -> child ids it pins while alive
+        #  node_usage: bytes of sealed segments per node (capacity accounting)
+        self.holders: Dict[bytes, set] = {}
+        self.pins: Dict[bytes, int] = {}
+        self.contained_pins: Dict[bytes, List[bytes]] = {}
+        self.node_usage: Dict[NodeID, int] = {}
+        self._reconstructing: Dict[bytes, List[Callable[[bool, Any], None]]] = {}
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._acceptors: List[threading.Thread] = []
@@ -450,6 +469,7 @@ class Scheduler:
         if dh.pull_node_id:
             self._pull_sources.pop(dh.pull_node_id, None)
             self._fail_pulls_from(dh.pull_node_id)
+        self._drop_holder_everywhere(dh.holder_id)
         try:
             dh.conn.close()
         except OSError:
@@ -480,8 +500,14 @@ class Scheduler:
             self._thread.join(timeout=5)
 
     def call(self, method: str, payload: Any) -> concurrent.futures.Future:
-        """Thread-safe entry for driver API threads."""
+        """Thread-safe entry for driver API threads. Fails fast once the
+        scheduler has stopped — a caller blocked on .result() of a command no
+        thread will ever process would hang forever (e.g. a background ref
+        flusher racing shutdown)."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        if self._stopped.is_set():
+            fut.set_exception(RuntimeError("scheduler is stopped"))
+            return fut
         self._commands.put((method, payload, fut))
         self._wake()
         return fut
@@ -561,6 +587,14 @@ class Scheduler:
                 import traceback
 
                 traceback.print_exc()
+        # Loop exited: fail any command that raced the stop and is still queued.
+        while True:
+            try:
+                _method, _payload, fut = self._commands.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(RuntimeError("scheduler is stopped"))
 
     def _drain_worker(self, wh: WorkerHandle):
         try:
@@ -605,6 +639,8 @@ class Scheduler:
                 elif kind == "object_data":
                     _, token, ok, data = msg
                     self._finish_pull(token, ok, data)
+                elif kind == "ref_ops":
+                    self._apply_ref_ops(msg[1], dh.holder_id)
         except (EOFError, OSError):
             self._on_driver_death(dh)
 
@@ -790,6 +826,7 @@ class Scheduler:
                 wh.conn.close()
             except OSError:
                 pass
+        self._drop_holder_everywhere(wh.worker_id.hex())
         if wh.actor_id is not None:
             self._handle_actor_worker_death(wh)
         elif wh.current_task is not None:
@@ -829,6 +866,12 @@ class Scheduler:
                 self._store_error_results(rec, err)
         ar.inflight.clear()
         ar.worker = None
+        # The creation task record never reaches a terminal state when the
+        # worker dies mid-creation: release its dependency pins here (restart
+        # builds a fresh record that re-pins).
+        crec = self.tasks.get(ar.creation_req.spec.task_id)
+        if crec is not None:
+            self._release_task_pins(crec)
         if ar.state == "DEAD":
             self._release_actor_resources(ar)
             return
@@ -864,6 +907,8 @@ class Scheduler:
         elif kind == "req":
             _, req_id, method, payload = msg
             self._on_worker_request(wh, req_id, method, payload)
+        elif kind == "ref_ops":
+            self._apply_ref_ops(msg[1], wh.worker_id.hex())
 
     def _respond(self, wh: WorkerHandle, req_id: int, ok: bool, payload):
         wh.send(("resp", req_id, ok, payload))
@@ -884,6 +929,7 @@ class Scheduler:
             return
         rec.state = "FINISHED" if ok else "FAILED"
         self._record_event(rec.spec, rec.state)
+        self._release_task_pins(rec)
         for meta in metas:
             self._seal_object(meta)
         if rec.spec.actor_id is not None:
@@ -944,9 +990,128 @@ class Scheduler:
     # ------------------------------------------------------------------ objects
     def _seal_object(self, meta: ObjectMeta):
         key = meta.object_id.binary()
+        old = self.object_table.get(key)
+        if old is not None:
+            # Reseal (reconstruction / error overwrite): retire the old copy's
+            # accounting before the new one takes over.
+            self._retire_meta_accounting(old)
         self.object_table[key] = meta
+        if meta.segment and meta.node_id:
+            nid = NodeID(meta.node_id)
+            self.node_usage[nid] = self.node_usage.get(nid, 0) + meta.size
+        if meta.contained_ids:
+            for child in meta.contained_ids:
+                self._pin(child)
+            self.contained_pins[key] = list(meta.contained_ids)
         for cb in self.object_waiters.pop(key, []):
             cb(meta)
+        for respond in self._reconstructing.pop(key, []):
+            respond(True, meta)
+        # The seal itself may be the last event keeping a dropped object alive.
+        self._maybe_free(key)
+
+    # --- refcounting core ---
+    def _add_holder(self, key: bytes, holder: str):
+        self.holders.setdefault(key, set()).add(holder)
+
+    def _rel_holder(self, key: bytes, holder: str):
+        hs = self.holders.get(key)
+        if hs is not None:
+            hs.discard(holder)
+            if not hs:
+                del self.holders[key]
+        self._maybe_free(key)
+
+    def _pin(self, key: bytes, n: int = 1):
+        self.pins[key] = self.pins.get(key, 0) + n
+
+    def _unpin(self, key: bytes):
+        n = self.pins.get(key, 0) - 1
+        if n <= 0:
+            self.pins.pop(key, None)
+            self._maybe_free(key)
+        else:
+            self.pins[key] = n
+
+    def _register_return_holders(self, return_ids: List[ObjectID], holder: str):
+        for oid in return_ids:
+            self._add_holder(oid.binary(), holder)
+
+    def _release_task_pins(self, rec: TaskRecord):
+        if rec.pins_released:
+            return
+        rec.pins_released = True
+        for d in rec.dep_ids:
+            self._unpin(d)
+
+    def _maybe_free(self, key: bytes):
+        if key in self.holders or self.pins.get(key, 0) > 0:
+            return
+        if key in self._reconstructing or key in self.object_waiters:
+            return
+        meta = self.object_table.pop(key, None)
+        if meta is None:
+            return
+        self._retire_meta_accounting(meta)
+        self._delete_segment(meta)
+
+    def _retire_meta_accounting(self, meta: ObjectMeta):
+        key = meta.object_id.binary()
+        if meta.segment and meta.node_id:
+            nid = NodeID(meta.node_id)
+            self.node_usage[nid] = max(0, self.node_usage.get(nid, 0) - meta.size)
+        for child in self.contained_pins.pop(key, []):
+            self._unpin(child)
+
+    def _delete_segment(self, meta: ObjectMeta):
+        if not meta.segment:
+            return
+        # Dependency-error metas alias their parent's segment; only the object
+        # that actually owns the file (segments are named by creator id) may
+        # unlink it.
+        if os.path.basename(meta.segment) != meta.object_id.hex():
+            return
+        # Daemons and client drivers both honor ("delete_object", path) on
+        # their connections; head-local (virtual-node) segments unlink here.
+        source = self._pull_sources.get(meta.node_id or b"")
+        if source is not None:
+            source.send(("delete_object", meta.segment))
+        else:
+            try:
+                os.unlink(meta.segment)
+            except OSError:
+                pass
+
+    def _drop_holder_everywhere(self, holder: str):
+        """A process died or disconnected: release every ref it held."""
+        for key in [k for k, hs in self.holders.items() if holder in hs]:
+            self._rel_holder(key, holder)
+
+    def _apply_ref_ops(self, ops: List[Tuple[str, bytes]], holder: str):
+        for op, key in ops:
+            if op == "add":
+                self._add_holder(key, holder)
+            else:
+                self._rel_holder(key, holder)
+
+    def _check_capacity(self, meta: ObjectMeta) -> Optional[Exception]:
+        """Enforce Config.object_store_memory for explicit puts (task returns are
+        allowed to overshoot — the work is already done, as in the reference's
+        fallback allocation)."""
+        if not meta.segment or not meta.node_id:
+            return None
+        from ray_tpu.exceptions import ObjectStoreFullError
+
+        nid = NodeID(meta.node_id)
+        cap = self.config.object_store_memory
+        usage = self.node_usage.get(nid, 0)
+        if usage + meta.size > cap:
+            return ObjectStoreFullError(
+                f"object store on node {nid.hex()[:8]} is full: "
+                f"{usage + meta.size} > capacity {cap} bytes. Free ObjectRefs "
+                "(del / let them go out of scope) or raise object_store_memory."
+            )
+        return None
 
     def _store_error_results(self, rec: TaskRecord, err: Exception):
         sv = serialization.serialize(err)
@@ -960,16 +1125,34 @@ class Scheduler:
             )
             self._seal_object(meta)
         rec.state = "FAILED"
+        self._release_task_pins(rec)
         self._record_event(rec.spec, "FAILED")
+
+    # The in-process driver's holder identity for refcounting.
+    _INPROC_DRIVER = "driver0"
+
+    @staticmethod
+    def _holder_of(wh) -> str:
+        return wh.holder_id if isinstance(wh, DriverHandle) else wh.worker_id.hex()
 
     # ------------------------------------------------------------------ commands (driver API)
     def _cmd_submit(self, payload):
         rec: TaskRecord = payload
+        self._register_return_holders(rec.return_ids, self._INPROC_DRIVER)
         self._register_task(rec)
         return [oid for oid in rec.return_ids]
 
     def _cmd_put_meta(self, meta: ObjectMeta):
+        err = self._check_capacity(meta)
+        if err is not None:
+            raise err
+        self._add_holder(meta.object_id.binary(), self._INPROC_DRIVER)
         self._seal_object(meta)
+        return True
+
+    def _cmd_ref_ops(self, payload):
+        ops, holder = payload
+        self._apply_ref_ops(ops, holder or self._INPROC_DRIVER)
         return True
 
     def _cmd_get_metas(self, payload):
@@ -986,14 +1169,19 @@ class Scheduler:
         return _ASYNC
 
     def _cmd_free(self, ids: List[bytes]):
+        """Force-free objects regardless of outstanding references (the unsafe
+        `ray._private.internal_api.free` analogue)."""
         freed = []
         for i in ids:
             meta = self.object_table.pop(i, None)
-            if meta is not None and meta.segment:
-                freed.append(meta)
+            if meta is not None:
+                self._retire_meta_accounting(meta)
+                if meta.segment:
+                    freed.append(meta)
+                self._delete_segment(meta)
         return freed
 
-    def _cmd_create_actor(self, payload):
+    def _cmd_create_actor(self, payload, holder: Optional[str] = None):
         ar, info, name = payload
         self.actors[ar.actor_id] = ar
         self.gcs.actors[ar.actor_id] = info
@@ -1001,11 +1189,15 @@ class Scheduler:
             if name in self.gcs.named_actors:
                 raise ValueError(f"Actor name '{name}' already taken")
             self.gcs.named_actors[name] = ar.actor_id
+        self._register_return_holders(
+            ar.creation_req.return_ids, holder or self._INPROC_DRIVER
+        )
         self._try_start_actor(ar)
         return True
 
     def _cmd_submit_actor_task(self, payload):
         req: ExecRequest = payload
+        self._register_return_holders(req.return_ids, self._INPROC_DRIVER)
         return self._submit_actor_task(req)
 
     def _cmd_get_actor_by_name(self, name: str):
@@ -1155,15 +1347,22 @@ class Scheduler:
         rec: TaskRecord = payload
         if rec.func_blob is not None:
             self.gcs.function_table.setdefault(rec.spec.func.function_id, rec.func_blob)
+        self._register_return_holders(rec.return_ids, self._holder_of(wh))
         self._register_task(rec)
         self._respond(wh, req_id, True, True)
 
     def _req_submit_actor_task(self, wh: WorkerHandle, req_id: int, payload):
         req: ExecRequest = payload
+        self._register_return_holders(req.return_ids, self._holder_of(wh))
         self._submit_actor_task(req)
         self._respond(wh, req_id, True, True)
 
     def _req_put_meta(self, wh: WorkerHandle, req_id: int, meta: ObjectMeta):
+        err = self._check_capacity(meta)
+        if err is not None:
+            self._respond(wh, req_id, False, err)
+            return
+        self._add_holder(meta.object_id.binary(), self._holder_of(wh))
         self._seal_object(meta)
         self._respond(wh, req_id, True, True)
 
@@ -1202,7 +1401,7 @@ class Scheduler:
             self._respond(wh, req_id, True, blob)
 
     def _req_create_actor(self, wh: WorkerHandle, req_id: int, payload):
-        self._cmd_create_actor(payload)
+        self._cmd_create_actor(payload, holder=self._holder_of(wh))
         self._respond(wh, req_id, True, True)
 
     def _req_get_actor_by_name(self, wh: WorkerHandle, req_id: int, name: str):
@@ -1319,6 +1518,88 @@ class Scheduler:
         else:
             respond(False, OSError(f"remote segment read failed: {data}"))
 
+    # ------------------------------------------------------------------ reconstruction
+    def _req_reconstruct_object(self, wh, req_id: int, object_key: bytes):
+        # Release the requester's CPU while it waits (like get/wait): the
+        # reconstructed task may need this very slot to run.
+        self._mark_blocked(wh)
+
+        def respond(ok: bool, payload):
+            self._unmark_blocked(wh)
+            self._respond(wh, req_id, ok, payload)
+
+        self._reconstruct_object(object_key, respond)
+
+    def _cmd_reconstruct_object(self, payload):
+        object_key, fut = payload
+
+        def respond(ok: bool, result):
+            if fut.done():
+                return
+            if ok:
+                fut.set_result(result)
+            else:
+                fut.set_exception(result if isinstance(result, BaseException) else OSError(str(result)))
+
+        self._reconstruct_object(object_key, respond)
+        return _ASYNC
+
+    def _reconstruct_object(self, object_key: bytes, respond: Callable[[bool, Any], None]):
+        """Lineage reconstruction: a sealed object's bytes were lost — re-execute
+        the task that created it, recursively re-creating lost dependencies
+        (reference: `core_worker/object_recovery_manager.h:41`,
+        `task_manager.h:74 ResubmitTask`). Responds with the fresh meta once the
+        object reseals (an error meta if the re-execution fails)."""
+        from ray_tpu.exceptions import ObjectLostError
+
+        waiters = self._reconstructing.get(object_key)
+        if waiters is not None:
+            waiters.append(respond)
+            return
+        oid = ObjectID(object_key)
+        if oid.is_put:
+            respond(
+                False,
+                ObjectLostError(
+                    f"Object {oid.hex()} was created by ray_tpu.put() and its bytes "
+                    "are lost; put objects have no lineage to re-execute."
+                ),
+            )
+            return
+        rec = self.tasks.get(oid.task_id)
+        if rec is None:
+            respond(False, ObjectLostError(f"No lineage retained for object {oid.hex()}."))
+            return
+        if rec.spec.actor_id is not None:
+            respond(
+                False,
+                ObjectLostError(
+                    f"Object {oid.hex()} came from an actor task; actor state makes "
+                    "re-execution unsafe (matches the reference's constraint)."
+                ),
+            )
+            return
+        self._reconstructing[object_key] = [respond]
+        # Retire the stale meta (segment bytes are gone).
+        stale = self.object_table.pop(object_key, None)
+        if stale is not None:
+            self._retire_meta_accounting(stale)
+        if rec.state == "PENDING" or rec.state == "RUNNING":
+            return  # already (re)executing; seal will answer the waiters
+        clone = TaskRecord(
+            spec=rec.spec,
+            arg_entries=rec.arg_entries,
+            kwarg_entries=rec.kwarg_entries,
+            return_ids=rec.return_ids,
+            func_blob=rec.func_blob,
+            retries_left=self.config.task_max_retries,
+        )
+        # Recursively restore lost dependencies first (lineage chain).
+        for kind, v in list(rec.arg_entries) + list(rec.kwarg_entries.values()):
+            if kind == "id" and v not in self.object_table and v not in self._reconstructing:
+                self._reconstruct_object(v, lambda ok, payload: None)
+        self._register_task(clone)
+
     def _mark_blocked(self, wh: WorkerHandle):
         """Release the CPU held by the task running on `wh` while it blocks in
         get/wait, so dependent tasks can run (prevents pool deadlock; mirrors the
@@ -1376,6 +1657,21 @@ class Scheduler:
         if rec.spec.actor_id is not None and not rec.spec.is_actor_creation:
             # Actor call path (should come through _submit_actor_task).
             raise ValueError("actor tasks must use submit_actor_task")
+        # Pin dependencies for the task's lifetime so they cannot be freed
+        # between submission and execution.
+        if not rec.dep_ids:
+            rec.dep_ids = [v for (k, v) in rec.arg_entries if k == "id"] + [
+                v for (k, v) in rec.kwarg_entries.values() if k == "id"
+            ]
+        for d in rec.dep_ids:
+            self._pin(d)
+        # Inline arg metas may themselves contain refs (e.g. a list of refs
+        # passed by value): pin those too, released with the task.
+        for kind, m in list(rec.arg_entries) + list(rec.kwarg_entries.values()):
+            if kind == "meta" and m.contained_ids:
+                rec.dep_ids.extend(m.contained_ids)
+                for child in m.contained_ids:
+                    self._pin(child)
         self.pending.append(rec)
 
     def _submit_actor_task(self, req: ExecRequest):
@@ -1389,6 +1685,18 @@ class Scheduler:
             return_ids=req.return_ids,
             func_blob=None,
         )
+        # Pin dependencies (and refs nested in by-value args) until terminal.
+        entries = list(getattr(req, "_arg_entries", None) or []) + list(
+            (getattr(req, "_kwarg_entries", None) or {}).values()
+        )
+        for kind, v in entries:
+            if kind == "id":
+                rec.dep_ids.append(v)
+                self._pin(v)
+            elif kind == "meta" and v.contained_ids:
+                rec.dep_ids.extend(v.contained_ids)
+                for child in v.contained_ids:
+                    self._pin(child)
         self.tasks[spec.task_id] = rec
         self._record_event(spec, "SUBMITTED")
         ar = self.actors.get(spec.actor_id)
@@ -1467,6 +1775,7 @@ class Scheduler:
                     )
                     self._seal_object(m)
                 rec.state = "FAILED"
+                self._release_task_pins(rec)
                 return
             req.arg_metas = arg_metas
             req.kwarg_metas = kw
@@ -1662,6 +1971,7 @@ class Scheduler:
                 )
                 self._seal_object(m)
             rec.state = "FAILED"
+            self._release_task_pins(rec)
             return True
         # 2) actor creation: dedicated worker + resources
         if rec.spec.is_actor_creation:
@@ -1717,6 +2027,7 @@ class Scheduler:
     def _try_dispatch_actor_creation(self, rec: TaskRecord, metas, kw) -> bool:
         ar = self.actors.get(rec.spec.actor_id)
         if ar is None or ar.state == "DEAD":
+            self._release_task_pins(rec)
             return True  # dropped (e.g. killed while pending)
         node = self._pick_node(rec)
         if node is None:
@@ -1767,8 +2078,8 @@ class Scheduler:
             return_ids=req.return_ids,
             func_blob=req.func_blob,
         )
-        self.tasks[req.spec.task_id] = rec
-        self.pending.append(rec)
+        # Through _register_task so creation-arg refs get pinned like any task's.
+        self._register_task(rec)
 
     # ------------------------------------------------------------------ resources
     def _release_task_resources(self, rec: TaskRecord):

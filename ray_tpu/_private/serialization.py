@@ -26,10 +26,27 @@ class SerializedValue:
 
     inband: bytes
     buffers: List[memoryview] = field(default_factory=list)
+    # ObjectRef ids pickled inside the value. The control plane pins these while
+    # the containing object lives, the analogue of the reference's
+    # "contained object" tracking in `reference_count.h:59`.
+    contained_ids: List[bytes] = field(default_factory=list)
 
     @property
     def total_size(self) -> int:
         return len(self.inband) + sum(b.nbytes for b in self.buffers)
+
+
+# Active only inside serialize() (per thread): ObjectRef.__reduce__ reports ids
+# here so nested refs are discovered without a second pass over the value.
+import threading as _threading
+
+_tls = _threading.local()
+
+
+def note_contained_ref(id_bytes: bytes) -> None:
+    collector = getattr(_tls, "contained_collector", None)
+    if collector is not None:
+        collector.append(id_bytes)
 
 
 class _Pickler(cloudpickle.CloudPickler):
@@ -65,14 +82,21 @@ def serialize(value: Any) -> SerializedValue:
 
     f = io.BytesIO()
     p = _Pickler(f, protocol=5, buffer_callback=buffers.append)
-    p.dump(value)
+    prev = getattr(_tls, "contained_collector", None)
+    _tls.contained_collector = contained = []
+    try:
+        p.dump(value)
+    finally:
+        _tls.contained_collector = prev
     views = []
     for b in buffers:
         view = b.raw()
         if not view.contiguous:
             view = memoryview(bytes(view))
         views.append(view)
-    return SerializedValue(inband=f.getvalue(), buffers=views)
+    return SerializedValue(
+        inband=f.getvalue(), buffers=views, contained_ids=list(dict.fromkeys(contained))
+    )
 
 
 def deserialize(inband: bytes, buffers: List[memoryview]) -> Any:

@@ -34,14 +34,117 @@ DRIVER_MODE = "driver"
 WORKER_MODE = "worker"
 
 
+class _RefTracker:
+    """Process-local ObjectRef reference counts, the client half of ownership
+    refcounting (`/root/reference/src/ray/core_worker/reference_count.h:59`).
+
+    Every live ObjectRef in this process counts here; ops (first-ref "add",
+    zero-transition "rel") queue IN ORDER and are flushed to the control plane
+    in batches. Order matters: a ref deserialized out of a container is added
+    to the queue before the container's release can be, so the scheduler never
+    frees a child whose borrower registration is still in flight."""
+
+    def __init__(self):
+        import collections
+
+        self._lock = threading.Lock()
+        self._counts: Dict[bytes, int] = {}
+        self._ops: List[Tuple[str, bytes]] = []
+        # decref() must be safe to run from ObjectRef.__del__, which the GC can
+        # fire at ANY allocation point — including while this thread already
+        # holds self._lock. So __del__ only does a lock-free deque append
+        # (atomic in CPython); the bookkeeping happens later in drain().
+        self._dead: "collections.deque[bytes]" = collections.deque()
+
+    def incref(self, key: bytes) -> None:
+        with self._lock:
+            n = self._counts.get(key, 0)
+            self._counts[key] = n + 1
+            if n == 0:
+                self._ops.append(("add", key))
+
+    def decref(self, key: bytes) -> None:
+        # GC-safe: no lock, no dict mutation (see __init__ comment).
+        self._dead.append(key)
+
+    def _apply_dead_locked(self) -> None:
+        while True:
+            try:
+                key = self._dead.popleft()
+            except IndexError:
+                return
+            n = self._counts.get(key, 0) - 1
+            if n <= 0:
+                self._counts.pop(key, None)
+                self._ops.append(("rel", key))
+            else:
+                self._counts[key] = n
+
+    def drain(self) -> List[Tuple[str, bytes]]:
+        with self._lock:
+            self._apply_dead_locked()
+            ops, self._ops = self._ops, []
+            return ops
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._ops.clear()
+            self._dead.clear()
+
+
+_ref_tracker = _RefTracker()
+
+
+# Serializes drain+send so concurrent flushes (background flusher, put(), task
+# completion) cannot reorder batches — the add-before-rel queue order must
+# survive onto the wire.
+_flush_lock = threading.Lock()
+
+
+def flush_ref_ops() -> None:
+    """Send queued refcount ops to the control plane (called by the background
+    flusher, at task completion, and by tests for determinism)."""
+    with _flush_lock:
+        ops = _ref_tracker.drain()
+        if not ops:
+            return
+        ctx = global_worker.context
+        if ctx is None:
+            return
+        try:
+            ctx.ref_ops(ops)
+        except Exception:
+            pass  # control plane gone (shutdown); counts die with it
+
+
+def _start_ref_flusher() -> None:
+    gen = global_worker._session_gen
+
+    def loop():
+        while global_worker.mode is not None and global_worker._session_gen == gen:
+            time.sleep(0.1)
+            flush_ref_ops()
+
+    threading.Thread(target=loop, daemon=True, name="ref-flusher").start()
+
+
 class ObjectRef:
     """A reference to a (possibly pending) object (reference: `ObjectRef` in
-    `_raylet.pyx`). Picklable: rebinds to the receiving process's worker."""
+    `_raylet.pyx`). Picklable: rebinds to the receiving process's worker, which
+    registers itself as a borrower via the ref tracker."""
 
     __slots__ = ("_id",)
 
     def __init__(self, object_id: ObjectID):
         self._id = object_id
+        _ref_tracker.incref(object_id.binary())
+
+    def __del__(self):
+        try:
+            _ref_tracker.decref(self._id.binary())
+        except Exception:
+            pass  # interpreter teardown
 
     def binary(self) -> bytes:
         return self._id.binary()
@@ -63,6 +166,7 @@ class ObjectRef:
         return f"ObjectRef({self.hex()})"
 
     def __reduce__(self):
+        serialization.note_contained_ref(self._id.binary())
         return (ObjectRef, (self._id,))
 
     def future(self) -> concurrent.futures.Future:
@@ -103,6 +207,9 @@ class _WorkerState:
         self._lock = threading.Lock()
         self.namespace: str = "default"
         self._client_tmp_dir: Optional[str] = None
+        # Bumped on every init() so stale ref-flusher threads from a previous
+        # session exit instead of flushing into the new one.
+        self._session_gen: int = 0
 
     def next_put_id(self) -> ObjectID:
         with self._lock:
@@ -208,6 +315,14 @@ class DriverContext:
     def cancel(self, task_id, force: bool):
         return self.scheduler.call("cancel", (task_id, force)).result()
 
+    def ref_ops(self, ops):
+        self.scheduler.call("ref_ops", (ops, None)).result()
+
+    def reconstruct_object(self, key: bytes) -> ObjectMeta:
+        inner: concurrent.futures.Future = concurrent.futures.Future()
+        self.scheduler.call("reconstruct_object", (key, inner)).result()
+        return inner.result(timeout=get_config().object_pull_timeout_s)
+
     def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
         from ray_tpu._private.object_store import resolve_for_read
 
@@ -253,6 +368,11 @@ class RemoteDriverContext:
                     self.wc.send(("object_data", token, False, repr(e)))
 
             threading.Thread(target=_read, daemon=True).start()
+        elif msg[0] == "delete_object":
+            try:
+                os.unlink(msg[1])
+            except OSError:
+                pass
 
     def close(self):
         try:
@@ -336,6 +456,14 @@ class RemoteDriverContext:
 
     def remove_node(self, node_id):
         return self.wc.request("driver_cmd", ("remove_node", node_id))
+
+    def ref_ops(self, ops):
+        self.wc.send(("ref_ops", ops))
+
+    def reconstruct_object(self, key: bytes) -> ObjectMeta:
+        return self.wc.request(
+            "reconstruct_object", key, timeout=get_config().object_pull_timeout_s
+        )
 
     def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
         from ray_tpu._private.object_store import resolve_for_read
@@ -432,6 +560,14 @@ class WorkerProcContext:
 
     def cancel(self, task_id, force: bool):
         return self.rt.wc.request("driver_cmd", ("cancel", (task_id, force)))
+
+    def ref_ops(self, ops):
+        self.rt.wc.send(("ref_ops", ops))
+
+    def reconstruct_object(self, key: bytes) -> ObjectMeta:
+        return self.rt.wc.request(
+            "reconstruct_object", key, timeout=get_config().object_pull_timeout_s
+        )
 
     def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
         return self.rt.ensure_local(meta)
@@ -559,6 +695,9 @@ def init(
     global_worker.context = DriverContext(scheduler)
     global_worker.namespace = namespace or "default"
     global_worker.node = scheduler
+    global_worker._session_gen += 1
+    _ref_tracker.reset()
+    _start_ref_flusher()
 
     atexit.register(_atexit_shutdown)
     return RuntimeContext()
@@ -610,6 +749,9 @@ def _init_client_mode(address: str, namespace: Optional[str]):
     global_worker.namespace = namespace or "default"
     global_worker.node = None
     global_worker._client_tmp_dir = own_dir
+    global_worker._session_gen += 1
+    _ref_tracker.reset()
+    _start_ref_flusher()
 
     atexit.register(_atexit_shutdown)
     return RuntimeContext()
@@ -651,6 +793,8 @@ def shutdown():
     global_worker.node = None
     global_worker.session_dir = None
     global_worker._put_counter = 0
+    global_worker._session_gen += 1  # stop this session's ref flusher
+    _ref_tracker.reset()
     # Function-registration cache is per-session: a new init() must re-ship blobs.
     from ray_tpu import remote_function
 
@@ -659,14 +803,23 @@ def shutdown():
 
 
 def put(value: Any) -> ObjectRef:
-    """Store an object and return a reference (reference: `worker.py:2551`)."""
+    """Store an object and return a reference (reference: `worker.py:2551`).
+    Raises ObjectStoreFullError when the node's sealed-segment bytes would
+    exceed Config.object_store_memory; dropping ObjectRefs frees space."""
     _auto_init()
     if isinstance(value, ObjectRef):
         raise TypeError("Calling put() on an ObjectRef is not allowed.")
+    # Flush queued releases first so freed space is visible to the capacity
+    # check (keeps tight put-loops under the cap deterministically).
+    flush_ref_ops()
     cfg = get_config()
     oid = global_worker.next_put_id()
     meta = global_worker.store.put(oid, value, cfg.max_direct_call_object_size)
-    global_worker.context.put_meta(meta)
+    try:
+        global_worker.context.put_meta(meta)
+    except exceptions.ObjectStoreFullError:
+        global_worker.store.free(meta)
+        raise
     return ObjectRef(oid)
 
 
@@ -681,8 +834,17 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
     ids = [r.binary() for r in ref_list]
     metas = global_worker.context.get_metas(ids, timeout)
     values = []
+    ctx = global_worker.context
     for meta in metas:
-        value = global_worker.store.get(global_worker.context.ensure_local(meta))
+        try:
+            value = global_worker.store.get(ctx.ensure_local(meta))
+        except exceptions.GetTimeoutError:
+            raise
+        except (OSError, ConnectionError):
+            # Segment bytes lost (node died, file deleted): reconstruct from
+            # lineage and retry once (reference: ObjectRecoveryManager).
+            meta = ctx.reconstruct_object(meta.object_id.binary())
+            value = global_worker.store.get(ctx.ensure_local(meta))
         if meta.is_error:
             if isinstance(value, exceptions.RayTaskError):
                 raise value.as_instanceof_cause()

@@ -143,6 +143,19 @@ class WorkerRuntime:
 
         return resolve_for_read(self.store, meta, pull, self.args.config.force_object_pulls)
 
+    def fetch_value(self, meta: ObjectMeta):
+        """Read an object value, reconstructing from lineage if its bytes were
+        lost (reference: ObjectRecoveryManager re-submitting the creating task)."""
+        try:
+            return self.store.get(self.ensure_local(meta))
+        except (OSError, ConnectionError):
+            fresh = self.wc.request(
+                "reconstruct_object",
+                meta.object_id.binary(),
+                timeout=self.args.config.object_pull_timeout_s,
+            )
+            return self.store.get(self.ensure_local(fresh))
+
     def load_function(self, function_id: str, blob: Optional[bytes]):
         fn = self.functions.get(function_id)
         if fn is not None:
@@ -165,8 +178,8 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
     for k, v in spec.env_vars.items():
         os.environ[k] = v
     try:
-        args = [rt.store.get(rt.ensure_local(m)) for m in req.arg_metas]
-        kwargs = {k: rt.store.get(rt.ensure_local(m)) for k, m in req.kwarg_metas.items()}
+        args = [rt.fetch_value(m) for m in req.arg_metas]
+        kwargs = {k: rt.fetch_value(m) for k, m in req.kwarg_metas.items()}
         # Resolve any ObjectRefs that arrived as *resolved values already* — the
         # driver substitutes top-level refs with their value metas, so nothing to
         # do here; nested refs were rebuilt by the unpickler as live ObjectRefs.
@@ -209,6 +222,10 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
             sv = serialization.serialize(value)
             meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
             metas.append(meta)
+        # Flush refcount ops BEFORE "done": pipe FIFO guarantees any borrower
+        # registration this task made reaches the scheduler before its
+        # dependency pins are released.
+        worker_mod.flush_ref_ops()
         rt.wc.send(("done", spec.task_id.binary(), True, metas))
     except Exception as e:  # noqa: BLE001 — every task error must be captured
         tb = traceback.format_exc()
@@ -229,6 +246,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
             meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
             meta.is_error = True
             metas.append(meta)
+        worker_mod.flush_ref_ops()
         rt.wc.send(("done", spec.task_id.binary(), False, metas))
     finally:
         rt.current_task_id = None
@@ -250,6 +268,8 @@ def worker_loop(conn, args: WorkerArgs):
 
     reader = threading.Thread(target=wc.reader_loop, daemon=True, name="reader")
     reader.start()
+
+    worker_mod._start_ref_flusher()
     wc.send(("register", args.worker_id_hex, os.getpid()))
     while True:
         req = wc.task_queue.get()
