@@ -510,6 +510,15 @@ class Scheduler:
             return fut
         self._commands.put((method, payload, fut))
         self._wake()
+        # Re-check AFTER the put: if stop raced in between, the loop's final
+        # drain may already have run and this command would sit unprocessed
+        # forever. The drain and this check both guard with fut.done(), so at
+        # most one of them settles the future.
+        if self._stopped.is_set() and not fut.done():
+            try:
+                fut.set_exception(RuntimeError("scheduler is stopped"))
+            except Exception:
+                pass  # settled by the loop in the meantime
         return fut
 
     def _wake(self):
@@ -866,12 +875,6 @@ class Scheduler:
                 self._store_error_results(rec, err)
         ar.inflight.clear()
         ar.worker = None
-        # The creation task record never reaches a terminal state when the
-        # worker dies mid-creation: release its dependency pins here (restart
-        # builds a fresh record that re-pins).
-        crec = self.tasks.get(ar.creation_req.spec.task_id)
-        if crec is not None:
-            self._release_task_pins(crec)
         if ar.state == "DEAD":
             self._release_actor_resources(ar)
             return
@@ -890,6 +893,7 @@ class Scheduler:
                 info.state = "DEAD"
                 info.death_cause = ar.death_cause
             self._release_actor_resources(ar)
+            self._release_actor_creation_pins(ar)
             for req in ar.backlog:
                 rec = self.tasks.get(req.spec.task_id)
                 if rec is not None:
@@ -929,7 +933,10 @@ class Scheduler:
             return
         rec.state = "FINISHED" if ok else "FAILED"
         self._record_event(rec.spec, rec.state)
-        self._release_task_pins(rec)
+        # Actor-creation args stay pinned for the actor's lifetime: a restart
+        # replays the creation task and needs them (released on DEAD).
+        if not rec.spec.is_actor_creation:
+            self._release_task_pins(rec)
         for meta in metas:
             self._seal_object(meta)
         if rec.spec.actor_id is not None:
@@ -986,6 +993,7 @@ class Scheduler:
                     self._store_error_results(rec, err)
             ar.backlog.clear()
             self._release_actor_resources(ar)
+            self._release_actor_creation_pins(ar)
 
     # ------------------------------------------------------------------ objects
     def _seal_object(self, meta: ObjectMeta):
@@ -1043,6 +1051,11 @@ class Scheduler:
         rec.pins_released = True
         for d in rec.dep_ids:
             self._unpin(d)
+
+    def _release_actor_creation_pins(self, ar: "ActorRecord"):
+        rec = self.tasks.get(ar.creation_req.spec.task_id)
+        if rec is not None:
+            self._release_task_pins(rec)
 
     def _maybe_free(self, key: bytes):
         if key in self.holders or self.pins.get(key, 0) > 0:
@@ -1225,6 +1238,7 @@ class Scheduler:
             if info:
                 info.state = "DEAD"
                 info.death_cause = "ray_tpu.kill"
+            self._release_actor_creation_pins(ar)
         if was_pending and no_restart:
             # The creation task may still be queued: drop it and fail the backlog,
             # or _on_actor_created would resurrect a killed actor.
@@ -1594,11 +1608,29 @@ class Scheduler:
             func_blob=rec.func_blob,
             retries_left=self.config.task_max_retries,
         )
-        # Recursively restore lost dependencies first (lineage chain).
+        # Recursively restore lost dependencies first (lineage chain). A dep
+        # that cannot be reconstructed fails THIS object's waiters immediately
+        # instead of leaving them to hit the pull timeout.
+        def dep_result(ok: bool, payload):
+            if not ok:
+                self._fail_reconstruction(object_key, payload)
+
         for kind, v in list(rec.arg_entries) + list(rec.kwarg_entries.values()):
             if kind == "id" and v not in self.object_table and v not in self._reconstructing:
-                self._reconstruct_object(v, lambda ok, payload: None)
+                self._reconstruct_object(v, dep_result)
         self._register_task(clone)
+
+    def _fail_reconstruction(self, object_key: bytes, cause):
+        waiters = self._reconstructing.pop(object_key, [])
+        from ray_tpu.exceptions import ObjectLostError
+
+        err = (
+            cause
+            if isinstance(cause, BaseException)
+            else ObjectLostError(str(cause))
+        )
+        for respond in waiters:
+            respond(False, ObjectLostError(f"dependency unreconstructable: {err}"))
 
     def _mark_blocked(self, wh: WorkerHandle):
         """Release the CPU held by the task running on `wh` while it blocks in
@@ -2078,8 +2110,15 @@ class Scheduler:
             return_ids=req.return_ids,
             func_blob=req.func_blob,
         )
-        # Through _register_task so creation-arg refs get pinned like any task's.
+        # Through _register_task so creation-arg refs get pinned like any
+        # task's. Pin ordering matters on restart: the clone pins BEFORE the
+        # replaced record releases, so creation args can never hit refcount
+        # zero in between (they must stay alive for the actor's whole life —
+        # restarts replay the creation task, and put() args have no lineage).
+        old = self.tasks.get(req.spec.task_id)
         self._register_task(rec)
+        if old is not None and old is not rec:
+            self._release_task_pins(old)
 
     # ------------------------------------------------------------------ resources
     def _release_task_resources(self, rec: TaskRecord):

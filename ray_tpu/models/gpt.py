@@ -223,32 +223,87 @@ def forward(
     config: GPTConfig,
     attention_fn: Optional[Callable] = None,
     dropout_rng=None,
+    mesh=None,
+    num_microbatches: Optional[int] = None,
 ):
     """Returns logits (B, S, vocab) in float32. Pass dropout_rng to enable
-    dropout (training); omit it for deterministic eval."""
+    dropout (training); omit it for deterministic eval.
+
+    With a mesh whose `pipeline` axis is >1, the layer stack runs as a GPipe
+    microbatch pipeline (`parallel.pipeline`): each stage group holds
+    n_layer/pipeline layers, activations ppermute between stages over ICI.
+    Embedding and LM head stay outside the pipeline (replicated over the
+    pipeline axis — they are a small fraction of the FLOPs)."""
     B, S = tokens.shape
     cdt = config.dtype
     x = params["wte"].astype(cdt)[tokens] + params["wpe"].astype(cdt)[:S][None]
     use_dropout = dropout_rng is not None and config.dropout > 0
+    layers_rng = None
     if use_dropout:
         emb_rng, layers_rng = jax.random.split(dropout_rng)
         x = _dropout(x, config.dropout, emb_rng)
 
-    def block_fn(x, xs):
-        layer, idx = xs
-        rng = jax.random.fold_in(layers_rng, idx) if use_dropout else None
-        return _block(x, layer, config, attention_fn, rng), None
-
-    if config.remat:
-        policy = (
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            if config.remat_policy == "dots"
-            else None
-        )
-        block_fn = jax.checkpoint(block_fn, prevent_cse=False, policy=policy)
-    x, _ = jax.lax.scan(
-        block_fn, x, (params["blocks"], jnp.arange(config.n_layer))
+    remat_policy = (
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if config.remat_policy == "dots"
+        else None
     )
+
+    def make_block_fn(first_layer, attn, mb_idx=None):
+        def block_fn(x, xs):
+            layer, idx = xs
+            rng = None
+            if use_dropout:
+                rng = jax.random.fold_in(layers_rng, first_layer + idx)
+                if mb_idx is not None:
+                    # Independent dropout mask per microbatch under PP.
+                    rng = jax.random.fold_in(rng, mb_idx)
+            return _block(x, layer, config, attn, rng), None
+
+        if config.remat:
+            block_fn = jax.checkpoint(block_fn, prevent_cse=False, policy=remat_policy)
+        return block_fn
+
+    n_pipeline = int(mesh.shape.get("pipeline", 1)) if mesh is not None else 1
+    if n_pipeline > 1:
+        from ray_tpu.parallel.pipeline import pipeline_apply, to_stages
+
+        # Combining PP with CP: the pipeline region is already manual over the
+        # `pipeline` axis, so context parallelism must join the same manual
+        # region — use the inside-shard_map ring attention over `context`
+        # instead of whatever full-shard_map wrapper the caller passed.
+        n_context = int(mesh.shape.get("context", 1))
+        context_manual = n_context > 1
+        inner_attn = attention_fn
+        if context_manual:
+            import functools
+
+            from ray_tpu.parallel.ring_attention import ring_attention
+
+            inner_attn = functools.partial(ring_attention, axis_name="context")
+
+        def stack_fn(stage_local, xm, first_layer, mb_idx):
+            n_local = config.n_layer // n_pipeline
+            xm, _ = jax.lax.scan(
+                make_block_fn(first_layer, inner_attn, mb_idx),
+                xm,
+                (stage_local, jnp.arange(n_local)),
+            )
+            return xm
+
+        M = num_microbatches or (2 * n_pipeline if B % (2 * n_pipeline) == 0 else n_pipeline)
+        x = pipeline_apply(
+            mesh,
+            to_stages(params["blocks"], n_pipeline),
+            x,
+            stack_fn,
+            M,
+            context_manual=context_manual,
+        )
+    else:
+        x, _ = jax.lax.scan(
+            make_block_fn(0, attention_fn), x, (params["blocks"], jnp.arange(config.n_layer))
+        )
 
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     # Tied LM head: bf16 operands on the MXU, f32 accumulation — an f32×f32
@@ -269,6 +324,8 @@ def loss_fn(
     config: GPTConfig,
     attention_fn: Optional[Callable] = None,
     dropout_rng=None,
+    mesh=None,
+    num_microbatches: Optional[int] = None,
 ):
     """Causal LM cross entropy (mean over tokens)."""
     if "inputs" in batch:
@@ -276,7 +333,9 @@ def loss_fn(
     else:
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, config, attention_fn, dropout_rng)
+    logits = forward(
+        params, inputs, config, attention_fn, dropout_rng, mesh, num_microbatches
+    )
     # logsumexp - logit[target]: one reduction pass over V instead of
     # materializing the full (B, S, V) log-softmax array (saves ~2x V-sized
     # HBM traffic, ~19ms/step for GPT-2-small at B=16 on v5e).

@@ -80,7 +80,9 @@ def make_train_step(
         )
 
         def loss_of(p):
-            return gpt.loss_fn(p, batch, config, attention_fn, dropout_rng)
+            return gpt.loss_fn(
+                p, batch, config, attention_fn, dropout_rng, mesh=mesh
+            )
 
         loss, grads = jax.value_and_grad(loss_of)(state.params)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)

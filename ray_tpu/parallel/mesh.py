@@ -106,7 +106,10 @@ class ShardingRules:
         ("kv_heads", ("tensor",)),
         ("vocab", ("tensor",)),
         ("expert", ("expert",)),
-        ("layers", None),
+        # Layer stacks shard over the pipeline axis (each stage group stores
+        # n_layer/pipeline layers); on pipeline=1 meshes the axis is dropped by
+        # the divisibility filter and layers stay replicated.
+        ("layers", ("pipeline",)),
         ("stage", ("pipeline",)),
         ("head_dim", None),
         ("norm", None),

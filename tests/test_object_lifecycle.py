@@ -157,3 +157,34 @@ def test_put_objects_are_not_reconstructable(ray_start_regular):
     global_worker.store._segments.clear()
     with pytest.raises(ray_tpu.exceptions.ObjectLostError):
         ray_tpu.get(ref, timeout=30)
+
+
+def test_actor_restart_keeps_creation_args_alive(ray_start_regular):
+    """Creation args stay pinned for the actor's lifetime: restarting replays
+    the creation task, and put() args have no lineage to rebuild from."""
+    big = ray_tpu.put(np.arange(300_000))
+
+    @ray_tpu.remote(max_restarts=1)
+    class A:
+        def __init__(self, x):
+            self.total = int(x.sum())
+
+        def total_(self):
+            return self.total
+
+        def crash(self):
+            os._exit(1)
+
+    a = A.remote(big)
+    expect = int(np.arange(300_000).sum())
+    assert ray_tpu.get(a.total_.remote(), timeout=30) == expect
+    del big  # actor must survive losing the driver's ref
+    gc.collect()
+    flush_ref_ops()
+    time.sleep(0.3)
+    try:
+        ray_tpu.get(a.crash.remote(), timeout=30)
+    except ray_tpu.exceptions.RayActorError:
+        pass
+    # Restarted actor re-ran __init__(big): the arg was still alive.
+    assert ray_tpu.get(a.total_.remote(), timeout=60) == expect
