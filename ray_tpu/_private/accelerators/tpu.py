@@ -129,3 +129,73 @@ def worker_id() -> int:
         return int(os.environ.get("TPU_WORKER_ID", "0"))
     except ValueError:
         return 0
+
+
+def _parse_bounds(raw: Optional[str]) -> Optional[tuple]:
+    if not raw:
+        return None
+    try:
+        return tuple(int(x) for x in raw.replace("x", ",").split(","))
+    except ValueError:
+        return None
+
+
+def chips_per_host_bounds() -> Optional[tuple]:
+    """Per-host chip block, e.g. a v4 host drives 2x2x1 chips. libtpu exports
+    this as TPU_CHIPS_PER_HOST_BOUNDS (NOT TPU_HOST_BOUNDS, which is the
+    host-grid layout — detect_num_tpu_chips above uses the same convention)."""
+    return _parse_bounds(
+        os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
+        or os.environ.get("TPU_CHIPS_PER_PROCESS_BOUNDS")
+    )
+
+
+def host_grid_bounds() -> Optional[tuple]:
+    """Host-grid layout of the slice (hosts per dim): TPU_HOST_BOUNDS, e.g.
+    "2,2,2" for a v4-32's 8 hosts."""
+    return _parse_bounds(os.environ.get("TPU_HOST_BOUNDS"))
+
+
+def node_topology_labels() -> dict:
+    """Labels describing this host's position in its TPU slice, attached to the
+    node at registration so the TPU_SLICE placement policy
+    (`util/tpu_topology_policy.py`) can select contiguous sub-boxes of hosts.
+    Empty dict off-TPU (or for single-host slices with no topology metadata)."""
+    topo = detect_topology()
+    if topo is None or len(topo.mesh_shape) < 2:
+        return {}
+    labels = {
+        "tpu_topology": "x".join(str(d) for d in topo.mesh_shape),
+        "tpu_generation": topo.generation,
+    }
+    pod = tpu_pod_name()
+    if pod:
+        labels["tpu_pod_name"] = pod
+    from ray_tpu.util.tpu_topology_policy import (
+        coord_for_worker,
+        format_coord,
+        host_grid,
+    )
+
+    # Host grid: prefer the direct layout (TPU_HOST_BOUNDS), else derive it
+    # from the chip mesh / per-host chip block.
+    grid = host_grid_bounds()
+    if grid is None or len(grid) != len(topo.mesh_shape):
+        hb = chips_per_host_bounds()
+        if hb is None and len(topo.mesh_shape) == 3:
+            hb = (2, 2, 1)  # v4/v5p standard host block
+        if hb is None or len(hb) != len(topo.mesh_shape):
+            return labels
+        try:
+            grid = host_grid(topo.mesh_shape, hb)
+        except ValueError:
+            return labels
+    labels["tpu_host_grid"] = "x".join(str(d) for d in grid)
+    coord_env = os.environ.get("TPU_HOST_COORD")
+    coord = (
+        tuple(int(x) for x in coord_env.split(","))
+        if coord_env
+        else coord_for_worker(worker_id(), grid)
+    )
+    labels["tpu_host_coord"] = format_coord(coord)
+    return labels

@@ -64,7 +64,8 @@ def main() -> None:
         gcs, cfg, session_dir, tcp_port=ns.port, advertise_host=ns.host, bind_host=ns.bind_host
     )
     scheduler.start()
-    scheduler.call("add_node", (resources, {"head": "1"})).result()
+    labels = {"head": "1", **tpu_accel.node_topology_labels()}
+    scheduler.call("add_node", (resources, labels)).result()
 
     stop = threading.Event()
 

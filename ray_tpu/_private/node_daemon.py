@@ -177,12 +177,15 @@ def main() -> None:
     ns = parser.parse_args()
 
     host, _, port = ns.address.rpartition(":")
+    from ray_tpu._private.accelerators import tpu as tpu_accel
+
+    labels = {**tpu_accel.node_topology_labels(), **json.loads(ns.labels)}
     daemon = NodeDaemon(
         head_host=host,
         head_port=int(port),
         shm_dir=ns.shm_dir,
         resources=json.loads(ns.resources),
-        labels=json.loads(ns.labels),
+        labels=labels,
         log_dir=ns.log_dir or os.path.join(ns.shm_dir, "..", "logs"),
     )
     os.makedirs(ns.shm_dir, exist_ok=True)

@@ -1610,14 +1610,27 @@ class Scheduler:
         )
         # Recursively restore lost dependencies first (lineage chain). A dep
         # that cannot be reconstructed fails THIS object's waiters immediately
-        # instead of leaving them to hit the pull timeout.
+        # instead of leaving them to hit the pull timeout. Deps whose
+        # reconstruction is already in flight get the same failure hook
+        # appended to their waiter list.
+        failed = {"v": False}
+
         def dep_result(ok: bool, payload):
             if not ok:
+                failed["v"] = True
                 self._fail_reconstruction(object_key, payload)
 
         for kind, v in list(rec.arg_entries) + list(rec.kwarg_entries.values()):
-            if kind == "id" and v not in self.object_table and v not in self._reconstructing:
+            if kind != "id" or v in self.object_table:
+                continue
+            if v in self._reconstructing:
+                self._reconstructing[v].append(dep_result)
+            else:
                 self._reconstruct_object(v, dep_result)
+        if failed["v"]:
+            # Waiters already answered with ObjectLostError; don't register a
+            # clone that would wait on a dependency that can never exist.
+            return
         self._register_task(clone)
 
     def _fail_reconstruction(self, object_key: bytes, cause):
@@ -1883,12 +1896,36 @@ class Scheduler:
                 for b in unplaced:
                     if not any(place(b, n) for n in nodes):
                         return False
-        elif strategy == "STRICT_SPREAD":
-            used = {b.node for b in pg.bundles if b.node is not None}
-            for b in unplaced:
-                cand = [n for n in nodes if n.node_id not in used and n.node_id not in {p[1].node_id for p in plan}]
-                if not any(place(b, n) for n in cand):
-                    return False
+        elif strategy in ("TPU_SLICE", "STRICT_SPREAD"):
+            def place_spread() -> bool:
+                used = {b.node for b in pg.bundles if b.node is not None}
+                for b in unplaced:
+                    placed_ids = {p[1].node_id for p in plan}
+                    cand = [
+                        n for n in nodes
+                        if n.node_id not in used and n.node_id not in placed_ids
+                    ]
+                    if not any(place(b, n) for n in cand):
+                        return False
+                return True
+
+            chosen = (
+                self._plan_tpu_slice(unplaced, nodes, scratch)
+                if strategy == "TPU_SLICE"
+                else None
+            )
+            # ICI-topology-aware: bundles land on hosts forming a contiguous
+            # sub-box of one TPU slice's host grid (util/tpu_topology_policy.py)
+            # so the gang's collectives ride neighboring ICI links and keep
+            # wraparound where the box spans full torus dims. Falls back to
+            # STRICT_SPREAD placement when no slice can host the gang (CPU
+            # clusters, tests without TPU metadata, heterogeneous bundles).
+            if chosen is not None:
+                for b, n in zip(unplaced, chosen):
+                    if not place(b, n):  # cannot happen: pre-validated
+                        return False
+            elif not place_spread():
+                return False
         else:  # SPREAD (best-effort round robin)
             for i, b in enumerate(unplaced):
                 order = nodes[i % len(nodes):] + nodes[: i % len(nodes)] if nodes else []
@@ -1899,6 +1936,45 @@ class Scheduler:
             b.node = n.node_id
             b.available = dict(b.resources)
         return True
+
+    def _plan_tpu_slice(self, unplaced: List[Bundle], nodes: List[NodeState], scratch):
+        """Choose topology-labeled hosts forming a contiguous sub-box for the
+        bundles; None -> caller falls back to plain spread placement.
+
+        Hosts are grouped per physical slice (tpu_pod_name + grid shape) —
+        coordinates are only meaningful within one slice; a box mixing two
+        pods would put DCN (or nothing) where the gang expects ICI. Every
+        bundle is validated against its zipped host before the plan is
+        returned, so heterogeneous gangs either fit exactly or fall back."""
+        from ray_tpu.util.tpu_topology_policy import choose_slice_hosts, parse_coord
+
+        slices: Dict[Tuple[str, Tuple[int, ...]], Dict[Any, NodeState]] = {}
+        for n in nodes:
+            coord_label = n.labels.get("tpu_host_coord")
+            grid_label = n.labels.get("tpu_host_grid")
+            if not coord_label or not grid_label:
+                continue
+            grid = tuple(int(x) for x in grid_label.split("x"))
+            pod = n.labels.get("tpu_pod_name", "")
+            slices.setdefault((pod, grid), {})[parse_coord(coord_label)] = n
+        for (pod, grid), members in slices.items():
+            # Per-coordinate feasibility against the worst bundle: slice gangs
+            # are host-homogeneous, so check the max requirement per resource.
+            feasible = {
+                c: n
+                for c, n in members.items()
+                if all(_fits(scratch[n.node_id], b.resources) for b in unplaced)
+            }
+            if len(feasible) < len(unplaced):
+                continue
+            chosen_ids = choose_slice_hosts(
+                grid, {c: n.node_id.binary() for c, n in feasible.items()}, len(unplaced)
+            )
+            if chosen_ids is None:
+                continue
+            by_id = {n.node_id.binary(): n for n in members.values()}
+            return [by_id[i] for i in chosen_ids]
+        return None
 
     # --- main scheduling pass ---
     def _schedule(self):
@@ -2022,7 +2098,11 @@ class Scheduler:
                 break
         if wh is None:
             max_workers = int(node.resources.get("CPU", 1)) + self.config.maximum_startup_concurrency
-            if len(node.workers) >= max_workers + len(self.actors):
+            # Actor workers don't count against the stateless pool cap — but
+            # only THIS node's actors (a cluster-wide count would inflate every
+            # node's cap by every other node's actors).
+            node_actors = sum(1 for w in node.workers.values() if w.actor_id is not None)
+            if len(node.workers) >= max_workers + node_actors:
                 return False
             wh = self._spawn_worker(node)
             node.idle.remove(wh.worker_id)

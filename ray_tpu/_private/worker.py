@@ -684,7 +684,8 @@ def init(
     gcs = GCS()
     scheduler = Scheduler(gcs, cfg, session_dir)
     scheduler.start()
-    head_node_id = scheduler.call("add_node", (node_resources, {"head": "1"})).result()
+    head_labels = {"head": "1", **tpu_accel.node_topology_labels()}
+    head_node_id = scheduler.call("add_node", (node_resources, head_labels)).result()
 
     global_worker.mode = DRIVER_MODE
     global_worker.job_id = JobID.from_int(1)

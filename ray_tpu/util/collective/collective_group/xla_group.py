@@ -195,10 +195,19 @@ class XLAGroup(BaseGroup):
         self.allreduce(np.zeros((1,), np.float32))
 
     def reduce(self, tensor, root_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        # Implemented as allreduce + root filter. On a bidirectional ring this
+        # costs 2(N-1)/N x B per link vs (N-1)/N x B for a true reduce-to-root
+        # tree — a 2x bound, not Nx; XLA exposes no reduce-to-root HLO and a
+        # hand-rolled ppermute tree would serialize log(N) full-B hops, which
+        # is slower on ICI for all realistic N. Revisit only if profiles show
+        # reduce-heavy host loops (DP grad sync never takes this path — it is
+        # fused into the jitted step).
         out = self.allreduce(tensor, op)
         return out if self.rank == root_rank else None
 
     def broadcast(self, tensor, root_rank: int = 0):
+        # Masked psum (root contributes, others zero): same 2x-of-optimal ring
+        # bound as reduce() above, same rationale for not hand-rolling a tree.
         if self.world_size == 1:
             return np.asarray(tensor)
         garr = self._to_group_array(tensor)

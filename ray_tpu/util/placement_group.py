@@ -19,7 +19,7 @@ from ray_tpu._private.ids import PlacementGroupID
 from ray_tpu._private.scheduler import Bundle, PGRecord
 from ray_tpu._private.worker import _auto_init, global_worker
 
-VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD", "TPU_SLICE")
 
 
 class PlacementGroup:
@@ -81,10 +81,12 @@ def tpu_slice_placement_group(
     num_hosts: int,
     chips_per_host: int = 4,
     cpus_per_host: float = 1.0,
-    strategy: str = "STRICT_SPREAD",
+    strategy: str = "TPU_SLICE",
 ) -> PlacementGroup:
     """Gang-reserve a TPU slice: one bundle per host, each holding that host's
-    chips. STRICT_SPREAD maps bundles onto distinct hosts, mirroring how a pod
-    slice's workers must land 1:1 on its TPU VMs."""
+    chips. The TPU_SLICE strategy places bundles on hosts forming a contiguous
+    sub-box of the slice's ICI host grid (wraparound-preserving where the box
+    spans full torus dims; see `util/tpu_topology_policy.py`), falling back to
+    STRICT_SPREAD placement on clusters without TPU topology labels."""
     bundles = [{"CPU": cpus_per_host, "TPU": float(chips_per_host)} for _ in range(num_hosts)]
     return placement_group(bundles, strategy=strategy)

@@ -1,0 +1,124 @@
+"""ICI-topology-aware host selection for TPU slice placement groups.
+
+New IP relative to the reference (its bundle policies — PACK/SPREAD/STRICT_* in
+`/root/reference/src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc` —
+know nothing about accelerator interconnect shape): bundles of a TPU slice gang
+are mapped onto hosts whose coordinates form a **contiguous sub-box of the host
+grid**, preferring boxes that span whole torus dimensions so ring collectives
+keep their wraparound links (v4/v5p cube constraint).
+
+Host grid: a v4-32 slice is a 4x4x2 chip mesh with 2x2x1 chips per host, i.e.
+a (2,2,2) grid of 8 hosts. Host coordinates come from node labels
+(`tpu_host_coord`), derived from TPU_WORKER_ID row-major over the host grid or
+set explicitly.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Coord = Tuple[int, ...]
+
+
+def host_grid(mesh_shape: Sequence[int], host_bounds: Sequence[int]) -> Tuple[int, ...]:
+    """Chip mesh shape / per-host chip bounds -> host grid shape."""
+    if len(mesh_shape) != len(host_bounds):
+        raise ValueError(f"rank mismatch: mesh {mesh_shape} vs host bounds {host_bounds}")
+    grid = []
+    for m, h in zip(mesh_shape, host_bounds):
+        if h <= 0 or m % h != 0:
+            raise ValueError(f"host bounds {host_bounds} do not tile mesh {mesh_shape}")
+        grid.append(m // h)
+    return tuple(grid)
+
+
+def coord_for_worker(worker_id: int, grid: Sequence[int]) -> Coord:
+    """Row-major (last dim fastest) host coordinate for a TPU_WORKER_ID."""
+    coord = []
+    rem = worker_id
+    for d in reversed(grid):
+        coord.append(rem % d)
+        rem //= d
+    return tuple(reversed(coord))
+
+
+def _box_shapes(n: int, grid: Sequence[int]) -> List[Tuple[int, ...]]:
+    """All factorizations of n into len(grid) dims that fit inside the grid."""
+    rank = len(grid)
+
+    def rec(remaining: int, dims: List[int]) -> List[Tuple[int, ...]]:
+        axis = len(dims)
+        if axis == rank - 1:
+            if remaining <= grid[axis]:
+                return [tuple(dims + [remaining])]
+            return []
+        out = []
+        for d in range(1, min(remaining, grid[axis]) + 1):
+            if remaining % d == 0:
+                out.extend(rec(remaining // d, dims + [d]))
+        return out
+
+    return rec(n, [])
+
+
+def _box_coords(origin: Coord, shape: Coord, grid: Sequence[int]) -> List[Coord]:
+    """Coordinates of the (cyclic) box at `origin`, wrapping modulo the grid."""
+    ranges = [
+        [(origin[a] + i) % grid[a] for i in range(shape[a])] for a in range(len(grid))
+    ]
+    return [tuple(c) for c in itertools.product(*ranges)]
+
+
+def _score(shape: Coord, origin: Coord, grid: Sequence[int]) -> Tuple:
+    """Higher is better: full spans of LONG dimensions first (wraparound only
+    pays off on rings longer than 2 hosts — a 2-ring's wrap link duplicates the
+    direct one), then compactness (smaller max span), then alignment."""
+    full_span = sum(g for s, g in zip(shape, grid) if s == g and g > 2)
+    compact = -max(shape)
+    aligned = -sum(o % max(s, 1) for o, s in zip(origin, shape))
+    return (full_span, compact, aligned)
+
+
+def choose_slice_hosts(
+    grid: Sequence[int],
+    available: Dict[Coord, str],
+    num_hosts: int,
+) -> Optional[List[str]]:
+    """Pick `num_hosts` hosts forming a contiguous sub-box of the host grid.
+
+    Args:
+      grid: host grid shape, e.g. (2, 2, 2) for v4-32.
+      available: host coordinate -> opaque host id, only feasible hosts.
+      num_hosts: bundles to place.
+
+    Returns host ids in lexicographic coordinate order (stable rank mapping for
+    jax.distributed process ids), or None if no contiguous box is available.
+    A box may wrap around a dimension (cyclic contiguity) — on a torus the
+    wrapped box has identical link structure to an aligned one.
+    """
+    total = 1
+    for g in grid:
+        total *= g
+    if num_hosts > total:
+        return None
+    best: Optional[Tuple[Tuple, List[Coord]]] = None
+    for shape in _box_shapes(num_hosts, grid):
+        for origin in itertools.product(*[range(g) for g in grid]):
+            coords = _box_coords(origin, shape, grid)
+            if any(c not in available for c in coords):
+                continue
+            score = _score(shape, origin, grid)
+            if best is None or score > best[0]:
+                best = (score, coords)
+    if best is None:
+        return None
+    return [available[c] for c in sorted(best[1])]
+
+
+def parse_coord(label: str) -> Coord:
+    return tuple(int(x) for x in label.split(","))
+
+
+def format_coord(coord: Coord) -> str:
+    return ",".join(str(c) for c in coord)

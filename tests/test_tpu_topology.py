@@ -1,0 +1,212 @@
+"""ICI-topology-aware TPU slice placement.
+
+New IP vs the reference (its bundle policies, `bundle_scheduling_policy.cc`,
+are interconnect-blind): TPU_SLICE places gang bundles on hosts forming a
+contiguous sub-box of the slice's host grid. Scenario from VERDICT: a fake
+v4-32 — 4x4x2 chips, 2x2x1 chips/host => (2,2,2) host grid, 8 hosts.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.tpu_topology_policy import (
+    choose_slice_hosts,
+    coord_for_worker,
+    format_coord,
+    host_grid,
+)
+
+
+# ------------------------------------------------------------------ pure policy
+def test_host_grid_v4_32():
+    assert host_grid((4, 4, 2), (2, 2, 1)) == (2, 2, 2)
+
+
+def test_coord_for_worker_row_major():
+    grid = (2, 2, 2)
+    coords = [coord_for_worker(i, grid) for i in range(8)]
+    assert coords[0] == (0, 0, 0)
+    assert coords[1] == (0, 0, 1)
+    assert coords[2] == (0, 1, 0)
+    assert coords[7] == (1, 1, 1)
+    assert len(set(coords)) == 8
+
+
+def _box_is_contiguous(coords, grid):
+    """Contiguous modulo wraparound: per-dim value sets form a cyclic run."""
+    coords = sorted(coords)
+    for axis in range(len(grid)):
+        vals = sorted({c[axis] for c in coords})
+        span = len(vals)
+        runs = any(
+            {(start + i) % grid[axis] for i in range(span)} == set(vals)
+            for start in range(grid[axis])
+        )
+        if not runs:
+            return False
+    # volume check: it's a full box, not an L-shape
+    vol = 1
+    for axis in range(len(grid)):
+        vol *= len({c[axis] for c in coords})
+    return vol == len(coords)
+
+
+def test_choose_slice_hosts_contiguous():
+    grid = (2, 2, 2)
+    avail = {coord_for_worker(i, grid): f"h{i}" for i in range(8)}
+    for n in (2, 4, 8):
+        hosts = choose_slice_hosts(grid, avail, n)
+        assert hosts is not None and len(hosts) == n
+        inv = {v: k for k, v in avail.items()}
+        assert _box_is_contiguous([inv[h] for h in hosts], grid)
+
+
+def test_choose_slice_hosts_avoids_holes():
+    """With a scattered non-contiguous subset free, selection still returns a
+    contiguous box from what IS free, or None when impossible."""
+    grid = (2, 2, 2)
+    all_coords = [coord_for_worker(i, grid) for i in range(8)]
+    # Free: one 1x2x2 slab (contiguous) + one far corner.
+    free = {c: f"h{i}" for i, c in enumerate(all_coords) if c[0] == 0}
+    free[(1, 1, 1)] = "h_far"
+    hosts = choose_slice_hosts(grid, free, 4)
+    inv = {v: k for k, v in free.items()}
+    coords = [inv[h] for h in hosts]
+    assert _box_is_contiguous(coords, grid)
+    assert all(c[0] == 0 for c in coords)  # the slab, not the corner
+
+
+def test_choose_slice_hosts_prefers_full_dims():
+    """A 4-host box in a (4,2) grid: prefer 4x1 (spans the full wraparound dim)
+    over 2x2."""
+    grid = (4, 2)
+    avail = {(x, y): f"h{x}{y}" for x in range(4) for y in range(2)}
+    hosts = choose_slice_hosts(grid, avail, 4)
+    inv = {v: k for k, v in avail.items()}
+    coords = [inv[h] for h in hosts]
+    xs = {c[0] for c in coords}
+    assert xs == {0, 1, 2, 3}  # full first dim -> wraparound preserved
+
+
+def test_choose_slice_hosts_wraparound_box():
+    """Cyclic contiguity: when only a wrapped run is free, use it."""
+    grid = (4,)
+    free = {(3,): "a", (0,): "b"}
+    hosts = choose_slice_hosts(grid, free, 2)
+    assert set(hosts) == {"a", "b"}
+
+
+def test_choose_slice_hosts_infeasible():
+    grid = (2, 2)
+    avail = {(0, 0): "a", (1, 1): "b"}  # diagonal: no contiguous 2-box
+    assert choose_slice_hosts(grid, avail, 2) is None
+    assert choose_slice_hosts(grid, avail, 5) is None
+
+
+# ------------------------------------------------------------------ end-to-end
+def _fake_v4_32_cluster(cluster):
+    """8 virtual nodes labeled as the hosts of a v4-32 slice."""
+    grid = (2, 2, 2)
+    nodes = []
+    for i in range(8):
+        c = coord_for_worker(i, grid)
+        nid = cluster.add_node(
+            num_cpus=2,
+            num_tpus=4,
+            labels={
+                "tpu_host_grid": "2x2x2",
+                "tpu_host_coord": format_coord(c),
+                "tpu_topology": "4x4x2",
+            },
+        )
+        nodes.append((nid, c))
+    return dict(nodes)
+
+
+def test_tpu_slice_pg_places_contiguous_box(ray_start_cluster):
+    from ray_tpu.util.placement_group import tpu_slice_placement_group
+
+    coords_by_node = _fake_v4_32_cluster(ray_start_cluster)
+    pg = tpu_slice_placement_group(num_hosts=4, chips_per_host=4, cpus_per_host=1)
+    assert pg.wait(timeout_seconds=30)
+    # Inspect the reservation: bundles must sit on 4 distinct hosts forming a
+    # contiguous sub-box of the (2,2,2) host grid.
+    sched = ray_start_cluster._scheduler
+    from ray_tpu._private.ids import PlacementGroupID
+
+    rec = sched.pgs[PlacementGroupID.from_hex(pg.id)]
+    chosen_nodes = [b.node for b in rec.bundles]
+    assert len(set(chosen_nodes)) == 4
+    coords = [coords_by_node[n] for n in chosen_nodes]
+    assert _box_is_contiguous(coords, (2, 2, 2))
+
+
+def test_tpu_slice_pg_full_slice(ray_start_cluster):
+    from ray_tpu.util.placement_group import tpu_slice_placement_group
+
+    _fake_v4_32_cluster(ray_start_cluster)
+    pg = tpu_slice_placement_group(num_hosts=8, chips_per_host=4, cpus_per_host=1)
+    assert pg.wait(timeout_seconds=30)
+
+
+def test_tpu_slice_pg_falls_back_without_labels(ray_start_cluster):
+    """No topology labels anywhere: TPU_SLICE degrades to STRICT_SPREAD-style
+    distinct-host placement."""
+    for _ in range(3):
+        ray_start_cluster.add_node(num_cpus=2)
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}] * 3, strategy="TPU_SLICE")
+    assert pg.wait(timeout_seconds=30)
+
+
+def test_tpu_slice_pg_never_mixes_pods(ray_start_cluster):
+    """Two physical slices with identical grids: a gang must come from ONE pod
+    (coordinates are only meaningful within a slice)."""
+    grid = (2, 2, 2)
+    node_pods = {}
+    for pod in ("podA", "podB"):
+        # podA has only 3 free hosts; podB has all 8.
+        count = 3 if pod == "podA" else 8
+        for i in range(count):
+            c = coord_for_worker(i, grid)
+            nid = ray_start_cluster.add_node(
+                num_cpus=1,
+                num_tpus=4,
+                labels={
+                    "tpu_host_grid": "2x2x2",
+                    "tpu_host_coord": format_coord(c),
+                    "tpu_pod_name": pod,
+                },
+            )
+            node_pods[nid] = pod
+    from ray_tpu.util.placement_group import tpu_slice_placement_group
+
+    pg = tpu_slice_placement_group(num_hosts=4, chips_per_host=4, cpus_per_host=1)
+    assert pg.wait(timeout_seconds=30)
+    sched = ray_start_cluster._scheduler
+    from ray_tpu._private.ids import PlacementGroupID
+
+    rec = sched.pgs[PlacementGroupID.from_hex(pg.id)]
+    pods = {node_pods[b.node] for b in rec.bundles}
+    assert pods == {"podB"}  # all four hosts from the one slice that fits
+
+
+def test_tpu_slice_heterogeneous_bundles_fall_back(ray_start_cluster):
+    """A bundle bigger than any labeled host falls back to spread placement on
+    unlabeled nodes instead of pending forever."""
+    grid = (2, 2)
+    for i in range(4):
+        ray_start_cluster.add_node(
+            num_cpus=1,
+            labels={
+                "tpu_host_grid": "2x2",
+                "tpu_host_coord": format_coord(coord_for_worker(i, grid)),
+            },
+        )
+    ray_start_cluster.add_node(num_cpus=8)  # big unlabeled node
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 8}], strategy="TPU_SLICE")
+    assert pg.wait(timeout_seconds=30)
