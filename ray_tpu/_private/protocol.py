@@ -51,6 +51,11 @@ class TaskSpec:
     actor_id: Optional[ActorID] = None
     is_actor_creation: bool = False
     method_name: Optional[str] = None
+    # >1 on the creation spec makes the actor threaded: calls run on a bounded
+    # pool, out of order (reference: threaded actors /
+    # `transport/concurrency_group_manager.h`); async def methods additionally
+    # interleave on the actor's event loop.
+    max_concurrency: int = 1
     # Scheduling
     scheduling_strategy: Any = None
     placement_group_id: Optional[PlacementGroupID] = None

@@ -198,7 +198,9 @@ class _WorkerState:
         self.job_id: Optional[JobID] = None
         self.store: Optional[LocalObjectStore] = None
         self.context = None  # DriverContext | WorkerProcContext
-        self.current_task_id: Optional[TaskID] = None
+        # Per-THREAD: threaded actors run concurrent calls, each with its own
+        # current task (put-ID minting and lineage attribution key off it).
+        self._task_tls = threading.local()
         self.current_actor_id: Optional[ActorID] = None
         self.session_dir: Optional[str] = None
         self.node = None  # driver only: the Node object
@@ -210,6 +212,14 @@ class _WorkerState:
         # Bumped on every init() so stale ref-flusher threads from a previous
         # session exit instead of flushing into the new one.
         self._session_gen: int = 0
+
+    @property
+    def current_task_id(self) -> Optional[TaskID]:
+        return getattr(self._task_tls, "task_id", None)
+
+    @current_task_id.setter
+    def current_task_id(self, value: Optional[TaskID]) -> None:
+        self._task_tls.task_id = value
 
     def next_put_id(self) -> ObjectID:
         with self._lock:

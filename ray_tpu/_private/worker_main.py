@@ -125,10 +125,37 @@ class WorkerRuntime:
         self.current_task_id: Optional[TaskID] = None
         self.current_task_name: str = ""
         self._put_counter = 0
+        # Threaded actors (max_concurrency > 1): calls run on daemon threads
+        # bounded by this semaphore, out of submission order (reference:
+        # threaded actors, `transport/concurrency_group_manager.h`).
+        self.concurrency: int = 1
+        self._call_slots: Optional[threading.Semaphore] = None
+        # Lazily-started event loop for `async def` actor methods (reference:
+        # asyncio actors, `core_worker/fiber.h`).
+        self._aio_loop = None
+        self._aio_lock = threading.Lock()
 
     def next_put_index(self) -> int:
         self._put_counter += 1
         return self._put_counter
+
+    def enable_concurrency(self, n: int) -> None:
+        self.concurrency = n
+        if n > 1:
+            self._call_slots = threading.Semaphore(n)
+
+    def run_coroutine(self, coro):
+        """Drive an async actor method to completion on this actor's event
+        loop. Coroutines from concurrent calls interleave on the one loop."""
+        import asyncio
+
+        with self._aio_lock:
+            if self._aio_loop is None:
+                loop = asyncio.new_event_loop()
+                t = threading.Thread(target=loop.run_forever, daemon=True, name="actor-aio")
+                t.start()
+                self._aio_loop = loop
+        return asyncio.run_coroutine_threadsafe(coro, self._aio_loop).result()
 
     def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
         """Make a segment-backed object readable on this node, pulling the bytes
@@ -187,6 +214,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
             cls = rt.load_function(spec.func.function_id, req.func_blob)
             rt.actor_instance = cls(*args, **kwargs)
             rt.actor_id = spec.actor_id
+            rt.enable_concurrency(getattr(spec, "max_concurrency", 1))
             worker_mod._set_current_actor_id(spec.actor_id)
             results = [None] * spec.num_returns if spec.num_returns else []
             out = None
@@ -199,6 +227,10 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
             else:
                 method = getattr(rt.actor_instance, spec.method_name)
                 out = method(*args, **kwargs)
+                import inspect
+
+                if inspect.iscoroutine(out):
+                    out = rt.run_coroutine(out)
         else:
             fn = rt.load_function(spec.func.function_id, req.func_blob)
             out = fn(*args, **kwargs)
@@ -275,6 +307,27 @@ def worker_loop(conn, args: WorkerArgs):
         req = wc.task_queue.get()
         if req is None:
             break
-        _execute(rt, req)
+        if (
+            rt.concurrency > 1
+            and req.spec.actor_id is not None
+            and not req.spec.is_actor_creation
+            and req.spec.method_name != "__ray_terminate__"
+        ):
+            # Threaded actor: bounded out-of-order execution on daemon threads
+            # (a blocked long-poll call must not stall other methods). The slot
+            # is acquired INSIDE the spawned thread — acquiring here would
+            # head-of-line-block the dispatch loop (and even __ray_terminate__)
+            # whenever all slots are parked in long waits.
+            def _run(r=req):
+                with rt._call_slots:
+                    _execute(rt, r)
+
+            threading.Thread(target=_run, daemon=True, name="actor-call").start()
+        else:
+            _execute(rt, req)
     rt.store.detach_all()
-    sys.exit(0)
+    # Daemon call threads may still be blocked (e.g. in a long-poll); the
+    # process is done serving — exit without joining them.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)

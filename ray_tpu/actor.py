@@ -154,6 +154,7 @@ class ActorClass:
             actor_id=actor_id,
             is_actor_creation=True,
             name=f"{self._cls.__name__}.__init__",
+            max_concurrency=max(1, int(opts.get("max_concurrency", 1))),
         )
         _apply_strategy(spec, opts.get("scheduling_strategy"))
         entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)

@@ -2,11 +2,12 @@
 
 Reference: `python/ray/serve/_private/controller.py:73` (`ServeController`)
 + `deployment_state.py:1009` (`DeploymentState` reconciler) +
-`autoscaling_policy.py`. One named actor holds the desired state
-(deployments -> replica sets), starts/stops replica actors to match, serves
-routing tables to routers (their poll replaces the reference's LongPollHost
-push, `long_poll.py:185`), and runs the autoscaling loop off router-reported
-load.
+`_private/long_poll.py:185` (`LongPollHost`) + `autoscaling_policy.py`.
+One named actor holds the desired state (deployments -> replica sets),
+starts/stops replica actors to match, PUSHES routing tables to routers and
+proxies via key-versioned long polls (`listen_for_change` — callers block in a
+threaded-actor slot until a watched key's version moves), and runs the
+autoscaling loop off router-reported load.
 """
 
 from __future__ import annotations
@@ -17,8 +18,17 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.serve._private.common import DeploymentInfo, ReplicaInfo
 
+# Long-poll keys: f"replicas::{deployment}" and ROUTES_KEY.
+ROUTES_KEY = "routes"
+# Server-side re-arm bound: a poll with no change returns {} after this long
+# and the client immediately re-polls (keeps slots from being held forever).
+LISTEN_TIMEOUT_S = 20.0
+
 
 class ServeController:
+    """Deploy with max_concurrency: long-polling routers each occupy one call
+    slot while they wait."""
+
     def __init__(self):
         self._deployments: Dict[str, DeploymentInfo] = {}
         self._replicas: Dict[str, List[ReplicaInfo]] = {}
@@ -28,11 +38,47 @@ class ServeController:
         self._load: Dict[str, Dict[str, Any]] = {}
         self._downscale_since: Dict[str, Optional[float]] = {}
         self._lock = threading.RLock()
+        self._change = threading.Condition(self._lock)
+        self._versions: Dict[str, int] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._autoscale_loop, daemon=True, name="serve-autoscaler"
         )
         self._thread.start()
+
+    # ------------------------------------------------------------- long poll
+    def _bump(self, key: str) -> None:
+        """Record a change under `key` and wake blocked listeners (must hold
+        self._lock)."""
+        self._versions[key] = self._versions.get(key, 0) + 1
+        self._change.notify_all()
+
+    def _snapshot(self, key: str):
+        if key == ROUTES_KEY:
+            return dict(self._routes)
+        if key.startswith("replicas::"):
+            return list(self._replicas.get(key[len("replicas::"):], []))
+        return None
+
+    def listen_for_change(self, known: Dict[str, int]) -> Dict[str, Any]:
+        """Block until any watched key's version differs from the caller's,
+        then return {key: (version, snapshot)} for the changed keys; {} on
+        server-side timeout (client re-arms). The push half of the reference's
+        LongPollHost (`long_poll.py:185`)."""
+        deadline = time.time() + LISTEN_TIMEOUT_S
+        with self._change:
+            while True:
+                changed = {
+                    k: (self._versions.get(k, 0), self._snapshot(k))
+                    for k, v in known.items()
+                    if self._versions.get(k, 0) != v
+                }
+                if changed:
+                    return changed
+                remaining = deadline - time.time()
+                if remaining <= 0 or self._stop.is_set():
+                    return {}
+                self._change.wait(remaining)
 
     # ------------------------------------------------------------- deployment
     def deploy(self, info: DeploymentInfo) -> None:
@@ -43,6 +89,7 @@ class ServeController:
             self._deployments[info.name] = info
             if info.route_prefix:
                 self._routes[info.route_prefix] = info.name
+                self._bump(ROUTES_KEY)
             if info.autoscaling_config:
                 target = max(
                     info.autoscaling_config.min_replicas,
@@ -61,6 +108,8 @@ class ServeController:
             self._deployments.pop(name, None)
             self._replicas.pop(name, None)
             self._routes = {p: d for p, d in self._routes.items() if d != name}
+            self._bump(ROUTES_KEY)
+            self._bump(f"replicas::{name}")
 
     def _scale_to(self, name: str, target: int) -> None:
         import ray_tpu
@@ -82,9 +131,11 @@ class ServeController:
             # Block until constructed so routing tables only list live replicas.
             ray_tpu.get(handle.__ray_ready__.remote())
             replicas.append(ReplicaInfo(rid, handle._actor_id, name))
+            self._bump(f"replicas::{name}")
         while len(replicas) > target:
             rep = replicas.pop()
             self._kill_replica(rep)
+            self._bump(f"replicas::{name}")
 
     def _kill_replica(self, rep: ReplicaInfo) -> None:
         import ray_tpu
@@ -123,8 +174,10 @@ class ServeController:
             replicas = self._replicas.get(name, [])
             before = len(replicas)
             replicas[:] = [r for r in replicas if r.replica_id != replica_id]
-            if len(replicas) < before and name in self._deployments:
-                self._scale_to(name, before)
+            if len(replicas) < before:
+                self._bump(f"replicas::{name}")
+                if name in self._deployments:
+                    self._scale_to(name, before)
 
     # ------------------------------------------------------------ autoscaling
     def report_load(self, name: str, router_id: str, inflight: int) -> None:
@@ -178,4 +231,5 @@ class ServeController:
             self._deployments.clear()
             self._replicas.clear()
             self._routes.clear()
-        self._stop.set()
+            self._stop.set()
+            self._change.notify_all()  # release parked long-polls

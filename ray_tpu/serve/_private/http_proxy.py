@@ -53,6 +53,9 @@ class HTTPProxy:
             target=self._serve_thread, args=(host, port), daemon=True, name="http"
         )
         t.start()
+        threading.Thread(
+            target=self._routes_listen_loop, daemon=True, name="routes-listen"
+        ).start()
         if not self._started.wait(timeout=30):
             raise RuntimeError("HTTP proxy failed to start in 30s")
         return self._port
@@ -78,12 +81,36 @@ class HTTPProxy:
         loop.run_forever()
 
     # ---------------------------------------------------------------- routing
+    def _routes_listen_loop(self):
+        """Park in the controller's long poll for route-table pushes (client
+        half of the reference's LongPollHost)."""
+        import time
+
+        import ray_tpu
+
+        version = -1
+        while True:
+            try:
+                updates = ray_tpu.get(
+                    self._controller.listen_for_change.remote({"routes": version}),
+                    timeout=60,
+                )
+            except Exception:
+                time.sleep(0.5)
+                continue
+            if "routes" in updates:
+                version, routes = updates["routes"]
+                self._routes = routes
+
     def _route_table(self) -> Dict[str, str]:
         import time
 
         import ray_tpu
 
-        if time.time() - self._routes_fetched > 2.0:
+        # Push keeps this fresh; the fallback fetch covers the pre-first-push
+        # window, rate-limited so a legitimately empty table (no routed
+        # deployments) doesn't turn every 404 into a controller round trip.
+        if not self._routes and time.time() - self._routes_fetched > 2.0:
             self._routes = ray_tpu.get(self._controller.get_routes.remote())
             self._routes_fetched = time.time()
         return self._routes

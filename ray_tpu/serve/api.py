@@ -104,7 +104,13 @@ def _get_controller(create: bool = True):
             raise RuntimeError("Serve is not running (call serve.run/start first)")
         handle = (
             ray_tpu.remote(ServeController)
-            .options(name=CONTROLLER_NAME, num_cpus=0.1, get_if_exists=True)
+            # Threaded: each long-polling router/proxy parks in one call slot.
+            .options(
+                name=CONTROLLER_NAME,
+                num_cpus=0.1,
+                max_concurrency=32,
+                get_if_exists=True,
+            )
             .remote()
         )
         ray_tpu.get(handle.__ray_ready__.remote())

@@ -2,10 +2,12 @@
 
 Reference: `python/ray/serve/handle.py` + `_private/router.py:263` — a handle
 routes each call to a replica via power-of-two-choices over the router's
-outstanding-request counts; replica membership refreshes by polling the
-controller (the poll stands in for the reference's LongPoll push updates).
-Dead replicas are reported to the controller (which replaces them) and the
-call retries on another replica.
+outstanding-request counts. Replica membership is PUSHED: a background
+listener parks in the controller's `listen_for_change` long poll (the client
+half of the reference's LongPollHost, `long_poll.py:185`) and swaps the local
+table the moment the replica set changes — no TTL staleness window. Dead
+replicas are reported to the controller (which replaces them) and the call
+retries on another replica.
 """
 
 from __future__ import annotations
@@ -16,7 +18,6 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
-_TABLE_TTL_S = 2.0
 _LOAD_REPORT_INTERVAL_S = 0.5
 
 
@@ -27,20 +28,62 @@ class Router:
         self._router_id = uuid.uuid4().hex[:8]
         self._lock = threading.Lock()
         self._replicas: List = []  # ReplicaInfo
-        self._fetched_at = 0.0
+        self._version = -1  # -1 = never synced; first listen returns current
+        self._have_table = threading.Event()
         self._inflight: Dict[str, List[Any]] = {}  # replica_id -> pending refs
         self._last_load_report = 0.0
+        self._closed = False
+        threading.Thread(
+            target=self._listen_loop, daemon=True, name=f"serve-listen-{deployment_name}"
+        ).start()
 
-    def _refresh(self, force: bool = False):
+    def _listen_loop(self):
+        """Park in the controller's long poll; apply pushed replica tables."""
         import ray_tpu
 
-        now = time.time()
-        if not force and self._replicas and now - self._fetched_at < _TABLE_TTL_S:
+        key = f"replicas::{self._name}"
+        failures = 0
+        while not self._closed:
+            try:
+                updates = ray_tpu.get(
+                    self._controller.listen_for_change.remote({key: self._version}),
+                    timeout=60,
+                )
+                failures = 0
+            except Exception:
+                failures += 1
+                if self._closed or failures >= 6:
+                    # Controller gone (serve.shutdown without closing handles):
+                    # stop spinning; route() falls back to direct fetches.
+                    return
+                time.sleep(0.5)
+                continue
+            if key in updates:
+                version, replicas = updates[key]
+                with self._lock:
+                    self._version = version
+                    self._replicas = replicas
+                self._have_table.set()
+
+    def close(self):
+        self._closed = True
+
+    def _ensure_table(self, force: bool = False):
+        """Ensure a table exists. Steady-state updates arrive via push; this
+        only blocks on the very first request (or re-pulls after a reported
+        failure, where waiting for the push would race the retry). MUST be
+        called without self._lock held: the listener needs that lock to apply
+        the push this may be waiting for."""
+        import ray_tpu
+
+        if self._replicas and not force:
             return
-        self._replicas = ray_tpu.get(
-            self._controller.get_replicas.remote(self._name)
-        )
-        self._fetched_at = now
+        if not force and self._have_table.wait(timeout=5.0) and self._replicas:
+            return
+        replicas = ray_tpu.get(self._controller.get_replicas.remote(self._name))
+        with self._lock:
+            if force or not self._replicas:
+                self._replicas = replicas
 
     def _sweep(self):
         """Drop completed refs from the inflight books (lazy decrement)."""
@@ -74,8 +117,8 @@ class Router:
         """
         from ray_tpu.actor import ActorHandle
 
+        self._ensure_table(force=force_refresh)  # outside the lock (push needs it)
         with self._lock:
-            self._refresh(force=force_refresh)
             if not self._replicas:
                 raise RuntimeError(f"no replicas for deployment '{self._name}'")
             self._sweep()
@@ -106,7 +149,6 @@ class Router:
             pass
         with self._lock:
             self._replicas = [r for r in self._replicas if r.replica_id != replica_id]
-            self._fetched_at = 0.0
 
 
 class DeploymentResponse:

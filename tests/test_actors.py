@@ -160,3 +160,34 @@ def test_actor_task_from_actor(ray_start_regular):
 
     p = Parent.remote()
     assert ray_tpu.get(p.delegate.remote()) == 1
+
+
+def test_threaded_actor_concurrency(ray_start_regular):
+    """max_concurrency>1 runs actor calls on a bounded pool, out of order."""
+
+    @ray_tpu.remote(max_concurrency=4)
+    class Gate:
+        def __init__(self):
+            import threading
+
+            self.ev = threading.Event()
+
+        def block(self):
+            self.ev.wait(30)
+            return "unblocked"
+
+        def open(self):
+            self.ev.set()
+            return "open"
+
+        async def async_mul(self, a, b):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return a * b
+
+    g = Gate.remote()
+    blocked = g.block.remote()
+    assert ray_tpu.get(g.open.remote(), timeout=15) == "open"
+    assert ray_tpu.get(blocked, timeout=15) == "unblocked"
+    assert ray_tpu.get(g.async_mul.remote(6, 7), timeout=15) == 42

@@ -201,3 +201,70 @@ def test_autoscaling_scales_up(serve_ctx):
         except Exception:
             pass
     assert scaled, "autoscaler never scaled up under load"
+
+
+def test_long_poll_pushes_replica_changes(serve_ctx):
+    """A router that never issues requests learns replica-set changes within
+    ~1s via the controller's listen_for_change push — no TTL window."""
+
+    @serve.deployment(num_replicas=1)
+    class Svc:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Svc.bind(), _blocking_http=False)
+    assert handle.remote(1).result() == 1
+    router = handle._router
+    old_ids = {r.replica_id for r in router._replicas}
+    assert old_ids
+
+    # Scale to 3 via redeploy; the idle router's listener must pick it up.
+    serve.run(Svc.options(num_replicas=3).bind(), _blocking_http=False)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with router._lock:
+            ids = {r.replica_id for r in router._replicas}
+        if len(ids) == 3 and not (ids & old_ids):
+            break
+        time.sleep(0.05)
+    assert len(ids) == 3 and not (ids & old_ids), ids
+
+
+def test_dead_replica_push_updates_other_routers(serve_ctx):
+    """Router A discovers a dead replica and reports it; idle router B's table
+    is corrected by push, sub-second, without B sending any request."""
+
+    @serve.deployment(num_replicas=1)
+    class Svc2:
+        def __call__(self, x):
+            return x
+
+        def die(self, _):
+            import os
+
+            os._exit(1)
+
+    handle_a = serve.run(Svc2.bind(), _blocking_http=False)
+    assert handle_a.remote(1).result() == 1
+    handle_b = serve.get_deployment_handle("Svc2")
+    router_b = handle_b._ensure_router()
+    router_b._have_table.wait(timeout=5)
+    dead_id = router_b._replicas[0].replica_id
+
+    try:
+        handle_a.die.remote(0).result(timeout=15)
+    except Exception:
+        pass
+    # A's next call hits the dead replica, reports, retries; controller pushes
+    # the replacement table to B.
+    assert handle_a.remote(2).result(timeout=30) == 2
+    deadline = time.time() + 3
+    replaced = False
+    while time.time() < deadline:
+        with router_b._lock:
+            ids = {r.replica_id for r in router_b._replicas}
+        if ids and dead_id not in ids:
+            replaced = True
+            break
+        time.sleep(0.05)
+    assert replaced, f"router B still routes to dead replica {dead_id}"
