@@ -1,0 +1,122 @@
+"""Core-runtime microbenchmark: the `ray microbenchmark` analogue
+(`/root/reference/python/ray/_private/ray_perf.py:93`), so control-plane
+rewrites have a number to move (the reference's C++ envelope sustains ~1M
+queued tasks/node, `release/benchmarks/README.md:30`).
+
+Measures, on a local single-node runtime:
+  - put/get throughput for small (inline) and large (shm zero-copy) objects
+  - task submit->get roundtrips (sync) and pipelined async task throughput
+  - actor method roundtrips (sync) and pipelined async call throughput
+
+Prints one human table plus one JSON line per metric:
+  {"metric": ..., "value": ..., "unit": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def timeit(name, fn, n, unit="ops/s", scale=1.0):
+    # Warmup, then timed run.
+    fn(max(1, n // 10))
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    rate = n * scale / dt
+    return {"metric": name, "value": round(rate, 1), "unit": unit, "n": n, "seconds": round(dt, 3)}
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    results = []
+
+    # ------------------------------------------------------------- put / get
+    small = b"x" * 1024
+
+    def put_small(n):
+        refs = [ray_tpu.put(small) for _ in range(n)]
+        del refs
+
+    results.append(timeit("put_1KB", put_small, 2000))
+
+    big = np.zeros(1_250_000)  # 10 MB
+
+    def put_large(n):
+        refs = [ray_tpu.put(big) for _ in range(n)]
+        del refs
+
+    results.append(timeit("put_10MB", put_large, 100, unit="GB/s", scale=0.01))
+
+    ref_small = ray_tpu.put(small)
+
+    def get_small(n):
+        for _ in range(n):
+            ray_tpu.get(ref_small)
+
+    results.append(timeit("get_1KB", get_small, 2000))
+
+    ref_big = ray_tpu.put(big)
+
+    def get_large(n):
+        for _ in range(n):
+            ray_tpu.get(ref_big)
+
+    results.append(timeit("get_10MB_zero_copy", get_large, 200, unit="GB/s", scale=0.01))
+
+    # ----------------------------------------------------------------- tasks
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    def task_sync(n):
+        for _ in range(n):
+            ray_tpu.get(nop.remote())
+
+    results.append(timeit("task_roundtrip_sync", task_sync, 300))
+
+    def task_async(n):
+        ray_tpu.get([nop.remote() for _ in range(n)])
+
+    results.append(timeit("task_throughput_async", task_async, 1500))
+
+    # ---------------------------------------------------------------- actors
+    @ray_tpu.remote
+    class A:
+        def nop(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.nop.remote())
+
+    def actor_sync(n):
+        for _ in range(n):
+            ray_tpu.get(a.nop.remote())
+
+    results.append(timeit("actor_call_roundtrip_sync", actor_sync, 500))
+
+    def actor_async(n):
+        ray_tpu.get([a.nop.remote() for _ in range(n)])
+
+    results.append(timeit("actor_call_throughput_async", actor_async, 3000))
+
+    ray_tpu.shutdown()
+
+    width = max(len(r["metric"]) for r in results) + 2
+    print()
+    print(f"{'benchmark'.ljust(width)}{'rate':>14}  unit")
+    print("-" * (width + 26))
+    for r in results:
+        print(f"{r['metric'].ljust(width)}{r['value']:>14,.1f}  {r['unit']}")
+    print()
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

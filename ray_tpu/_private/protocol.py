@@ -61,8 +61,11 @@ class TaskSpec:
     placement_group_id: Optional[PlacementGroupID] = None
     placement_group_bundle_index: int = -1
     name: str = ""
-    # Runtime env (subset: env_vars)
+    # Runtime env: env_vars apply per task; the rest (pip/working_dir/
+    # py_modules) provisions a dedicated per-env worker pool
+    # (reference: `_private/runtime_env/`, dedicated workers in worker_pool.h).
     env_vars: Dict[str, str] = field(default_factory=dict)
+    runtime_env: Optional[Dict[str, Any]] = None
 
 
 @dataclass

@@ -1,0 +1,163 @@
+"""Runtime environments: per-task/actor pip packages, working_dir, py_modules.
+
+Reference: `python/ray/_private/runtime_env/` + the per-node agent
+(`dashboard/modules/runtime_env/runtime_env_agent.py:162 GetOrCreateRuntimeEnv`)
+— envs are created once per node, cached by content hash, and workers using an
+env get it applied before their task loop. Here setup runs inside the worker
+process at startup (`worker_main.worker_loop`): simpler than a separate agent,
+same cache-by-hash behavior (concurrent workers coordinate via an atomic
+marker), and failures surface as RuntimeEnvSetupError on the tasks.
+
+Supported keys:
+  env_vars: {str: str}        — applied by the scheduler at spawn (spec.env_vars)
+  pip: [requirement|wheel]    — `pip install --target` into the cached env dir
+  pip_install_options: [str]  — extra pip flags (e.g. ["--no-index"])
+  working_dir: path           — copied into the env dir; cwd + sys.path for the worker
+  py_modules: [path]          — modules/packages copied onto sys.path
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional
+
+_SETUP_KEYS = ("pip", "pip_install_options", "working_dir", "py_modules")
+CACHE_ROOT = os.environ.get("RAY_TPU_RUNTIME_ENV_CACHE", "/tmp/ray_tpu_runtime_envs")
+
+
+def needs_isolated_worker(renv: Optional[Dict[str, Any]]) -> bool:
+    """True if this runtime_env requires per-env worker pooling (anything
+    beyond env_vars, which plain workers already apply per task)."""
+    return bool(renv) and any(renv.get(k) for k in _SETUP_KEYS)
+
+
+def env_hash(renv: Optional[Dict[str, Any]]) -> str:
+    if not needs_isolated_worker(renv):
+        return ""
+    payload = {k: renv.get(k) for k in _SETUP_KEYS if renv.get(k)}
+    return hashlib.sha1(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _install_pip(renv: Dict[str, Any], target: str) -> None:
+    reqs = list(renv.get("pip") or [])
+    if not reqs:
+        return
+    cmd = [
+        sys.executable, "-m", "pip", "install",
+        "--target", target,
+        "--no-warn-script-location",
+        "--disable-pip-version-check",
+    ] + list(renv.get("pip_install_options") or []) + reqs
+    proc = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pip install failed (rc={proc.returncode}):\n{proc.stdout[-4000:]}"
+        )
+
+
+def _copy_working_dir(renv: Dict[str, Any], env_dir: str) -> Optional[str]:
+    src = renv.get("working_dir")
+    if not src:
+        return None
+    dst = os.path.join(env_dir, "working_dir")
+    if not os.path.exists(dst):
+        shutil.copytree(src, dst, symlinks=True)
+    return dst
+
+
+def _copy_py_modules(renv: Dict[str, Any], pkg_dir: str) -> None:
+    for mod in renv.get("py_modules") or []:
+        base = os.path.basename(mod.rstrip("/"))
+        dst = os.path.join(pkg_dir, base)
+        if os.path.exists(dst):
+            continue
+        if os.path.isdir(mod):
+            shutil.copytree(mod, dst, symlinks=True)
+        else:
+            os.makedirs(pkg_dir, exist_ok=True)
+            shutil.copy2(mod, dst)
+
+
+def ensure_runtime_env(renv: Optional[Dict[str, Any]], timeout_s: float = 300.0) -> Optional[str]:
+    """Create (or reuse) the cached env dir for `renv`; returns its path.
+
+    Concurrency: the first worker to claim the hash dir builds it and writes a
+    DONE marker; others wait for the marker (the per-node agent's
+    GetOrCreateRuntimeEnv semantics, without the agent)."""
+    h = env_hash(renv)
+    if not h:
+        return None
+    env_dir = os.path.join(CACHE_ROOT, h)
+    done = os.path.join(env_dir, ".DONE")
+    fail = os.path.join(env_dir, ".FAILED")
+    builder = False
+    for _attempt in range(2):
+        try:
+            os.makedirs(env_dir)
+            builder = True
+            break
+        except FileExistsError:
+            if os.path.exists(fail):
+                # A previous build failed: retire the poisoned dir (atomic
+                # rename claims it against concurrent retirers) and rebuild
+                # instead of failing forever.
+                trash = f"{env_dir}.trash.{os.getpid()}.{int(time.time() * 1e6)}"
+                try:
+                    os.rename(env_dir, trash)
+                    shutil.rmtree(trash, ignore_errors=True)
+                except OSError:
+                    time.sleep(0.1)  # another process is retiring/rebuilding
+                continue
+            break
+    if builder:
+        try:
+            pkg_dir = os.path.join(env_dir, "pkgs")
+            os.makedirs(pkg_dir, exist_ok=True)
+            _install_pip(renv, pkg_dir)
+            _copy_working_dir(renv, env_dir)
+            _copy_py_modules(renv, pkg_dir)
+            with open(done, "w") as f:
+                f.write("ok")
+        except Exception as e:  # noqa: BLE001
+            with open(fail, "w") as f:
+                f.write(repr(e))
+            raise
+    else:
+        deadline = time.time() + timeout_s
+        while not os.path.exists(done):
+            if os.path.exists(fail):
+                with open(fail) as f:
+                    raise RuntimeError(f"runtime_env build failed: {f.read()}")
+            if time.time() > deadline:
+                # Builder likely died mid-build (no marker either way): retire
+                # the partial dir so the next task rebuilds from scratch.
+                trash = f"{env_dir}.trash.{os.getpid()}.{int(time.time() * 1e6)}"
+                try:
+                    os.rename(env_dir, trash)
+                    shutil.rmtree(trash, ignore_errors=True)
+                except OSError:
+                    pass
+                raise TimeoutError(f"timed out waiting for runtime_env {h}")
+            time.sleep(0.1)
+    return env_dir
+
+
+def apply_runtime_env(renv: Optional[Dict[str, Any]]) -> None:
+    """Make the env active in THIS process: sys.path for pip/py_modules, cwd +
+    sys.path for working_dir. Called once at worker startup."""
+    env_dir = ensure_runtime_env(renv)
+    if env_dir is None:
+        return
+    pkg_dir = os.path.join(env_dir, "pkgs")
+    if os.path.isdir(pkg_dir):
+        sys.path.insert(0, pkg_dir)
+    wd = os.path.join(env_dir, "working_dir")
+    if os.path.isdir(wd):
+        os.chdir(wd)
+        sys.path.insert(0, wd)

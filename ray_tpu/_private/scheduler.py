@@ -158,6 +158,9 @@ class WorkerHandle:
     state: str = "idle"  # idle | busy | blocked
     current_task: Optional[TaskID] = None
     actor_id: Optional[ActorID] = None
+    # Hash of the worker's provisioned runtime env; idle reuse is per-hash
+    # (reference: dedicated workers for runtime envs, worker_pool.h:609).
+    env_hash: str = ""
     known_functions: set = field(default_factory=set)
     send_lock: threading.Lock = field(default_factory=threading.Lock)
     outbox: List[bytes] = field(default_factory=list)
@@ -745,9 +748,10 @@ class Scheduler:
 
     # ------------------------------------------------------------------ workers
     def _spawn_worker(self, node: NodeState, actor_id: Optional[ActorID] = None,
-                      env_vars: Optional[Dict[str, str]] = None) -> WorkerHandle:
+                      env_vars: Optional[Dict[str, str]] = None,
+                      runtime_env: Optional[Dict] = None) -> WorkerHandle:
         if node.daemon is not None:
-            return self._spawn_remote_worker(node, actor_id, env_vars)
+            return self._spawn_remote_worker(node, actor_id, env_vars, runtime_env)
         worker_id = WorkerID.from_random()
         args = WorkerArgs(
             worker_id_hex=worker_id.hex(),
@@ -757,6 +761,7 @@ class Scheduler:
             config=self.config,
             env_vars=env_vars or {},
             is_actor_worker=actor_id is not None,
+            runtime_env=runtime_env,
         )
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
@@ -776,12 +781,15 @@ class Scheduler:
             cwd=repo_root,
         )
         out.close()
+        from ray_tpu._private.runtime_env import env_hash as _renv_hash
+
         wh = WorkerHandle(
             worker_id=worker_id,
             node_id=node.node_id,
             process=_Proc(popen),
             state="idle" if actor_id is None else "busy",
             actor_id=actor_id,
+            env_hash=_renv_hash(runtime_env),
         )
         node.workers[worker_id] = wh
         self._workers_by_id[worker_id.hex()] = wh
@@ -790,10 +798,13 @@ class Scheduler:
         return wh
 
     def _spawn_remote_worker(self, node: NodeState, actor_id: Optional[ActorID],
-                             env_vars: Optional[Dict[str, str]]) -> WorkerHandle:
+                             env_vars: Optional[Dict[str, str]],
+                             runtime_env: Optional[Dict] = None) -> WorkerHandle:
         """Lease a worker on a daemon-managed node: the daemon execs the worker
         process, which dials back over TCP (reference: raylet WorkerPool start,
         `/root/reference/src/ray/raylet/worker_pool.h:77`)."""
+        from ray_tpu._private.runtime_env import env_hash as _renv_hash
+
         worker_id = WorkerID.from_random()
         args = WorkerArgs(
             worker_id_hex=worker_id.hex(),
@@ -803,6 +814,7 @@ class Scheduler:
             config=self.config,
             env_vars=env_vars or {},
             is_actor_worker=actor_id is not None,
+            runtime_env=runtime_env,
         )
         wh = WorkerHandle(
             worker_id=worker_id,
@@ -810,6 +822,7 @@ class Scheduler:
             process=_RemoteProc(node.daemon, worker_id.hex()),
             state="idle" if actor_id is None else "busy",
             actor_id=actor_id,
+            env_hash=_renv_hash(runtime_env),
         )
         node.workers[worker_id] = wh
         self._workers_by_id[worker_id.hex()] = wh
@@ -2088,12 +2101,19 @@ class Scheduler:
         node = self._pick_node(rec)
         if node is None:
             return False
-        # 4) worker
+        # 4) worker — idle reuse is per runtime-env hash (plain tasks reuse
+        # plain workers; pip/working_dir tasks get/reuse provisioned workers).
+        from ray_tpu._private.runtime_env import env_hash as _renv_hash
+
+        want_hash = _renv_hash(rec.spec.runtime_env)
         wh = None
-        while node.idle:
-            wid = node.idle.pop(0)
+        for wid in list(node.idle):
             cand = node.workers.get(wid)
-            if cand is not None and cand.process.is_alive():
+            if cand is None or not cand.process.is_alive():
+                node.idle.remove(wid)
+                continue
+            if cand.env_hash == want_hash:
+                node.idle.remove(wid)
                 wh = cand
                 break
         if wh is None:
@@ -2103,8 +2123,24 @@ class Scheduler:
             # node's cap by every other node's actors).
             node_actors = sum(1 for w in node.workers.values() if w.actor_id is not None)
             if len(node.workers) >= max_workers + node_actors:
-                return False
-            wh = self._spawn_worker(node)
+                # At cap with no matching worker: evict an idle worker of a
+                # different env hash to make room (the reference raylet kills
+                # idle workers to admit dedicated-env workers) — otherwise a
+                # pool full of mismatched-env workers deadlocks this task.
+                victim = None
+                for wid in node.idle:
+                    cand = node.workers.get(wid)
+                    if cand is not None and cand.env_hash != want_hash:
+                        victim = cand
+                        break
+                if victim is None:
+                    return False
+                try:
+                    victim.process.terminate()
+                except Exception:
+                    pass
+                self._on_worker_death(victim)
+            wh = self._spawn_worker(node, runtime_env=rec.spec.runtime_env)
             node.idle.remove(wh.worker_id)
         # 5) acquire + dispatch
         if rec.acquired_pg is not None:
@@ -2158,7 +2194,10 @@ class Scheduler:
         num_tpus = rec.spec.resources.get("TPU", 0)
         if num_tpus:
             env_vars.setdefault("TPU_CHIPS", str(int(num_tpus)))
-        wh = self._spawn_worker(node, actor_id=ar.actor_id, env_vars=env_vars)
+        wh = self._spawn_worker(
+            node, actor_id=ar.actor_id, env_vars=env_vars,
+            runtime_env=rec.spec.runtime_env,
+        )
         ar.worker = wh.worker_id
         ar.node = node.node_id
         rec.state = "RUNNING"

@@ -36,6 +36,10 @@ class WorkerArgs:
     config: Config
     env_vars: Dict[str, str]
     is_actor_worker: bool = False
+    # Applied once at startup (pip/working_dir/py_modules; see
+    # _private/runtime_env.py); failures surface as RuntimeEnvSetupError on
+    # every task this worker is asked to run.
+    runtime_env: Optional[Dict[str, Any]] = None
 
 
 class WorkerConnection:
@@ -125,15 +129,17 @@ class WorkerRuntime:
         self.current_task_id: Optional[TaskID] = None
         self.current_task_name: str = ""
         self._put_counter = 0
-        # Threaded actors (max_concurrency > 1): calls run on daemon threads
-        # bounded by this semaphore, out of submission order (reference:
-        # threaded actors, `transport/concurrency_group_manager.h`).
+        # Threaded actors (max_concurrency > 1): calls drain through a bounded
+        # pool of daemon threads, out of submission order (reference: threaded
+        # actors, `transport/concurrency_group_manager.h`).
         self.concurrency: int = 1
-        self._call_slots: Optional[threading.Semaphore] = None
+        self._call_queue = None
         # Lazily-started event loop for `async def` actor methods (reference:
         # asyncio actors, `core_worker/fiber.h`).
         self._aio_loop = None
         self._aio_lock = threading.Lock()
+        # Set when runtime_env provisioning failed: every task errors with it.
+        self.setup_error: Optional[BaseException] = None
 
     def next_put_index(self) -> int:
         self._put_counter += 1
@@ -142,7 +148,22 @@ class WorkerRuntime:
     def enable_concurrency(self, n: int) -> None:
         self.concurrency = n
         if n > 1:
-            self._call_slots = threading.Semaphore(n)
+            # n daemon threads draining one queue: bounded concurrency without
+            # spawning a thread per queued call, and the dispatch loop never
+            # blocks (a stdlib ThreadPoolExecutor's non-daemon threads would
+            # also stall interpreter exit while calls are parked in long polls).
+            self._call_queue: "queue.SimpleQueue" = queue.SimpleQueue()
+
+            def drain():
+                while True:
+                    fn = self._call_queue.get()
+                    fn()
+
+            for i in range(n):
+                threading.Thread(target=drain, daemon=True, name=f"actor-call-{i}").start()
+
+    def submit_call(self, fn) -> None:
+        self._call_queue.put(fn)
 
     def run_coroutine(self, coro):
         """Drive an async actor method to completion on this actor's event
@@ -205,6 +226,10 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
     for k, v in spec.env_vars.items():
         os.environ[k] = v
     try:
+        if rt.setup_error is not None:
+            raise exceptions.RuntimeEnvSetupError(
+                f"runtime_env setup failed for this worker: {rt.setup_error!r}"
+            )
         args = [rt.fetch_value(m) for m in req.arg_metas]
         kwargs = {k: rt.fetch_value(m) for k, m in req.kwarg_metas.items()}
         # Resolve any ObjectRefs that arrived as *resolved values already* — the
@@ -302,6 +327,13 @@ def worker_loop(conn, args: WorkerArgs):
     reader.start()
 
     worker_mod._start_ref_flusher()
+    if args.runtime_env:
+        from ray_tpu._private.runtime_env import apply_runtime_env
+
+        try:
+            apply_runtime_env(args.runtime_env)
+        except Exception as e:  # noqa: BLE001 — surfaced per-task as setup error
+            rt.setup_error = e
     wc.send(("register", args.worker_id_hex, os.getpid()))
     while True:
         req = wc.task_queue.get()
@@ -313,21 +345,11 @@ def worker_loop(conn, args: WorkerArgs):
             and not req.spec.is_actor_creation
             and req.spec.method_name != "__ray_terminate__"
         ):
-            # Threaded actor: bounded out-of-order execution on daemon threads
-            # (a blocked long-poll call must not stall other methods). The slot
-            # is acquired INSIDE the spawned thread — acquiring here would
-            # head-of-line-block the dispatch loop (and even __ray_terminate__)
-            # whenever all slots are parked in long waits.
-            def _run(r=req):
-                with rt._call_slots:
-                    _execute(rt, r)
-
-            threading.Thread(target=_run, daemon=True, name="actor-call").start()
+            # Threaded actor: bounded out-of-order execution on the actor's
+            # call-thread pool (a blocked long-poll call must not stall other
+            # methods; __ray_terminate__ stays on the dispatch loop).
+            rt.submit_call(lambda r=req: _execute(rt, r))
         else:
             _execute(rt, req)
     rt.store.detach_all()
-    # Daemon call threads may still be blocked (e.g. in a long-poll); the
-    # process is done serving — exit without joining them.
-    sys.stdout.flush()
-    sys.stderr.flush()
-    os._exit(0)
+    sys.exit(0)

@@ -146,6 +146,7 @@ class ActorClass:
         actor_id = ActorID.of(global_worker.job_id)
         task_id = global_worker.next_task_id()
         resources = _resources_from_options(opts, default_cpus=0.0)
+        renv = dict(opts.get("runtime_env") or {})
         spec = TaskSpec(
             task_id=task_id,
             func=FunctionDescriptor(self._function_id, self._cls.__name__),
@@ -155,6 +156,8 @@ class ActorClass:
             is_actor_creation=True,
             name=f"{self._cls.__name__}.__init__",
             max_concurrency=max(1, int(opts.get("max_concurrency", 1))),
+            env_vars=dict(renv.get("env_vars") or {}),
+            runtime_env={k: v for k, v in renv.items() if k != "env_vars"} or None,
         )
         _apply_strategy(spec, opts.get("scheduling_strategy"))
         entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)

@@ -103,6 +103,7 @@ class RemoteFunction:
         self._ensure_pickled()
         num_returns = int(opts.get("num_returns", 1))
         task_id = global_worker.next_task_id()
+        renv = dict(opts.get("runtime_env") or {})
         spec = TaskSpec(
             task_id=task_id,
             func=FunctionDescriptor(self._function_id, self.__name__),
@@ -110,6 +111,8 @@ class RemoteFunction:
             resources=_resources_from_options(opts, default_cpus=1.0),
             max_retries=int(opts.get("max_retries", 0)),
             name=opts.get("name") or self.__name__,
+            env_vars=dict(renv.get("env_vars") or {}),
+            runtime_env={k: v for k, v in renv.items() if k != "env_vars"} or None,
         )
         _apply_strategy(spec, opts.get("scheduling_strategy"))
         entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)

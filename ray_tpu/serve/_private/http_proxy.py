@@ -89,13 +89,18 @@ class HTTPProxy:
         import ray_tpu
 
         version = -1
+        failures = 0
         while True:
             try:
                 updates = ray_tpu.get(
                     self._controller.listen_for_change.remote({"routes": version}),
                     timeout=60,
                 )
+                failures = 0
             except Exception:
+                failures += 1
+                if failures >= 6:
+                    return  # controller gone; fallback fetch path takes over
                 time.sleep(0.5)
                 continue
             if "routes" in updates:

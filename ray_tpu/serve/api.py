@@ -104,11 +104,13 @@ def _get_controller(create: bool = True):
             raise RuntimeError("Serve is not running (call serve.run/start first)")
         handle = (
             ray_tpu.remote(ServeController)
-            # Threaded: each long-polling router/proxy parks in one call slot.
+            # Threaded: each long-polling router/proxy parks in one call slot;
+            # sized generously — parked threads are cheap, starved deploys are
+            # not (large fleets: shard routers over per-node controllers).
             .options(
                 name=CONTROLLER_NAME,
                 num_cpus=0.1,
-                max_concurrency=32,
+                max_concurrency=256,
                 get_if_exists=True,
             )
             .remote()
@@ -242,6 +244,9 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
+    from ray_tpu.serve.handle import close_all_routers
+
+    close_all_routers()
     if "controller" in _client:
         try:
             ray_tpu.get(_client["controller"].shutdown.remote())

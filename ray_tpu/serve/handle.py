@@ -20,6 +20,17 @@ from typing import Any, Dict, List, Optional
 
 _LOAD_REPORT_INTERVAL_S = 0.5
 
+# Every live Router in this process; serve.shutdown() closes them so their
+# long-poll listeners release controller call slots.
+import weakref
+
+_all_routers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def close_all_routers() -> None:
+    for r in list(_all_routers):
+        r.close()
+
 
 class Router:
     def __init__(self, deployment_name: str, controller):
@@ -33,6 +44,7 @@ class Router:
         self._inflight: Dict[str, List[Any]] = {}  # replica_id -> pending refs
         self._last_load_report = 0.0
         self._closed = False
+        _all_routers.add(self)
         threading.Thread(
             target=self._listen_loop, daemon=True, name=f"serve-listen-{deployment_name}"
         ).start()

@@ -1,0 +1,171 @@
+"""Runtime environments: per-task/actor pip, working_dir, py_modules.
+
+Reference: `python/ray/_private/runtime_env/` + runtime_env_agent
+(GetOrCreateRuntimeEnv at `runtime_env_agent.py:272`). Network-free: pip
+installs from a locally crafted wheel with --no-index.
+"""
+
+import os
+import shutil
+import textwrap
+import zipfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env import CACHE_ROOT, env_hash, needs_isolated_worker
+
+
+@pytest.fixture(autouse=True)
+def _clean_env_cache():
+    shutil.rmtree(CACHE_ROOT, ignore_errors=True)
+    yield
+    shutil.rmtree(CACHE_ROOT, ignore_errors=True)
+
+
+def _make_wheel(tmp_path, name="rtenv_demo", version="0.1", value=42) -> str:
+    """A minimal offline-installable wheel exposing {name}.VALUE."""
+    whl = os.path.join(str(tmp_path), f"{name}-{version}-py3-none-any.whl")
+    dist = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}/__init__.py", f"VALUE = {value}\n")
+        z.writestr(
+            f"{dist}/METADATA",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+        )
+        z.writestr(
+            f"{dist}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\nTag: py3-none-any\n",
+        )
+        z.writestr(f"{dist}/RECORD", "")
+    return whl
+
+
+def test_env_hash_and_isolation_predicate():
+    assert env_hash(None) == ""
+    assert env_hash({"env_vars": {"A": "1"}}) == ""  # plain workers handle these
+    h1 = env_hash({"pip": ["x"]})
+    h2 = env_hash({"pip": ["y"]})
+    assert h1 and h2 and h1 != h2
+    assert needs_isolated_worker({"working_dir": "/tmp"})
+    assert not needs_isolated_worker({"env_vars": {"A": "1"}})
+
+
+def test_pip_env_isolated_from_siblings(ray_start_regular, tmp_path):
+    whl = _make_wheel(tmp_path)
+    renv = {"pip": [whl], "pip_install_options": ["--no-index", "--no-deps"]}
+
+    @ray_tpu.remote(runtime_env=renv)
+    def with_pkg():
+        import rtenv_demo
+
+        return rtenv_demo.VALUE
+
+    @ray_tpu.remote
+    def without_pkg():
+        try:
+            import rtenv_demo  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "clean"
+
+    assert ray_tpu.get(with_pkg.remote(), timeout=120) == 42
+    # Sibling worker without the env must not see the package.
+    assert ray_tpu.get(without_pkg.remote(), timeout=60) == "clean"
+
+
+def test_pip_env_actor(ray_start_regular, tmp_path):
+    whl = _make_wheel(tmp_path, value=7)
+    renv = {"pip": [whl], "pip_install_options": ["--no-index", "--no-deps"]}
+
+    @ray_tpu.remote(runtime_env=renv)
+    class Uses:
+        def val(self):
+            import rtenv_demo
+
+            return rtenv_demo.VALUE
+
+    a = Uses.remote()
+    assert ray_tpu.get(a.val.remote(), timeout=120) == 7
+
+
+def test_working_dir(ray_start_regular, tmp_path):
+    wd = tmp_path / "app"
+    wd.mkdir()
+    (wd / "data.txt").write_text("hello-wd")
+    (wd / "helper.py").write_text(
+        textwrap.dedent(
+            """
+            def read_data():
+                with open("data.txt") as f:
+                    return f.read()
+            """
+        )
+    )
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+    def uses_wd():
+        import helper
+
+        return helper.read_data()
+
+    assert ray_tpu.get(uses_wd.remote(), timeout=60) == "hello-wd"
+
+
+def test_py_modules(ray_start_regular, tmp_path):
+    mod = tmp_path / "sidecar_mod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("NAME = 'sidecar'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def uses_mod():
+        import sidecar_mod
+
+        return sidecar_mod.NAME
+
+    assert ray_tpu.get(uses_mod.remote(), timeout=60) == "sidecar"
+
+
+def test_runtime_env_setup_failure_surfaces(ray_start_regular):
+    @ray_tpu.remote(
+        runtime_env={
+            "pip": ["definitely-not-a-real-package-xyz"],
+            "pip_install_options": ["--no-index"],
+        },
+        max_retries=0,
+    )
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        ray_tpu.get(f.remote(), timeout=120)
+
+
+def test_env_workers_pooled_separately(ray_start_regular, tmp_path):
+    """Same env reuses its worker; different envs use different workers."""
+    wd1 = tmp_path / "e1"
+    wd1.mkdir()
+    (wd1 / "tag.txt").write_text("one")
+    wd2 = tmp_path / "e2"
+    wd2.mkdir()
+    (wd2 / "tag.txt").write_text("two")
+
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    def tagged(wd):
+        @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+        def t():
+            with open("tag.txt") as f:
+                return (os.getpid(), f.read())
+
+        return t
+
+    p1a, tag1 = ray_tpu.get(tagged(wd1).remote(), timeout=60)
+    p1b, _ = ray_tpu.get(tagged(wd1).remote(), timeout=60)
+    p2, tag2 = ray_tpu.get(tagged(wd2).remote(), timeout=60)
+    assert tag1 == "one" and tag2 == "two"
+    assert p1a == p1b  # same env -> pooled worker reused
+    assert p2 != p1a  # different env -> different worker
