@@ -762,6 +762,7 @@ class Scheduler:
             env_vars=env_vars or {},
             is_actor_worker=actor_id is not None,
             runtime_env=runtime_env,
+            head_address=f"{self.tcp_address[0]}:{self.tcp_address[1]}",
         )
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
@@ -815,6 +816,7 @@ class Scheduler:
             env_vars=env_vars or {},
             is_actor_worker=actor_id is not None,
             runtime_env=runtime_env,
+            head_address=f"{self.tcp_address[0]}:{self.tcp_address[1]}",
         )
         wh = WorkerHandle(
             worker_id=worker_id,
@@ -1357,6 +1359,40 @@ class Scheduler:
     def _cmd_task_events(self, _):
         return list(self.gcs.task_events)
 
+    def _cmd_list_tasks(self, payload):
+        limit = int(payload or 1000)
+        out = []
+        for rec in list(self.tasks.values())[-limit:]:
+            out.append(
+                {
+                    "task_id": rec.spec.task_id.hex(),
+                    "name": rec.spec.name or rec.spec.func.name,
+                    "state": rec.state,
+                    "actor_id": rec.spec.actor_id.hex() if rec.spec.actor_id else None,
+                    "node_id": rec.node.hex() if rec.node else None,
+                    "retries_left": rec.retries_left,
+                    "submitted_at": rec.submitted_at,
+                }
+            )
+        return out
+
+    def _cmd_list_objects(self, payload):
+        limit = int(payload or 1000)
+        out = []
+        for key, meta in list(self.object_table.items())[-limit:]:
+            out.append(
+                {
+                    "object_id": meta.object_id.hex(),
+                    "size": meta.size,
+                    "in_shm": meta.segment is not None,
+                    "node_id": meta.node_id.hex() if meta.node_id else None,
+                    "holders": sorted(self.holders.get(key, ())),
+                    "pins": self.pins.get(key, 0),
+                    "is_error": meta.is_error,
+                }
+            )
+        return out
+
     def _cmd_list_actors(self, _):
         return [
             {
@@ -1465,7 +1501,8 @@ class Scheduler:
     _DRIVER_CMDS = frozenset(
         {
             "free", "register_function", "remove_pg", "cancel", "task_events",
-            "list_actors", "get_nodes", "add_node", "remove_node",
+            "list_actors", "list_tasks", "list_objects", "get_nodes",
+            "add_node", "remove_node",
         }
     )
 

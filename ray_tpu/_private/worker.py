@@ -319,6 +319,12 @@ class DriverContext:
     def list_actors(self):
         return self.scheduler.call("list_actors", None).result()
 
+    def list_tasks(self, limit=1000):
+        return self.scheduler.call("list_tasks", limit).result()
+
+    def list_objects(self, limit=1000):
+        return self.scheduler.call("list_objects", limit).result()
+
     def free(self, ids: List[bytes]):
         return self.scheduler.call("free", ids).result()
 
@@ -455,6 +461,12 @@ class RemoteDriverContext:
     def list_actors(self):
         return self.wc.request("driver_cmd", ("list_actors", None))
 
+    def list_tasks(self, limit=1000):
+        return self.wc.request("driver_cmd", ("list_tasks", limit))
+
+    def list_objects(self, limit=1000):
+        return self.wc.request("driver_cmd", ("list_objects", limit))
+
     def free(self, ids):
         return self.wc.request("driver_cmd", ("free", ids))
 
@@ -557,13 +569,19 @@ class WorkerProcContext:
         return self.rt.wc.request("cluster_resources", None)
 
     def nodes(self):
-        return []
+        return self.rt.wc.request("driver_cmd", ("get_nodes", None))
 
     def task_events(self):
-        return []
+        return self.rt.wc.request("driver_cmd", ("task_events", None))
 
     def list_actors(self):
-        return []
+        return self.rt.wc.request("driver_cmd", ("list_actors", None))
+
+    def list_tasks(self, limit=1000):
+        return self.rt.wc.request("driver_cmd", ("list_tasks", limit))
+
+    def list_objects(self, limit=1000):
+        return self.rt.wc.request("driver_cmd", ("list_objects", limit))
 
     def free(self, ids):
         return []

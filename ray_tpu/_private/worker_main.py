@@ -40,6 +40,10 @@ class WorkerArgs:
     # _private/runtime_env.py); failures surface as RuntimeEnvSetupError on
     # every task this worker is asked to run.
     runtime_env: Optional[Dict[str, Any]] = None
+    # "host:port" of the head's TCP listener, exported as RAY_TPU_ADDRESS so
+    # subprocesses a task launches (e.g. job-submission entrypoints) can join
+    # the cluster as client drivers.
+    head_address: Optional[str] = None
 
 
 class WorkerConnection:
@@ -314,6 +318,8 @@ def worker_loop(conn, args: WorkerArgs):
     set_config(args.config)
     for k, v in args.env_vars.items():
         os.environ.setdefault(k, v)
+    if args.head_address:
+        os.environ.setdefault("RAY_TPU_ADDRESS", args.head_address)
     wc = WorkerConnection(conn)
     wc.exit_on_eof = True
     rt = WorkerRuntime(args, wc)

@@ -132,6 +132,12 @@ class ActorClass:
         ac._function_id = self._function_id
         return ac
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy actor DAG node (reference: `dag/class_node.py`)."""
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> ActorHandle:
         worker_mod._auto_init()
         opts = self._options

@@ -98,6 +98,13 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (reference: `dag/function_node.py`); run the
+        graph with `.execute(...)`."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def _remote(self, args, kwargs, opts):
         worker_mod._auto_init()
         self._ensure_pickled()

@@ -1,0 +1,267 @@
+"""Operational CLI, the analogue of `ray start/stop/status/list/timeline/...`
+(reference: `python/ray/scripts/scripts.py` — `ray start:529`, `ray stop:1013`,
+`ray microbenchmark`, `ray timeline`, state CLI `experimental/state/state_cli.py`).
+
+Usage (via `python -m ray_tpu`):
+  start --head [--port P] [--num-cpus N] [--num-tpus N]   start a head server
+  start --address HOST:PORT [--num-cpus N] ...            start a node daemon
+  stop                                                    stop processes this CLI started
+  status [--address A]                                    cluster resource + entity rollup
+  list {nodes,actors,tasks,objects} [--address A]
+  timeline --output FILE [--address A]                    chrome://tracing dump
+  microbenchmark                                          run bench_core
+  job submit --entrypoint "python x.py" [--working-dir D] [--address A]
+  job {status,logs,list,stop} ...
+
+Connection resolution: --address flag, else RAY_TPU_ADDRESS env, else the
+head this CLI started (recorded in ~/.ray_tpu/cli_state.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+STATE_FILE = os.path.expanduser("~/.ray_tpu/cli_state.json")
+
+
+def _load_state() -> dict:
+    try:
+        with open(STATE_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_state(state: dict) -> None:
+    os.makedirs(os.path.dirname(STATE_FILE), exist_ok=True)
+    with open(STATE_FILE, "w") as f:
+        json.dump(state, f, indent=2)
+
+
+def _connect(ns):
+    """init() against the resolved address (or error out with guidance)."""
+    import ray_tpu
+
+    address = getattr(ns, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
+    state = _load_state()
+    if not address and state.get("head"):
+        address = state["head"]["address"]
+        os.environ.setdefault("RAY_TPU_AUTHKEY_HEX", state["head"]["authkey_hex"])
+    if not address:
+        sys.exit(
+            "no cluster address: pass --address, set RAY_TPU_ADDRESS, or "
+            "`python -m ray_tpu start --head` first"
+        )
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+# ----------------------------------------------------------------- start/stop
+def cmd_start(ns):
+    state = _load_state()
+    if ns.head:
+        cmd = [sys.executable, "-m", "ray_tpu._private.head", "--port", str(ns.port),
+               "--host", ns.host]
+        if ns.num_cpus is not None:
+            cmd += ["--num-cpus", str(ns.num_cpus)]
+        if ns.num_tpus is not None:
+            cmd += ["--num-tpus", str(ns.num_tpus)]
+        if ns.resources:
+            cmd += ["--resources", ns.resources]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        info = None
+        for _ in range(600):
+            line = proc.stdout.readline()
+            if not line:
+                sys.exit("head process exited during startup")
+            if line.startswith("RAY_TPU_HEAD_READY "):
+                info = json.loads(line[len("RAY_TPU_HEAD_READY "):])
+                break
+        if info is None:
+            proc.terminate()
+            sys.exit("head process never reported ready; terminated it")
+        state["head"] = {"pid": proc.pid, **info}
+        _save_state(state)
+        print(f"head started: address={info['address']} pid={proc.pid}")
+        print(f"connect with: ray_tpu.init(address=\"{info['address']}\")  "
+              f"[RAY_TPU_AUTHKEY_HEX={info['authkey_hex']}]")
+    else:
+        if not ns.address:
+            sys.exit("start needs --head or --address HOST:PORT")
+        head = state.get("head") or {}
+        env = dict(os.environ)
+        if "RAY_TPU_AUTHKEY_HEX" not in env and head.get("authkey_hex"):
+            env["RAY_TPU_AUTHKEY_HEX"] = head["authkey_hex"]
+        shm_dir = ns.shm_dir or tempfile.mkdtemp(prefix="ray_tpu_node_")
+        resources = json.loads(ns.resources) if ns.resources else {}
+        if ns.num_cpus is not None:
+            resources.setdefault("CPU", float(ns.num_cpus))
+        if ns.num_tpus:
+            resources.setdefault("TPU", float(ns.num_tpus))
+        cmd = [sys.executable, "-m", "ray_tpu._private.node_daemon",
+               "--address", ns.address, "--shm-dir", shm_dir,
+               "--resources", json.dumps(resources)]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                                text=True, env=env)
+        node_id = None
+        for _ in range(600):
+            line = proc.stdout.readline()
+            if not line:
+                sys.exit("node daemon exited before registering")
+            if line.startswith("RAY_TPU_NODE_READY "):
+                node_id = line.split()[1]
+                break
+        state.setdefault("daemons", []).append({"pid": proc.pid, "node_id": node_id})
+        _save_state(state)
+        print(f"node daemon started: node_id={node_id} pid={proc.pid}")
+
+
+def cmd_stop(_ns):
+    state = _load_state()
+    stopped = 0
+    for d in state.get("daemons", []):
+        try:
+            os.kill(d["pid"], signal.SIGTERM)
+            stopped += 1
+        except ProcessLookupError:
+            pass
+    head = state.get("head")
+    if head:
+        try:
+            os.kill(head["pid"], signal.SIGTERM)
+            stopped += 1
+        except ProcessLookupError:
+            pass
+    _save_state({})
+    print(f"stopped {stopped} process(es)")
+
+
+# --------------------------------------------------------------------- state
+def cmd_status(ns):
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    print(json.dumps(state_api.summarize(), indent=2, default=str))
+
+
+def cmd_list(ns):
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    fn = {
+        "nodes": state_api.list_nodes,
+        "actors": state_api.list_actors,
+        "tasks": state_api.list_tasks,
+        "objects": state_api.list_objects,
+    }[ns.what]
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_timeline(ns):
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    events = state_api.timeline(ns.output)
+    print(f"wrote {len(events)} events to {ns.output}")
+
+
+def cmd_microbenchmark(_ns):
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.path.insert(0, repo_root)
+    import bench_core
+
+    bench_core.main()
+
+
+# ---------------------------------------------------------------------- jobs
+def cmd_job(ns):
+    _connect(ns)
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if ns.job_cmd == "submit":
+        renv = {}
+        if ns.working_dir:
+            renv["working_dir"] = ns.working_dir
+        job_id = client.submit_job(entrypoint=ns.entrypoint, runtime_env=renv or None)
+        print(job_id)
+        if ns.wait:
+            status = client.wait_until_finished(job_id, timeout=ns.timeout)
+            print(status)
+            print(client.get_job_logs(job_id), end="")
+            sys.exit(0 if status == "SUCCEEDED" else 1)
+    elif ns.job_cmd == "status":
+        print(client.get_job_status(ns.job_id))
+    elif ns.job_cmd == "logs":
+        print(client.get_job_logs(ns.job_id), end="")
+    elif ns.job_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2))
+    elif ns.job_cmd == "stop":
+        print("stopped" if client.stop_job(ns.job_id) else "not running")
+
+
+# ---------------------------------------------------------------------- main
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head server or node daemon")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="head address (node-daemon mode)")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--resources", help="JSON resource map")
+    sp.add_argument("--shm-dir")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop processes started by this CLI")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster rollup")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster entities")
+    sp.add_argument("what", choices=["nodes", "actors", "tasks", "objects"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("timeline", help="dump chrome://tracing timeline")
+    sp.add_argument("--output", default="timeline.json")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("microbenchmark", help="run the core microbenchmark")
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--entrypoint", required=True)
+    j.add_argument("--working-dir")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("--address")
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+        j.add_argument("--address")
+    j = jsub.add_parser("list")
+    j.add_argument("--address")
+    sp.set_defaults(fn=cmd_job)
+
+    ns = p.parse_args(argv)
+    ns.fn(ns)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""State API: programmatic cluster introspection.
+
+Reference: `python/ray/experimental/state/api.py` (+ `state_cli.py`,
+`dashboard/state_aggregator.py:133 StateAPIManager`): `ray list
+tasks/actors/objects/nodes`, `ray timeline`. Same surface here, served from
+the scheduler's live tables over the driver connection.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.worker import _auto_init, global_worker
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    _auto_init()
+    return global_worker.context.nodes()
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    _auto_init()
+    return global_worker.context.list_actors()
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    _auto_init()
+    return global_worker.context.list_tasks(limit)
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    _auto_init()
+    return global_worker.context.list_objects(limit)
+
+
+def summarize() -> Dict[str, Any]:
+    """`ray status`-style rollup: resources + entity counts."""
+    _auto_init()
+    ctx = global_worker.context
+    tasks = ctx.list_tasks(100000)
+    by_state: Dict[str, int] = {}
+    for t in tasks:
+        by_state[t["state"]] = by_state.get(t["state"], 0) + 1
+    return {
+        "cluster_resources": ctx.cluster_resources(),
+        "available_resources": ctx.available_resources(),
+        "nodes": len(ctx.nodes()),
+        "actors": len(ctx.list_actors()),
+        "tasks_by_state": by_state,
+        "objects": len(ctx.list_objects(100000)),
+    }
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-tracing events from the task-event log (reference:
+    `GlobalState.chrome_tracing_dump`, `_private/state.py:435` /
+    `ray timeline`). Returns the event list; writes JSON if `filename`."""
+    _auto_init()
+    events = global_worker.context.task_events()
+    # Pair RUNNING -> FINISHED/FAILED into chrome "X" (complete) events.
+    open_ts: Dict[str, float] = {}
+    trace: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev.state == "RUNNING":
+            open_ts[ev.task_id] = ev.timestamp
+        elif ev.state in ("FINISHED", "FAILED", "CANCELLED"):
+            start = open_ts.pop(ev.task_id, None)
+            if start is not None:
+                trace.append(
+                    {
+                        "name": ev.name,
+                        "cat": "task",
+                        "ph": "X",
+                        "ts": int(start * 1e6),
+                        "dur": int((ev.timestamp - start) * 1e6),
+                        "pid": "cluster",
+                        "tid": ev.task_id[:8],
+                        "args": {"state": ev.state},
+                    }
+                )
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,158 @@
+"""State API, job submission, and the operational CLI.
+
+Reference surfaces: `experimental/state/api.py` + `state_cli.py` (list/
+timeline), `dashboard/modules/job/job_manager.py` (+ SDK), and
+`scripts/scripts.py` (`ray start/stop/status`).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state as state_api
+
+
+def test_list_tasks_objects_nodes(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    refs = [f.remote(i) for i in range(3)]
+    assert ray_tpu.get(refs, timeout=30) == [1, 2, 3]
+    import numpy as np
+
+    big = ray_tpu.put(np.zeros(50_000))
+
+    tasks = state_api.list_tasks()
+    assert len([t for t in tasks if t["name"] == "f"]) == 3
+    assert all(t["state"] == "FINISHED" for t in tasks if t["name"] == "f")
+    objs = state_api.list_objects()
+    assert any(o["object_id"] == big.hex() and o["in_shm"] for o in objs)
+    assert len(state_api.list_nodes()) == 1
+    summary = state_api.summarize()
+    assert summary["nodes"] == 1
+    assert summary["tasks_by_state"].get("FINISHED", 0) >= 3
+
+
+def test_timeline_chrome_trace(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(2)], timeout=30)
+    out = str(tmp_path / "trace.json")
+    events = state_api.timeline(out)
+    assert len(events) >= 2
+    loaded = json.load(open(out))
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in loaded)
+
+
+def test_job_submission_end_to_end(ray_start_regular, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    script = tmp_path / "entry.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            print("job says hello")
+            print("lines", 1 + 1)
+            """
+        )
+    )
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(job_id)
+    assert "job says hello" in logs
+    assert client.list_jobs()[job_id] == JobStatus.SUCCEEDED
+    info = client.get_job_info(job_id)
+    assert info["entrypoint"].endswith("entry.py")
+
+
+def test_job_failure_status(ray_start_regular, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; print('dying'); sys.exit(3)\n")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    assert client.wait_until_finished(job_id, timeout=120) == JobStatus.FAILED
+    assert "dying" in client.get_job_logs(job_id)
+
+
+def test_job_uses_cluster_as_client_driver(ray_start_regular, tmp_path):
+    """The entrypoint joins THIS cluster via RAY_TPU_ADDRESS and runs a task."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    script = tmp_path / "cluster_job.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import os, sys
+            sys.path.insert(0, os.environ["RAY_TPU_REPO"])
+            import ray_tpu
+            ray_tpu.init(address=os.environ["RAY_TPU_ADDRESS"])
+
+            @ray_tpu.remote
+            def from_job(x):
+                return x * 3
+
+            print("cluster result:", ray_tpu.get(from_job.remote(14)))
+            """
+        )
+    )
+    os.environ["RAY_TPU_REPO"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        client = JobSubmissionClient()
+        job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+        assert client.wait_until_finished(job_id, timeout=120) == JobStatus.SUCCEEDED
+        assert "cluster result: 42" in client.get_job_logs(job_id)
+    finally:
+        os.environ.pop("RAY_TPU_REPO", None)
+
+
+def test_cli_start_status_list_stop(tmp_path):
+    """Full CLI cycle against a real head process: start --head, status,
+    list nodes, job submit --wait, stop."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["HOME"] = str(tmp_path)  # isolate ~/.ray_tpu/cli_state.json
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def cli(*args, timeout=120):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            env=env, cwd=repo_root, timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    try:
+        r = cli("start", "--head", "--num-cpus", "2", "--num-tpus", "0")
+        assert r.returncode == 0, r.stdout
+        assert "head started" in r.stdout
+
+        r = cli("status")
+        assert r.returncode == 0, r.stdout
+        summary = json.loads(r.stdout)
+        assert summary["nodes"] == 1
+
+        r = cli("list", "nodes")
+        assert r.returncode == 0, r.stdout
+        assert len(json.loads(r.stdout)) == 1
+
+        script = tmp_path / "cli_job.py"
+        script.write_text("print('cli job ran')\n")
+        r = cli("job", "submit", "--entrypoint", f"{sys.executable} {script}", "--wait")
+        assert r.returncode == 0, r.stdout
+        assert "cli job ran" in r.stdout
+    finally:
+        r = cli("stop", timeout=30)
+        assert "stopped" in r.stdout
