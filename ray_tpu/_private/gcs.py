@@ -112,3 +112,50 @@ class GCS:
         if len(self.task_events) > self._task_event_cap:
             # Bounded store with head drop, like the reference's gcs_task_manager.
             del self.task_events[: self._task_event_cap // 10]
+
+    # --- persistence (reference: RedisStoreClient-backed GCS fault tolerance,
+    # `store_client/redis_store_client.h:28`, restore at `gcs_server.cc:59`) ---
+    def snapshot_bytes(self) -> bytes:
+        """Serialize the durable tables: the KV store (jobs/metrics/user data
+        ride it) and the function table. Live entities (actors, nodes, task
+        events) die with their processes and are intentionally not persisted —
+        the reference reconstructs those from re-registration, not storage."""
+        import pickle
+
+        with self.store._lock:
+            data = {t: dict(kv) for t, kv in self.store._data.items()}
+        # function_table is mutated by the scheduler thread without a lock;
+        # retry the copy across "dict changed size" races.
+        for _ in range(5):
+            try:
+                functions = dict(self.function_table)
+                break
+            except RuntimeError:
+                continue
+        else:
+            functions = {}
+        return pickle.dumps({"store": data, "functions": functions})
+
+    def restore_bytes(self, blob: bytes) -> None:
+        import pickle
+
+        payload = pickle.loads(blob)
+        with self.store._lock:
+            self.store._data = {t: dict(kv) for t, kv in payload["store"].items()}
+        self.function_table.update(payload.get("functions", {}))
+
+    def save_to(self, path: str) -> None:
+        import os
+
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(self.snapshot_bytes())
+        os.replace(tmp, path)
+
+    def load_from(self, path: str) -> bool:
+        try:
+            with open(path, "rb") as f:
+                self.restore_bytes(f.read())
+            return True
+        except FileNotFoundError:
+            return False

@@ -37,6 +37,20 @@ def main() -> None:
     parser.add_argument("--num-tpus", type=float, default=None)
     parser.add_argument("--resources", default="{}", help="extra JSON resource map")
     parser.add_argument("--system-config", default="{}", help="JSON Config overrides")
+    parser.add_argument(
+        "--persist",
+        default=None,
+        help="GCS persistence file: restore on boot, checkpoint periodically "
+        "(KV + function table survive head restarts; reference: redis-backed "
+        "GCS fault tolerance)",
+    )
+    parser.add_argument("--persist-interval", type=float, default=5.0)
+    parser.add_argument(
+        "--dashboard-port",
+        type=int,
+        default=None,
+        help="start the REST dashboard on this port (0 = ephemeral)",
+    )
     ns = parser.parse_args()
 
     from ray_tpu._private.accelerators import tpu as tpu_accel
@@ -60,6 +74,13 @@ def main() -> None:
     os.makedirs(os.path.join(session_dir, "shm"), exist_ok=True)
 
     gcs = GCS()
+    if ns.persist and gcs.load_from(ns.persist):
+        # Jobs that were in flight when the previous head died have no live
+        # supervisor anymore: fail them (the reference marks in-flight jobs
+        # failed on GCS recovery).
+        for key in gcs.kv_keys(b"job::"):
+            if key.endswith(b"::status") and gcs.kv_get(key) in (b"RUNNING", b"PENDING"):
+                gcs.kv_put(key, b"FAILED")
     scheduler = Scheduler(
         gcs, cfg, session_dir, tcp_port=ns.port, advertise_host=ns.host, bind_host=ns.bind_host
     )
@@ -68,6 +89,28 @@ def main() -> None:
     scheduler.call("add_node", (resources, labels)).result()
 
     stop = threading.Event()
+
+    if ns.persist:
+        def _persist_loop():
+            while not stop.wait(ns.persist_interval):
+                try:
+                    gcs.save_to(ns.persist)
+                except Exception:
+                    pass  # transient (incl. concurrent-mutation races); retry next tick
+
+        threading.Thread(target=_persist_loop, daemon=True, name="gcs-persist").start()
+
+    dashboard_port = None
+    if ns.dashboard_port is not None:
+        # The dashboard needs a driver context for state queries: the head
+        # process self-connects as a client driver.
+        import ray_tpu
+
+        os.environ["RAY_TPU_AUTHKEY_HEX"] = scheduler.authkey.hex()
+        ray_tpu.init(address=f"{scheduler.tcp_address[0]}:{scheduler.tcp_address[1]}")
+        from ray_tpu.dashboard import start_dashboard
+
+        dashboard_port = start_dashboard(ns.host, ns.dashboard_port).port
 
     def _signal(_sig, _frm):
         stop.set()
@@ -80,9 +123,16 @@ def main() -> None:
         "session_dir": session_dir,
         "authkey_hex": scheduler.authkey.hex(),
     }
+    if dashboard_port is not None:
+        ready["dashboard_port"] = dashboard_port
     print("RAY_TPU_HEAD_READY " + json.dumps(ready), flush=True)
 
     stop.wait()
+    if ns.persist:
+        try:
+            gcs.save_to(ns.persist)
+        except OSError:
+            pass
     scheduler.stop()
     shutil.rmtree(session_dir, ignore_errors=True)
     sys.exit(0)

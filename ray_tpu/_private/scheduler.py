@@ -209,6 +209,8 @@ class NodeState:
     # Set for nodes backed by a separate daemon process; None for the head's
     # in-process node and virtual test nodes.
     daemon: Optional[DaemonHandle] = None
+    # Last time work was dispatched here (autoscaler idle detection).
+    last_active: float = field(default_factory=time.time)
 
     def utilization(self) -> float:
         total = sum(v for v in self.resources.values() if v > 0) or 1.0
@@ -1376,6 +1378,41 @@ class Scheduler:
             )
         return out
 
+    def _cmd_autoscaler_state(self, _):
+        """Demand + supply snapshot for the autoscaler (the analogue of the
+        GCS monitor endpoint the reference autoscaler polls,
+        `gcs/gcs_server/gcs_monitor_server.h` / `load_metrics.py`)."""
+        now = time.time()
+        pending = [dict(rec.spec.resources) for rec in self.pending if rec.state == "PENDING"]
+        pending_bundles = [
+            dict(b.resources)
+            for pg in self.pending_pgs
+            for b in pg.bundles
+            if b.node is None
+        ]
+        nodes = []
+        for n in self.nodes.values():
+            busy = sum(1 for w in n.workers.values() if w.state in ("busy", "blocked"))
+            actors = sum(1 for w in n.workers.values() if w.actor_id is not None)
+            nodes.append(
+                {
+                    "node_id": n.node_id.hex(),
+                    "resources": dict(n.resources),
+                    "available": dict(n.available),
+                    "labels": dict(n.labels),
+                    "alive": n.alive,
+                    "busy_workers": busy,
+                    "actors": actors,
+                    "idle_s": max(0.0, now - n.last_active),
+                    "is_daemon": n.daemon is not None,
+                }
+            )
+        return {
+            "pending_tasks": pending,
+            "pending_bundles": pending_bundles,
+            "nodes": nodes,
+        }
+
     def _cmd_list_objects(self, payload):
         limit = int(payload or 1000)
         out = []
@@ -1502,7 +1539,7 @@ class Scheduler:
         {
             "free", "register_function", "remove_pg", "cancel", "task_events",
             "list_actors", "list_tasks", "list_objects", "get_nodes",
-            "add_node", "remove_node",
+            "add_node", "remove_node", "autoscaler_state",
         }
     )
 
@@ -2190,6 +2227,7 @@ class Scheduler:
         rec.state = "RUNNING"
         rec.worker = wh.worker_id
         rec.node = node.node_id
+        node.last_active = time.time()
         wh.state = "busy"
         wh.current_task = rec.spec.task_id
         self._record_event(rec.spec, "RUNNING")
@@ -2225,6 +2263,7 @@ class Scheduler:
         else:
             _acquire(node.available, rec.spec.resources)
         ar.acquired = dict(rec.spec.resources)
+        node.last_active = time.time()
         env_vars = dict(rec.spec.env_vars)
         # TPU visibility: give the actor its chip share (analogue of
         # CUDA_VISIBLE_DEVICES assignment in the reference's resource allocator).

@@ -325,6 +325,9 @@ class DriverContext:
     def list_objects(self, limit=1000):
         return self.scheduler.call("list_objects", limit).result()
 
+    def autoscaler_state(self):
+        return self.scheduler.call("autoscaler_state", None).result()
+
     def free(self, ids: List[bytes]):
         return self.scheduler.call("free", ids).result()
 
@@ -467,6 +470,9 @@ class RemoteDriverContext:
     def list_objects(self, limit=1000):
         return self.wc.request("driver_cmd", ("list_objects", limit))
 
+    def autoscaler_state(self):
+        return self.wc.request("driver_cmd", ("autoscaler_state", None))
+
     def free(self, ids):
         return self.wc.request("driver_cmd", ("free", ids))
 
@@ -582,6 +588,9 @@ class WorkerProcContext:
 
     def list_objects(self, limit=1000):
         return self.rt.wc.request("driver_cmd", ("list_objects", limit))
+
+    def autoscaler_state(self):
+        return self.rt.wc.request("driver_cmd", ("autoscaler_state", None))
 
     def free(self, ids):
         return []

@@ -1,0 +1,45 @@
+"""Autoscaler: demand-driven node scale-up and idle scale-down.
+
+Reference: `python/ray/autoscaler/` (~21k LoC — `StandardAutoscaler`
+(`_private/autoscaler.py:172`), `Monitor` (`monitor.py:127`), cloud
+`NodeProvider` plugins, `fake_multi_node` test provider). Same architecture,
+TPU-first providers:
+
+ - `StandardAutoscaler`: reads the scheduler's demand snapshot (pending task
+   resource shapes + unplaced PG bundles + per-node idle time), bin-packs
+   demand onto configured node types, asks the provider for nodes, and
+   terminates nodes idle past the timeout (respecting min_workers).
+ - `NodeProvider` plugins: `FakeMultiNodeProvider` (virtual scheduler nodes,
+   the `fake_multi_node` analogue), `LocalDaemonProvider` (real node-daemon
+   processes on this machine), and `TpuQueuedResourcesProvider` (gcloud
+   queued-resources command builder for TPU pod slices — the provider SURVEY
+   §7 step 6 calls for; requires gcloud at runtime).
+ - `Monitor`: background thread driving the loop (the reference's monitor
+   process, colocated here).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    Monitor,
+    NodeTypeConfig,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.node_provider import (
+    FakeMultiNodeProvider,
+    LocalDaemonProvider,
+    NodeProvider,
+    TpuQueuedResourcesProvider,
+)
+from ray_tpu.autoscaler.sdk import request_resources
+
+__all__ = [
+    "AutoscalerConfig",
+    "NodeTypeConfig",
+    "StandardAutoscaler",
+    "Monitor",
+    "NodeProvider",
+    "FakeMultiNodeProvider",
+    "LocalDaemonProvider",
+    "TpuQueuedResourcesProvider",
+    "request_resources",
+]

@@ -1,0 +1,159 @@
+"""NodeProvider plugin interface + built-in providers.
+
+Reference: `python/ray/autoscaler/node_provider.py` (the plugin API cloud
+providers implement) and `_private/fake_multi_node/node_provider.py:237`
+(`FakeMultiNodeProvider`, the test double nearly every autoscaler test uses).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Create/terminate nodes of a named node type. `node_config` is the
+    type's config dict (resources, labels, provider-specific fields)."""
+
+    def create_node(self, node_type: str, node_config: Dict[str, Any]) -> str:
+        """Launch one node; returns a provider node id."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Registers virtual nodes with the in-process scheduler — pure-logic
+    autoscaler tests without processes (the fake_multi_node analogue)."""
+
+    def __init__(self):
+        self._nodes: Dict[str, Any] = {}
+
+    def create_node(self, node_type: str, node_config: Dict[str, Any]) -> str:
+        from ray_tpu._private.ids import NodeID
+        from ray_tpu._private.worker import global_worker
+
+        resources = dict(node_config.get("resources") or {})
+        labels = {"autoscaler_node_type": node_type, **(node_config.get("labels") or {})}
+        scheduler = global_worker.context.scheduler
+        node_id: NodeID = scheduler.call("add_node", (resources, labels)).result()
+        self._nodes[node_id.hex()] = node_id
+        return node_id.hex()
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        from ray_tpu._private.worker import global_worker
+
+        node_id = self._nodes.pop(provider_node_id, None)
+        if node_id is not None:
+            global_worker.context.scheduler.call("remove_node", node_id).result()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes)
+
+
+class LocalDaemonProvider(NodeProvider):
+    """Spawns real node-daemon processes on this machine (the autoscaler
+    variant of `cluster_utils.Cluster(real=True).add_node`)."""
+
+    def __init__(self, head_address: str, authkey_hex: Optional[str] = None):
+        self.head_address = head_address
+        self.authkey_hex = authkey_hex or os.environ.get("RAY_TPU_AUTHKEY_HEX", "")
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def create_node(self, node_type: str, node_config: Dict[str, Any]) -> str:
+        from ray_tpu._private.launch import spawn_node_daemon
+
+        shm_dir = tempfile.mkdtemp(prefix="ray_tpu_asnode_")
+        labels = {"autoscaler_node_type": node_type, **(node_config.get("labels") or {})}
+        proc, node_id = spawn_node_daemon(
+            self.head_address,
+            shm_dir=shm_dir,
+            resources=node_config.get("resources") or {},
+            labels=labels,
+            authkey_hex=self.authkey_hex,
+        )
+        self._procs[node_id] = proc
+        return node_id
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        proc = self._procs.pop(provider_node_id, None)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [nid for nid, p in self._procs.items() if p.poll() is None]
+
+
+class TpuQueuedResourcesProvider(NodeProvider):
+    """GCP TPU queued-resources provider: each node type maps to a TPU pod
+    slice requested via `gcloud compute tpus queued-resources create` (the
+    GKE/queued-resources provider SURVEY §7 step 6 specifies; no reference
+    equivalent — its providers are GPU-cloud only).
+
+    Command construction is pure (unit-testable offline); execution requires
+    gcloud credentials at runtime. Started slices join the cluster by running
+    `python -m ray_tpu start --address ...` in their startup script.
+    """
+
+    def __init__(self, project: str, zone: str, head_address: str,
+                 runner=subprocess.run):
+        self.project = project
+        self.zone = zone
+        self.head_address = head_address
+        self._runner = runner
+        self._requests: Dict[str, str] = {}  # request id -> node_type
+
+    def _create_command(self, request_id: str, node_config: Dict[str, Any]) -> List[str]:
+        accel = node_config["accelerator_type"]  # e.g. "v4-32"
+        runtime = node_config.get("runtime_version", "tpu-ubuntu2204-base")
+        startup = node_config.get(
+            "startup_script",
+            f"python -m ray_tpu start --address {self.head_address}",
+        )
+        return [
+            "gcloud", "compute", "tpus", "queued-resources", "create", request_id,
+            f"--project={self.project}",
+            f"--zone={self.zone}",
+            f"--node-id={request_id}",
+            f"--accelerator-type={accel}",
+            f"--runtime-version={runtime}",
+            f"--metadata=startup-script={startup}",
+        ]
+
+    def _delete_command(self, request_id: str) -> List[str]:
+        return [
+            "gcloud", "compute", "tpus", "queued-resources", "delete", request_id,
+            f"--project={self.project}", f"--zone={self.zone}", "--quiet", "--force",
+        ]
+
+    def create_node(self, node_type: str, node_config: Dict[str, Any]) -> str:
+        request_id = f"raytpu-{node_type}-{int(time.time())}"
+        cmd = self._create_command(request_id, node_config)
+        proc = self._runner(cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"queued-resources create failed: {proc.stdout}")
+        self._requests[request_id] = node_type
+        return request_id
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        self._requests.pop(provider_node_id, None)
+        self._runner(
+            self._delete_command(provider_node_id),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._requests)

@@ -58,30 +58,14 @@ class Cluster:
 
     # ------------------------------------------------------------------ real mode
     def _start_head_process(self, args: Dict):
-        cmd = [sys.executable, "-m", "ray_tpu._private.head", "--port", "0"]
-        if "num_cpus" in args:
-            cmd += ["--num-cpus", str(args["num_cpus"])]
-        if "num_tpus" in args:
-            cmd += ["--num-tpus", str(args["num_tpus"])]
-        if "resources" in args:
-            cmd += ["--resources", json.dumps(args["resources"])]
-        env = dict(os.environ)
-        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        self._head_proc = subprocess.Popen(
-            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+        from ray_tpu._private.launch import spawn_head
+
+        self._head_proc, info = spawn_head(
+            num_cpus=args.get("num_cpus"),
+            num_tpus=args.get("num_tpus"),
+            resources=args.get("resources"),
+            timeout_s=30,
         )
-        deadline = time.time() + 30
-        info = None
-        while time.time() < deadline:
-            line = self._head_proc.stdout.readline()
-            if not line:
-                raise RuntimeError("head process exited before becoming ready")
-            if line.startswith("RAY_TPU_HEAD_READY "):
-                info = json.loads(line[len("RAY_TPU_HEAD_READY "):])
-                break
-        if info is None:
-            raise TimeoutError("head process did not become ready in 30s")
         self._head_info = info
         self._saved_authkey = os.environ.get("RAY_TPU_AUTHKEY_HEX")
         os.environ["RAY_TPU_AUTHKEY_HEX"] = info["authkey_hex"]
@@ -113,36 +97,19 @@ class Cluster:
         return node_id
 
     def _add_daemon_node(self, node_resources, labels) -> NodeID:
+        from ray_tpu._private.launch import spawn_node_daemon
+
         shm_dir = tempfile.mkdtemp(prefix="ray_tpu_node_")
         self._tmp_dirs.append(shm_dir)
-        env = dict(os.environ)
-        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        env["RAY_TPU_AUTHKEY_HEX"] = self._head_info["authkey_hex"]
-        proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "ray_tpu._private.node_daemon",
-                "--address", self._head_info["address"],
-                "--shm-dir", shm_dir,
-                "--resources", json.dumps(node_resources),
-                "--labels", json.dumps(labels),
-            ],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
-            text=True,
+        proc, node_hex = spawn_node_daemon(
+            self._head_info["address"],
+            shm_dir=shm_dir,
+            resources=node_resources,
+            labels=labels,
+            authkey_hex=self._head_info["authkey_hex"],
+            timeout_s=30,
         )
-        node_id = None
-        deadline = time.time() + 30
-        while time.time() < deadline:
-            line = proc.stdout.readline()
-            if not line:
-                raise RuntimeError("node daemon exited before registering")
-            if line.startswith("RAY_TPU_NODE_READY "):
-                node_id = NodeID.from_hex(line.split()[1])
-                break
-        if node_id is None:
-            raise TimeoutError("node daemon did not register in 30s")
+        node_id = NodeID.from_hex(node_hex)
         self._daemons[node_id] = proc
         self._node_ids.append(node_id)
         return node_id

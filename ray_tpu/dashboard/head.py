@@ -1,0 +1,113 @@
+"""Dashboard HTTP server (reference: `dashboard/head.py` + per-module REST
+handlers under `dashboard/modules/`)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+
+class DashboardServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._host = host
+        self._want_port = port
+        self.port: Optional[int] = None
+        self._started = threading.Event()
+        self._loop = None
+
+    # ------------------------------------------------------------- handlers
+    def _payload(self, kind: str):
+        from ray_tpu.util import state as state_api
+
+        if kind == "cluster":
+            return state_api.summarize()
+        if kind == "nodes":
+            return state_api.list_nodes()
+        if kind == "actors":
+            return state_api.list_actors()
+        if kind == "tasks":
+            return state_api.list_tasks()
+        if kind == "objects":
+            return state_api.list_objects()
+        if kind == "jobs":
+            from ray_tpu.job_submission import JobSubmissionClient
+
+            return JobSubmissionClient().list_jobs()
+        raise KeyError(kind)
+
+    async def _api(self, request):
+        from aiohttp import web
+
+        kind = request.match_info["kind"]
+        loop = asyncio.get_event_loop()
+        try:
+            payload = await loop.run_in_executor(None, self._payload, kind)
+        except KeyError:
+            return web.json_response({"error": f"unknown endpoint {kind}"}, status=404)
+        return web.json_response(json.loads(json.dumps(payload, default=str)))
+
+    async def _metrics(self, _request):
+        from aiohttp import web
+
+        from ray_tpu.util.metrics import prometheus_text
+
+        loop = asyncio.get_event_loop()
+        text = await loop.run_in_executor(None, prometheus_text)
+        return web.Response(text=text, content_type="text/plain")
+
+    async def _index(self, _request):
+        from aiohttp import web
+
+        from ray_tpu.util import state as state_api
+
+        loop = asyncio.get_event_loop()
+        s = await loop.run_in_executor(None, state_api.summarize)
+        rows = "".join(
+            f"<tr><td>{k}</td><td><pre>{json.dumps(v, default=str)}</pre></td></tr>"
+            for k, v in s.items()
+        )
+        html = (
+            "<html><head><title>ray_tpu dashboard</title></head><body>"
+            "<h2>ray_tpu cluster</h2><table border=1>" + rows + "</table>"
+            "<p>APIs: /api/cluster /api/nodes /api/actors /api/tasks "
+            "/api/objects /api/jobs /metrics</p></body></html>"
+        )
+        return web.Response(text=html, content_type="text/html")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> int:
+        t = threading.Thread(target=self._serve, daemon=True, name="dashboard")
+        t.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("dashboard failed to start in 30s")
+        return self.port
+
+    def _serve(self):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/{kind}", self._api)
+        app.router.add_get("/metrics", self._metrics)
+        runner = web.AppRunner(app, access_log=None)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self._host, self._want_port)
+        loop.run_until_complete(site.start())
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._started.set()
+        loop.run_forever()
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> DashboardServer:
+    server = DashboardServer(host, port)
+    server.start()
+    return server

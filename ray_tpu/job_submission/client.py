@@ -61,6 +61,18 @@ class _JobSupervisor:
     MAX_LOG_LINES = 2000
 
     def run(self) -> str:
+        try:
+            return self._run_impl()
+        finally:
+            # Job reached a terminal state: tear this supervisor down so its
+            # 0.1 CPU + worker process don't leak (the reference JobManager
+            # stops the supervisor at job end). The delay lets the "done"
+            # message for this call flush first.
+            import threading
+
+            threading.Timer(2.0, os._exit, args=(0,)).start()
+
+    def _run_impl(self) -> str:
         ctx = _kv()
         if self.stopped:
             # stop() landed before the subprocess launched.
@@ -69,16 +81,22 @@ class _JobSupervisor:
         ctx.kv("put", _status_key(self.job_id), JobStatus.RUNNING.encode())
         env = dict(os.environ)
         env["RAY_TPU_JOB_ID"] = self.job_id
-        # RAY_TPU_ADDRESS / RAY_TPU_AUTHKEY_HEX are already exported by the
-        # worker (WorkerArgs.head_address), so the entrypoint's ray_tpu.init
-        # joins this cluster as a client driver.
-        self.proc = subprocess.Popen(
-            shlex.split(self.entrypoint),
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
-        )
+        try:
+            # RAY_TPU_ADDRESS / RAY_TPU_AUTHKEY_HEX are already exported by the
+            # worker (WorkerArgs.head_address), so the entrypoint's
+            # ray_tpu.init joins this cluster as a client driver.
+            self.proc = subprocess.Popen(
+                shlex.split(self.entrypoint),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+            )
+        except OSError as e:
+            # Unlaunchable entrypoint must still reach a terminal status.
+            ctx.kv("put", _logs_key(self.job_id), f"failed to launch: {e!r}".encode())
+            ctx.kv("put", _status_key(self.job_id), JobStatus.FAILED.encode())
+            return JobStatus.FAILED
         import collections
 
         tail: "collections.deque[str]" = collections.deque(maxlen=self.MAX_LOG_LINES)

@@ -65,59 +65,48 @@ def _connect(ns):
 
 # ----------------------------------------------------------------- start/stop
 def cmd_start(ns):
+    from ray_tpu._private.launch import spawn_head, spawn_node_daemon
+
     state = _load_state()
     if ns.head:
-        cmd = [sys.executable, "-m", "ray_tpu._private.head", "--port", str(ns.port),
-               "--host", ns.host]
-        if ns.num_cpus is not None:
-            cmd += ["--num-cpus", str(ns.num_cpus)]
-        if ns.num_tpus is not None:
-            cmd += ["--num-tpus", str(ns.num_tpus)]
-        if ns.resources:
-            cmd += ["--resources", ns.resources]
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
-        info = None
-        for _ in range(600):
-            line = proc.stdout.readline()
-            if not line:
-                sys.exit("head process exited during startup")
-            if line.startswith("RAY_TPU_HEAD_READY "):
-                info = json.loads(line[len("RAY_TPU_HEAD_READY "):])
-                break
-        if info is None:
-            proc.terminate()
-            sys.exit("head process never reported ready; terminated it")
+        extra = []
+        if ns.dashboard_port is not None:
+            extra += ["--dashboard-port", str(ns.dashboard_port)]
+        if ns.persist:
+            extra += ["--persist", ns.persist]
+        try:
+            proc, info = spawn_head(
+                port=ns.port, host=ns.host,
+                num_cpus=ns.num_cpus, num_tpus=ns.num_tpus,
+                resources=json.loads(ns.resources) if ns.resources else None,
+                extra_args=tuple(extra),
+            )
+        except (TimeoutError, RuntimeError) as e:
+            sys.exit(str(e))
         state["head"] = {"pid": proc.pid, **info}
         _save_state(state)
         print(f"head started: address={info['address']} pid={proc.pid}")
+        if info.get("dashboard_port"):
+            print(f"dashboard: http://{ns.host}:{info['dashboard_port']}")
         print(f"connect with: ray_tpu.init(address=\"{info['address']}\")  "
               f"[RAY_TPU_AUTHKEY_HEX={info['authkey_hex']}]")
     else:
         if not ns.address:
             sys.exit("start needs --head or --address HOST:PORT")
         head = state.get("head") or {}
-        env = dict(os.environ)
-        if "RAY_TPU_AUTHKEY_HEX" not in env and head.get("authkey_hex"):
-            env["RAY_TPU_AUTHKEY_HEX"] = head["authkey_hex"]
+        authkey = os.environ.get("RAY_TPU_AUTHKEY_HEX") or head.get("authkey_hex")
         shm_dir = ns.shm_dir or tempfile.mkdtemp(prefix="ray_tpu_node_")
         resources = json.loads(ns.resources) if ns.resources else {}
         if ns.num_cpus is not None:
             resources.setdefault("CPU", float(ns.num_cpus))
         if ns.num_tpus:
             resources.setdefault("TPU", float(ns.num_tpus))
-        cmd = [sys.executable, "-m", "ray_tpu._private.node_daemon",
-               "--address", ns.address, "--shm-dir", shm_dir,
-               "--resources", json.dumps(resources)]
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                                text=True, env=env)
-        node_id = None
-        for _ in range(600):
-            line = proc.stdout.readline()
-            if not line:
-                sys.exit("node daemon exited before registering")
-            if line.startswith("RAY_TPU_NODE_READY "):
-                node_id = line.split()[1]
-                break
+        try:
+            proc, node_id = spawn_node_daemon(
+                ns.address, shm_dir=shm_dir, resources=resources, authkey_hex=authkey
+            )
+        except (TimeoutError, RuntimeError) as e:
+            sys.exit(str(e))
         state.setdefault("daemons", []).append({"pid": proc.pid, "node_id": node_id})
         _save_state(state)
         print(f"node daemon started: node_id={node_id} pid={proc.pid}")
@@ -221,6 +210,8 @@ def main(argv=None) -> None:
     sp.add_argument("--num-tpus", type=float, default=None)
     sp.add_argument("--resources", help="JSON resource map")
     sp.add_argument("--shm-dir")
+    sp.add_argument("--dashboard-port", type=int, default=None)
+    sp.add_argument("--persist", help="GCS persistence file (head mode)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop processes started by this CLI")

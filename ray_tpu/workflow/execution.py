@@ -91,16 +91,20 @@ def _supervise(workflow_id: str, root: Optional[str]):
 
 def _head_pinned_supervise():
     """The supervisor must see the same filesystem the driver wrote the DAG
-    to: pin it to the head node. On multi-node clusters `storage_root` must be
-    a shared filesystem (same requirement as the reference's storage URL)."""
+    to: pin it to the head node (selected by its 'head' label, not list
+    position). On multi-node clusters `storage_root` must be a shared
+    filesystem (same requirement as the reference's storage URL)."""
     from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
     from ray_tpu._private.worker import global_worker
 
     nodes = global_worker.context.nodes()
-    if nodes:
+    head = next((n for n in nodes if n.get("labels", {}).get("head") == "1"), None)
+    if head is None and nodes:
+        head = nodes[0]
+    if head is not None:
         return _supervise.options(
             scheduling_strategy=NodeAffinitySchedulingStrategy(
-                nodes[0]["node_id"], soft=False
+                head["node_id"], soft=False
             )
         )
     return _supervise
@@ -139,18 +143,22 @@ def run(
     return ray_tpu.get(ref)
 
 
-def resume(workflow_id: str, storage_root: Optional[str] = None):
+def resume(workflow_id: str, storage_root: Optional[str] = None, *, force: bool = False):
     """Re-run a workflow from its last completed step (reference:
-    `workflow.resume`). Completed steps load from storage; the rest execute."""
+    `workflow.resume`). Completed steps load from storage; the rest execute.
+
+    RUNNING/PENDING workflows are refused by default — a second supervisor
+    would concurrently re-run non-checkpointed steps. After a HARD crash
+    (head/supervisor killed, status stuck at RUNNING with no live supervisor)
+    pass ``force=True`` to take over."""
     store = WorkflowStorage(workflow_id, storage_root)
     status = store.get_status()
     if status == "NOT_FOUND":
         raise ValueError(f"no workflow '{workflow_id}'")
-    if status in ("RUNNING", "PENDING"):
-        # A live supervisor is already executing: a second one would re-run
-        # non-checkpointed (possibly non-idempotent) steps concurrently.
+    if status in ("RUNNING", "PENDING") and not force:
         raise ValueError(
-            f"workflow '{workflow_id}' is {status}; resume only terminal workflows"
+            f"workflow '{workflow_id}' is {status}; a live supervisor may still "
+            "own it. If it died uncleanly (head crash), resume with force=True."
         )
     if store.has_step(RESULT_STEP):
         return store.load_step(RESULT_STEP)

@@ -21,10 +21,15 @@ class WorkflowStorage:
     def __init__(self, workflow_id: str, root: Optional[str] = None):
         self.workflow_id = workflow_id
         self.dir = os.path.join(root or DEFAULT_ROOT, workflow_id)
+        # Directories are created lazily by the WRITE paths: read-only calls
+        # (get_status of a typo'd id) must not pollute the storage root.
+
+    def _ensure_dirs(self) -> None:
         os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
 
     # -- dag / metadata ----------------------------------------------------
     def save_dag(self, dag, args, kwargs) -> None:
+        self._ensure_dirs()
         self._atomic_write(
             os.path.join(self.dir, "dag.pkl"),
             cloudpickle.dumps({"dag": dag, "args": args, "kwargs": kwargs}),
@@ -36,6 +41,7 @@ class WorkflowStorage:
         return d["dag"], d["args"], d["kwargs"]
 
     def set_status(self, status: str) -> None:
+        self._ensure_dirs()
         self._atomic_write(os.path.join(self.dir, "STATUS"), status.encode())
 
     def get_status(self) -> str:
@@ -53,6 +59,7 @@ class WorkflowStorage:
         return os.path.exists(self._step_path(step_id))
 
     def save_step(self, step_id: str, value: Any) -> None:
+        self._ensure_dirs()
         self._atomic_write(self._step_path(step_id), cloudpickle.dumps(value))
 
     def load_step(self, step_id: str) -> Any:
@@ -60,11 +67,14 @@ class WorkflowStorage:
             return pickle.loads(f.read())
 
     def completed_steps(self) -> List[str]:
-        return [
-            f[:-4]
-            for f in os.listdir(os.path.join(self.dir, "steps"))
-            if f.endswith(".pkl")
-        ]
+        try:
+            return [
+                f[:-4]
+                for f in os.listdir(os.path.join(self.dir, "steps"))
+                if f.endswith(".pkl")
+            ]
+        except FileNotFoundError:
+            return []
 
     # -- util --------------------------------------------------------------
     def _atomic_write(self, path: str, data: bytes) -> None:

@@ -45,26 +45,9 @@ import subprocess  # noqa: E402
 def head_process_runtime(num_cpus=4):
     """Out-of-process control plane: spawn a head server (`_private/head.py`)
     and connect this process as a client driver over TCP."""
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu._private.head", "--port", "0",
-         "--num-cpus", str(num_cpus), "--num-tpus", "0"],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
-        text=True,
-        env=env,
-    )
-    info = None
-    for _ in range(300):
-        line = proc.stdout.readline()
-        if not line:
-            raise RuntimeError("head process died during startup")
-        if line.startswith("RAY_TPU_HEAD_READY "):
-            info = json.loads(line[len("RAY_TPU_HEAD_READY "):])
-            break
-    assert info is not None
+    from ray_tpu._private.launch import spawn_head
+
+    proc, info = spawn_head(num_cpus=num_cpus, num_tpus=0, timeout_s=60)
     old_key = os.environ.get("RAY_TPU_AUTHKEY_HEX")
     os.environ["RAY_TPU_AUTHKEY_HEX"] = info["authkey_hex"]
     try:

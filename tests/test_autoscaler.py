@@ -1,0 +1,199 @@
+"""Autoscaler: demand-driven scale-up, idle scale-down, providers.
+
+Reference tests: `python/ray/tests/test_autoscaler.py` (mocked provider,
+pure-logic decisions) + `test_autoscaler_fake_multinode.py` (end-to-end with
+the fake provider).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    FakeMultiNodeProvider,
+    Monitor,
+    NodeTypeConfig,
+    StandardAutoscaler,
+    TpuQueuedResourcesProvider,
+)
+
+
+class RecordingProvider:
+    """Pure mock: records create/terminate calls."""
+
+    def __init__(self):
+        self.created = []
+        self.terminated = []
+        self._n = 0
+
+    def create_node(self, node_type, node_config):
+        self._n += 1
+        nid = f"{node_type}-{self._n}"
+        self.created.append((node_type, node_config))
+        return nid
+
+    def terminate_node(self, nid):
+        self.terminated.append(nid)
+
+    def non_terminated_nodes(self):
+        return []
+
+
+def _state(nodes=None, pending=None, bundles=None):
+    return {
+        "pending_tasks": pending or [],
+        "pending_bundles": bundles or [],
+        "nodes": nodes or [],
+    }
+
+
+def _node(nid="n1", resources=None, available=None, idle_s=0.0, busy=0, actors=0):
+    res = resources or {"CPU": 4}
+    return {
+        "node_id": nid,
+        "resources": res,
+        "available": available if available is not None else dict(res),
+        "labels": {},
+        "alive": True,
+        "busy_workers": busy,
+        "actors": actors,
+        "idle_s": idle_s,
+        "is_daemon": False,
+    }
+
+
+def test_scale_up_for_unmet_demand():
+    cfg = AutoscalerConfig(node_types={"cpu4": NodeTypeConfig(resources={"CPU": 4})})
+    prov = RecordingProvider()
+    a = StandardAutoscaler(cfg, prov)
+    out = a.update(_state(nodes=[_node(available={"CPU": 0})], pending=[{"CPU": 2}, {"CPU": 2}]))
+    # Both pending shapes fit on one new cpu4 node... but demand is counted
+    # per-shape against scratch capacity: first launch absorbs... launches are
+    # per unmet shape; both were unmet against zero available capacity.
+    assert len(out["launched"]) >= 1
+    assert all(t == "cpu4" for t, _ in out["launched"])
+
+
+def test_demand_fitting_consumes_capacity():
+    """N identical pending tasks need N slots, not one."""
+    cfg = AutoscalerConfig(node_types={"cpu2": NodeTypeConfig(resources={"CPU": 2})})
+    a = StandardAutoscaler(cfg, RecordingProvider())
+    # One node with 2 free CPUs; three pending 2-CPU tasks -> 2 unmet.
+    out = a.update(_state(nodes=[_node(available={"CPU": 2})], pending=[{"CPU": 2}] * 3))
+    assert len(out["launched"]) == 2
+
+
+def test_max_workers_cap_and_tpu_demand():
+    cfg = AutoscalerConfig(
+        node_types={
+            "tpu_host": NodeTypeConfig(resources={"CPU": 1, "TPU": 4}, max_workers=2)
+        }
+    )
+    a = StandardAutoscaler(cfg, RecordingProvider())
+    out = a.update(_state(pending=[{"TPU": 4}] * 5))
+    assert len(out["launched"]) == 2  # capped
+
+
+def test_min_workers_floor():
+    cfg = AutoscalerConfig(
+        node_types={"base": NodeTypeConfig(resources={"CPU": 2}, min_workers=2)}
+    )
+    a = StandardAutoscaler(cfg, RecordingProvider())
+    out = a.update(_state())
+    assert len(out["launched"]) == 2
+
+
+def test_idle_scale_down_respects_activity_and_min():
+    cfg = AutoscalerConfig(
+        node_types={"cpu4": NodeTypeConfig(resources={"CPU": 4}, min_workers=1)},
+        idle_timeout_s=5.0,
+    )
+    prov = RecordingProvider()
+    a = StandardAutoscaler(cfg, prov)
+    a.launched = {"a": "cpu4", "b": "cpu4", "c": "cpu4"}
+    nodes = [
+        _node("a", idle_s=100.0),             # idle -> terminate
+        _node("b", idle_s=100.0, actors=1),   # hosts an actor -> keep
+        _node("c", idle_s=1.0),               # recently active -> keep
+    ]
+    out = a.update(_state(nodes=nodes))
+    assert out["terminated"] == ["a"]
+    # min_workers=1: even if all were idle, one must survive.
+    a2 = StandardAutoscaler(cfg, RecordingProvider())
+    a2.launched = {"x": "cpu4"}
+    out2 = a2.update(_state(nodes=[_node("x", idle_s=100.0)]))
+    assert out2["terminated"] == []
+
+
+def test_pg_bundles_create_demand():
+    cfg = AutoscalerConfig(node_types={"cpu2": NodeTypeConfig(resources={"CPU": 2})})
+    a = StandardAutoscaler(cfg, RecordingProvider())
+    out = a.update(_state(bundles=[{"CPU": 1}, {"CPU": 1}, {"CPU": 2}]))
+    assert len(out["launched"]) >= 1
+
+
+def test_tpu_queued_resources_commands():
+    prov = TpuQueuedResourcesProvider(
+        project="proj", zone="us-central2-b", head_address="10.0.0.1:6379",
+        runner=lambda cmd, **kw: type("R", (), {"returncode": 0, "stdout": ""})(),
+    )
+    cmd = prov._create_command("req1", {"accelerator_type": "v4-32"})
+    joined = " ".join(cmd)
+    assert "queued-resources create req1" in joined
+    assert "--accelerator-type=v4-32" in joined
+    assert "ray_tpu start --address 10.0.0.1:6379" in joined
+    nid = prov.create_node("slice", {"accelerator_type": "v4-32"})
+    assert nid in prov.non_terminated_nodes()
+    prov.terminate_node(nid)
+    assert prov.non_terminated_nodes() == []
+
+
+def test_end_to_end_fake_provider(ray_start_regular):
+    """Infeasible task -> monitor launches a virtual node -> task runs; node
+    scales back down once idle."""
+    cfg = AutoscalerConfig(
+        node_types={"special": NodeTypeConfig(resources={"CPU": 1, "special": 1})},
+        idle_timeout_s=1.5,
+    )
+    monitor = Monitor(cfg, FakeMultiNodeProvider(), interval_s=0.2)
+    monitor.start()
+    try:
+        @ray_tpu.remote(resources={"special": 1})
+        def needs_special():
+            return "scaled!"
+
+        assert ray_tpu.get(needs_special.remote(), timeout=60) == "scaled!"
+        assert ray_tpu.cluster_resources().get("special") == 1
+        # Idle: the launched node is terminated again.
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if "special" not in ray_tpu.cluster_resources():
+                break
+            time.sleep(0.2)
+        assert "special" not in ray_tpu.cluster_resources()
+    finally:
+        monitor.stop()
+
+
+def test_request_resources_prewarms(ray_start_regular):
+    from ray_tpu.autoscaler import request_resources
+
+    cfg = AutoscalerConfig(
+        node_types={"warm": NodeTypeConfig(resources={"CPU": 1, "warm": 1})},
+        idle_timeout_s=3600,
+    )
+    monitor = Monitor(cfg, FakeMultiNodeProvider(), interval_s=0.2)
+    monitor.start()
+    try:
+        request_resources([{"warm": 1}])
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if ray_tpu.cluster_resources().get("warm"):
+                break
+            time.sleep(0.2)
+        assert ray_tpu.cluster_resources().get("warm") == 1
+    finally:
+        request_resources([])
+        monitor.stop()

@@ -1,0 +1,142 @@
+"""Metrics (Counter/Gauge/Histogram + Prometheus export), dashboard REST, and
+GCS persistence across head restarts.
+
+Reference surfaces: `python/ray/util/metrics.py` + the metrics-agent
+Prometheus pipeline, `dashboard/head.py` REST modules, and redis-backed GCS
+fault tolerance (`test_gcs_fault_tolerance.py`).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_api
+
+
+def test_metrics_counter_gauge_histogram(ray_start_regular):
+    c = metrics_api.Counter("req_total", "requests", ("route",))
+    g = metrics_api.Gauge("queue_depth", "queue size")
+    h = metrics_api.Histogram("latency_s", "latency", boundaries=(0.1, 1.0))
+    c.inc(2, {"route": "/a"})
+    c.inc(1, {"route": "/b"})
+    g.set(7)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(10.0)
+    metrics_api.flush_metrics()
+
+    text = metrics_api.prometheus_text()
+    assert 'req_total{route="/a"} 2' in text
+    assert 'req_total{route="/b"} 1' in text
+    assert "queue_depth" in text and "} 7" in text
+    assert 'latency_s_bucket{le="0.1"} 1' in text
+    assert 'latency_s_bucket{le="1.0"} 2' in text
+    assert 'latency_s_bucket{le="+Inf"} 3' in text
+    assert "latency_s_count 3" in text
+
+
+def test_metrics_merge_across_workers(ray_start_regular):
+    @ray_tpu.remote
+    def work(i):
+        from ray_tpu.util import metrics as m
+
+        c = m.Counter("worker_ops", "ops from workers")
+        c.inc(1)
+        m.flush_metrics()
+        return i
+
+    assert ray_tpu.get([work.remote(i) for i in range(3)], timeout=60) == [0, 1, 2]
+    text = metrics_api.prometheus_text()
+    # Counters sum across processes.
+    total = 0
+    for line in text.splitlines():
+        if line.startswith("worker_ops") and not line.startswith("#"):
+            total += float(line.rsplit(" ", 1)[1])
+    assert total == 3
+
+
+def test_dashboard_rest_and_metrics(ray_start_regular):
+    from ray_tpu.dashboard import start_dashboard
+
+    c = metrics_api.Counter("dash_hits", "hits")
+    c.inc(5)
+    metrics_api.flush_metrics()
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get(noop.remote(), timeout=30)
+
+    server = start_dashboard(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        cluster = json.loads(urllib.request.urlopen(f"{base}/api/cluster", timeout=15).read())
+        assert cluster["nodes"] == 1
+        nodes = json.loads(urllib.request.urlopen(f"{base}/api/nodes", timeout=15).read())
+        assert len(nodes) == 1
+        tasks = json.loads(urllib.request.urlopen(f"{base}/api/tasks", timeout=15).read())
+        assert any(t["name"] == "noop" for t in tasks)
+        text = urllib.request.urlopen(f"{base}/metrics", timeout=15).read().decode()
+        assert "dash_hits 5" in text
+        html = urllib.request.urlopen(f"{base}/", timeout=15).read().decode()
+        assert "ray_tpu cluster" in html
+        assert urllib.request.urlopen(f"{base}/api/nope", timeout=15)
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        server.stop()
+
+
+def test_gcs_persistence_across_head_restart(tmp_path):
+    """KV written through head #1 survives into head #2 via --persist."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    persist = str(tmp_path / "gcs.bin")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def start_head():
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.head", "--port", "0",
+             "--num-cpus", "2", "--num-tpus", "0", "--persist", persist,
+             "--persist-interval", "0.3"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        for _ in range(300):
+            line = proc.stdout.readline()
+            assert line, "head died"
+            if line.startswith("RAY_TPU_HEAD_READY "):
+                return proc, json.loads(line.split(" ", 1)[1])
+        raise AssertionError("head never ready")
+
+    proc1, info1 = start_head()
+    os.environ["RAY_TPU_AUTHKEY_HEX"] = info1["authkey_hex"]
+    try:
+        ray_tpu.init(address=info1["address"])
+        from ray_tpu._private.worker import global_worker
+
+        global_worker.context.kv("put", b"durable_key", b"durable_value")
+        time.sleep(0.8)  # let a persist tick run
+    finally:
+        ray_tpu.shutdown()
+        proc1.terminate()
+        proc1.wait(timeout=15)
+
+    proc2, info2 = start_head()
+    os.environ["RAY_TPU_AUTHKEY_HEX"] = info2["authkey_hex"]
+    try:
+        ray_tpu.init(address=info2["address"])
+        from ray_tpu._private.worker import global_worker
+
+        assert global_worker.context.kv("get", b"durable_key") == b"durable_value"
+    finally:
+        ray_tpu.shutdown()
+        proc2.terminate()
+        proc2.wait(timeout=15)
+        os.environ.pop("RAY_TPU_AUTHKEY_HEX", None)
