@@ -66,6 +66,9 @@ class TaskSpec:
     # (reference: `_private/runtime_env/`, dedicated workers in worker_pool.h).
     env_vars: Dict[str, str] = field(default_factory=dict)
     runtime_env: Optional[Dict[str, Any]] = None
+    # Tracing context propagated caller -> worker (util/tracing.py); the
+    # execute-side span becomes a child of the caller's submit span.
+    trace_context: Optional[Dict[str, str]] = None
 
 
 @dataclass

@@ -806,6 +806,9 @@ def shutdown():
     """Tear down the runtime and unlink all shared-memory segments."""
     if global_worker.mode is None:
         return
+    from ray_tpu._private import usage
+
+    usage.flush()
     if global_worker.mode == DRIVER_MODE:
         ctx = global_worker.context
         if isinstance(ctx, RemoteDriverContext):

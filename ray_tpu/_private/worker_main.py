@@ -229,6 +229,16 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
     cfg = rt.args.config
     for k, v in spec.env_vars.items():
         os.environ[k] = v
+    exec_span = None
+    if spec.trace_context is not None:
+        from ray_tpu.util import tracing
+
+        exec_span = tracing.start_span(
+            f"execute::{spec.name or spec.func.name}",
+            "execute",
+            trace_context=spec.trace_context,
+            attributes={"task_id": spec.task_id.hex()},
+        )
     try:
         if rt.setup_error is not None:
             raise exceptions.RuntimeEnvSetupError(
@@ -289,6 +299,11 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
         worker_mod.flush_ref_ops()
         rt.wc.send(("done", spec.task_id.binary(), True, metas))
     except Exception as e:  # noqa: BLE001 — every task error must be captured
+        if exec_span is not None:
+            from ray_tpu.util import tracing
+
+            tracing.end_span(exec_span, "ERROR")
+            exec_span = None
         tb = traceback.format_exc()
         err = exceptions.RayTaskError(
             function_name=spec.name or spec.func.name,
@@ -310,6 +325,10 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
         worker_mod.flush_ref_ops()
         rt.wc.send(("done", spec.task_id.binary(), False, metas))
     finally:
+        if exec_span is not None:
+            from ray_tpu.util import tracing
+
+            tracing.end_span(exec_span)
         rt.current_task_id = None
 
 

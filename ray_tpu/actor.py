@@ -94,12 +94,28 @@ class ActorHandle:
             method_name=method_name,
             name=f"{self._class_name}.{method_name}",
         )
-        entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
-        return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]
-        req = ExecRequest(spec=spec, arg_metas=[], kwarg_metas={}, return_ids=return_ids)
-        req._arg_entries = entries
-        req._kwarg_entries = kwentries
-        global_worker.context.submit_actor_task(req)
+        from ray_tpu.util import tracing
+
+        submit_span = None
+        if tracing.is_enabled():
+            submit_span = tracing.start_span(
+                f"actor::{spec.name}", "submit", attributes={"task_id": task_id.hex()}
+            )
+            spec.trace_context = {
+                "trace_id": submit_span["trace_id"],
+                "parent_id": submit_span["span_id"],
+            }
+            spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
+        try:
+            entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
+            return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]
+            req = ExecRequest(spec=spec, arg_metas=[], kwarg_metas={}, return_ids=return_ids)
+            req._arg_entries = entries
+            req._kwarg_entries = kwentries
+            global_worker.context.submit_actor_task(req)
+        finally:
+            if submit_span is not None:
+                tracing.end_span(submit_span)
         refs = [ObjectRef(oid) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 

@@ -108,6 +108,9 @@ def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Datas
 
 
 def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    from ray_tpu._private import usage
+
+    usage.record_library_usage("data")
     parallelism = _auto_parallelism(parallelism, len(items))
     refs = [
         ray_tpu.put(BlockAccessor.from_rows([items[i] for i in rng]))

@@ -61,18 +61,6 @@ class _JobSupervisor:
     MAX_LOG_LINES = 2000
 
     def run(self) -> str:
-        try:
-            return self._run_impl()
-        finally:
-            # Job reached a terminal state: tear this supervisor down so its
-            # 0.1 CPU + worker process don't leak (the reference JobManager
-            # stops the supervisor at job end). The delay lets the "done"
-            # message for this call flush first.
-            import threading
-
-            threading.Timer(2.0, os._exit, args=(0,)).start()
-
-    def _run_impl(self) -> str:
         ctx = _kv()
         if self.stopped:
             # stop() landed before the subprocess launched.
@@ -134,6 +122,19 @@ class _JobSupervisor:
         return True
 
 
+@ray_tpu.remote(num_cpus=0)
+def _reap_supervisor(_run_status, job_id: str):
+    """Runs AFTER the supervisor's run() result seals (it's a dependency), so
+    killing the actor can never race the job's result/status flush — the
+    reference JobManager's supervisor teardown, dependency-ordered."""
+    try:
+        sup = ray_tpu.get_actor(f"JOB_SUPERVISOR::{job_id}")
+    except ValueError:
+        return False
+    ray_tpu.kill(sup)
+    return True
+
+
 class JobSubmissionClient:
     """Reference: `python/ray/job_submission/JobSubmissionClient` (REST there,
     direct actor calls here — the dashboard REST head wraps this)."""
@@ -182,9 +183,11 @@ class JobSubmissionClient:
             name=f"JOB_SUPERVISOR::{job_id}",
             runtime_env=runtime_env,
         ).remote(job_id, entrypoint)
-        # Fire-and-forget: the supervisor runs the job to completion; keep the
-        # result ref alive in the KV-registered actor, not here.
-        sup.run.remote()
+        run_ref = sup.run.remote()
+        # Dependency-ordered teardown: reap fires only after run()'s result
+        # seals, so the supervisor (0.1 CPU + worker process) never leaks and
+        # never dies mid-flush.
+        _reap_supervisor.remote(run_ref, job_id)
         self._supervisors = getattr(self, "_supervisors", {})
         self._supervisors[job_id] = sup
         return job_id

@@ -46,6 +46,12 @@ class GPTConfig:
     # Applied to embeddings and both residual branches when a dropout_rng is
     # passed to forward()/loss_fn (GPT-2 used 0.1; modern pretraining uses 0).
     dropout: float = 0.0
+    # Mixture-of-experts: >0 replaces every block's dense MLP with a Switch
+    # (top-1) MoE of this many experts, sharded over the `expert` mesh axis
+    # (models/moe.py). 0 = dense.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def ff_dim(self) -> int:
@@ -83,11 +89,15 @@ class GPTConfig:
 
 def num_params(config: GPTConfig) -> int:
     d, L, V, F = config.d_model, config.n_layer, config.vocab_size, config.ff_dim
+    E = config.moe_experts
+    if E:
+        mlp = d * E + E * (d * F + F + F * d + d)  # router + per-expert FFNs
+    else:
+        mlp = d * F + F + F * d + d
     per_layer = (
         3 * d * d + 3 * d  # qkv
         + d * d + d        # attn out
-        + d * F + F        # mlp fc
-        + F * d + d        # mlp proj
+        + mlp
         + 4 * d            # 2 layernorms
     )
     return V * d + config.max_seq_len * d + L * per_layer + 2 * d
@@ -115,23 +125,35 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     def norm(key, shape, s):
         return (jax.random.normal(key, shape) * s).astype(pd)
 
+    blocks = {
+        "ln1_scale": jnp.ones((L, d), pd),
+        "ln1_bias": jnp.zeros((L, d), pd),
+        "qkv_w": norm(next(k), (L, d, 3, nh, hd), std),
+        "qkv_b": jnp.zeros((L, 3, nh, hd), pd),
+        "out_w": norm(next(k), (L, nh, hd, d), proj_std),
+        "out_b": jnp.zeros((L, d), pd),
+        "ln2_scale": jnp.ones((L, d), pd),
+        "ln2_bias": jnp.zeros((L, d), pd),
+    }
+    if config.moe_experts:
+        from ray_tpu.models.moe import init_moe_params
+
+        blocks["moe"] = init_moe_params(
+            next(k), L, d, F, config.moe_experts, pd
+        )
+    else:
+        blocks.update(
+            {
+                "fc_w": norm(next(k), (L, d, F), std),
+                "fc_b": jnp.zeros((L, F), pd),
+                "proj_w": norm(next(k), (L, F, d), proj_std),
+                "proj_b": jnp.zeros((L, d), pd),
+            }
+        )
     params = {
         "wte": norm(next(k), (V, d), std),
         "wpe": norm(next(k), (config.max_seq_len, d), std),
-        "blocks": {
-            "ln1_scale": jnp.ones((L, d), pd),
-            "ln1_bias": jnp.zeros((L, d), pd),
-            "qkv_w": norm(next(k), (L, d, 3, nh, hd), std),
-            "qkv_b": jnp.zeros((L, 3, nh, hd), pd),
-            "out_w": norm(next(k), (L, nh, hd, d), proj_std),
-            "out_b": jnp.zeros((L, d), pd),
-            "ln2_scale": jnp.ones((L, d), pd),
-            "ln2_bias": jnp.zeros((L, d), pd),
-            "fc_w": norm(next(k), (L, d, F), std),
-            "fc_b": jnp.zeros((L, F), pd),
-            "proj_w": norm(next(k), (L, F, d), proj_std),
-            "proj_b": jnp.zeros((L, d), pd),
-        },
+        "blocks": blocks,
         "lnf_scale": jnp.ones((d,), pd),
         "lnf_bias": jnp.zeros((d,), pd),
     }
@@ -140,23 +162,33 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
 
 def param_logical_axes(config: GPTConfig) -> Dict[str, Any]:
     """Per-leaf logical axis names, consumed by parallel.ShardingRules."""
+    blocks = {
+        "ln1_scale": ("layers", None),
+        "ln1_bias": ("layers", None),
+        "qkv_w": ("layers", "embed", None, "heads", None),
+        "qkv_b": ("layers", None, "heads", None),
+        "out_w": ("layers", "heads", None, "embed"),
+        "out_b": ("layers", None),
+        "ln2_scale": ("layers", None),
+        "ln2_bias": ("layers", None),
+    }
+    if config.moe_experts:
+        from ray_tpu.models.moe import moe_param_logical_axes
+
+        blocks["moe"] = moe_param_logical_axes()
+    else:
+        blocks.update(
+            {
+                "fc_w": ("layers", "embed", "mlp"),
+                "fc_b": ("layers", "mlp"),
+                "proj_w": ("layers", "mlp", "embed"),
+                "proj_b": ("layers", None),
+            }
+        )
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
-        "blocks": {
-            "ln1_scale": ("layers", None),
-            "ln1_bias": ("layers", None),
-            "qkv_w": ("layers", "embed", None, "heads", None),
-            "qkv_b": ("layers", None, "heads", None),
-            "out_w": ("layers", "heads", None, "embed"),
-            "out_b": ("layers", None),
-            "ln2_scale": ("layers", None),
-            "ln2_bias": ("layers", None),
-            "fc_w": ("layers", "embed", "mlp"),
-            "fc_b": ("layers", "mlp"),
-            "proj_w": ("layers", "mlp", "embed"),
-            "proj_b": ("layers", None),
-        },
+        "blocks": blocks,
         "lnf_scale": (None,),
         "lnf_bias": (None,),
     }
@@ -191,7 +223,8 @@ def _dropout(x, rate: float, rng):
 
 
 def _block(x, layer, config: GPTConfig, attention_fn, drop_rng=None):
-    """One transformer block. x: (B, S, D) in config.dtype."""
+    """One transformer block. x: (B, S, D) in config.dtype.
+    Returns (x, aux) — aux is the MoE load-balance loss (0.0 when dense)."""
     B, S, D = x.shape
     nh, hd = config.n_head, config.head_dim
     cdt = config.dtype
@@ -211,10 +244,22 @@ def _block(x, layer, config: GPTConfig, attention_fn, drop_rng=None):
     x = x + _dropout(o, config.dropout, r1)
 
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]).astype(cdt)
-    h = jnp.einsum("bsd,df->bsf", h, layer["fc_w"].astype(cdt)) + layer["fc_b"].astype(cdt)
-    h = jax.nn.gelu(h)
-    h = jnp.einsum("bsf,fd->bsd", h, layer["proj_w"].astype(cdt)) + layer["proj_b"].astype(cdt)
-    return x + _dropout(h, config.dropout, r2)
+    aux = jnp.zeros((), jnp.float32)
+    if config.moe_experts:
+        from ray_tpu.models.moe import moe_mlp
+
+        moe = layer["moe"]
+        h, aux = moe_mlp(
+            h,
+            moe["router_w"], moe["fc_w"], moe["fc_b"],
+            moe["proj_w"], moe["proj_b"],
+            capacity_factor=config.moe_capacity_factor,
+        )
+    else:
+        h = jnp.einsum("bsd,df->bsf", h, layer["fc_w"].astype(cdt)) + layer["fc_b"].astype(cdt)
+        h = jax.nn.gelu(h)
+        h = jnp.einsum("bsf,fd->bsd", h, layer["proj_w"].astype(cdt)) + layer["proj_b"].astype(cdt)
+    return x + _dropout(h, config.dropout, r2), aux
 
 
 def forward(
@@ -225,9 +270,11 @@ def forward(
     dropout_rng=None,
     mesh=None,
     num_microbatches: Optional[int] = None,
+    return_aux: bool = False,
 ):
-    """Returns logits (B, S, vocab) in float32. Pass dropout_rng to enable
-    dropout (training); omit it for deterministic eval.
+    """Returns logits (B, S, vocab) in float32 (with `return_aux`, a
+    (logits, moe_aux_loss) pair). Pass dropout_rng to enable dropout
+    (training); omit it for deterministic eval.
 
     With a mesh whose `pipeline` axis is >1, the layer stack runs as a GPipe
     microbatch pipeline (`parallel.pipeline`): each stage group holds
@@ -258,7 +305,8 @@ def forward(
                 if mb_idx is not None:
                     # Independent dropout mask per microbatch under PP.
                     rng = jax.random.fold_in(rng, mb_idx)
-            return _block(x, layer, config, attn, rng), None
+            x, aux = _block(x, layer, config, attn, rng)
+            return x, aux
 
         if config.remat:
             block_fn = jax.checkpoint(block_fn, prevent_cse=False, policy=remat_policy)
@@ -284,15 +332,15 @@ def forward(
 
         def stack_fn(stage_local, xm, first_layer, mb_idx):
             n_local = config.n_layer // n_pipeline
-            xm, _ = jax.lax.scan(
+            xm, auxs = jax.lax.scan(
                 make_block_fn(first_layer, inner_attn, mb_idx),
                 xm,
                 (stage_local, jnp.arange(n_local)),
             )
-            return xm
+            return xm, jnp.sum(auxs)
 
         M = num_microbatches or (2 * n_pipeline if B % (2 * n_pipeline) == 0 else n_pipeline)
-        x = pipeline_apply(
+        x, moe_aux = pipeline_apply(
             mesh,
             to_stages(params["blocks"], n_pipeline),
             x,
@@ -301,9 +349,10 @@ def forward(
             context_manual=context_manual,
         )
     else:
-        x, _ = jax.lax.scan(
+        x, auxs = jax.lax.scan(
             make_block_fn(0, attention_fn), x, (params["blocks"], jnp.arange(config.n_layer))
         )
+        moe_aux = jnp.sum(auxs)
 
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     # Tied LM head: bf16 operands on the MXU, f32 accumulation — an f32×f32
@@ -315,6 +364,8 @@ def forward(
         params["wte"].astype(cdt),
         preferred_element_type=jnp.float32,
     )
+    if return_aux:
+        return logits, moe_aux
     return logits
 
 
@@ -333,12 +384,16 @@ def loss_fn(
     else:
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(
-        params, inputs, config, attention_fn, dropout_rng, mesh, num_microbatches
+    logits, moe_aux = forward(
+        params, inputs, config, attention_fn, dropout_rng, mesh, num_microbatches,
+        return_aux=True,
     )
     # logsumexp - logit[target]: one reduction pass over V instead of
     # materializing the full (B, S, V) log-softmax array (saves ~2x V-sized
     # HBM traffic, ~19ms/step for GPT-2-small at B=16 on v5e).
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     at_target = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return (lse - at_target).mean()
+    loss = (lse - at_target).mean()
+    if config.moe_experts:
+        loss = loss + config.moe_aux_weight * moe_aux
+    return loss

@@ -1,0 +1,113 @@
+"""Mixture-of-Experts MLP with expert parallelism over the `expert` mesh axis.
+
+No reference equivalent (SURVEY.md §2: EP "NO") — designed TPU-first in the
+GShard/Switch style: routing is expressed as DENSE one-hot dispatch/combine
+einsums with a static capacity, so the whole layer is three large matmuls the
+MXU loves, and sharding the expert dim over the `expert` axis makes XLA insert
+the token all-to-all automatically (no ragged transfers, no dynamic shapes).
+
+Top-1 (Switch) routing with capacity factor: tokens over an expert's capacity
+are dropped to the residual path (standard Switch behavior; static shapes are
+what keeps this jit-compilable). The auxiliary load-balancing loss
+(mean(router_prob) . mean(assignment) * E) pushes the router toward uniform
+expert usage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_capacity(num_tokens: int, num_experts: int, capacity_factor: float) -> int:
+    cap = int(num_tokens * capacity_factor / num_experts)
+    return max(cap, 1)
+
+
+def moe_mlp(
+    x,  # (B, S, D) activations, config.dtype
+    router_w,  # (D, E) f32
+    fc_w,  # (E, D, F)
+    fc_b,  # (E, F)
+    proj_w,  # (E, F, D)
+    proj_b,  # (E, D)
+    capacity_factor: float = 1.25,
+) -> Tuple[Any, Any]:
+    """Returns (out (B,S,D), aux_loss scalar).
+
+    GShard-style GROUPED routing: each batch row is a routing group with its
+    own per-expert capacity C = ceil(S/E * factor). The dispatch/combine
+    tensors are (B, S, E, C) — linear in tokens (E*C ~ S), not the quadratic
+    (N, E, N/E) a global top-1 would produce — and the capacity cumsum runs
+    per group, so with batch sharded over `data` it never serializes across
+    shards. Expert buffers are (E, B*C, D) with the expert dim sharded over
+    the `expert` axis; XLA inserts the token all-to-alls around the per-expert
+    matmuls."""
+    B, S, D = x.shape
+    E = router_w.shape[1]
+    C = moe_capacity(S, E, capacity_factor)
+    cdt = x.dtype
+
+    # Router in f32 for stable softmax.
+    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (B, S, E)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (B, S) top-1 (Switch)
+    gate = jnp.take_along_axis(probs, expert_idx[..., None], axis=-1)[..., 0]  # (B, S)
+
+    # Per-group capacity bucketing: token's slot in its expert's queue.
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (B, S, E)
+    position = jnp.cumsum(onehot, axis=1) * onehot  # 1-based slot within group
+    within_cap = (position > 0) & (position <= C)
+    slot = jnp.sum((position - 1) * onehot, axis=-1)  # (B, S)
+    keep = jnp.any(within_cap, axis=-1)  # (B, S)
+
+    # Dense dispatch/combine (B, S, E, C): linear in tokens.
+    dispatch = (
+        jax.nn.one_hot(expert_idx, E, dtype=cdt)[..., None]
+        * jax.nn.one_hot(slot, C, dtype=cdt)[..., None, :]
+        * keep[..., None, None].astype(cdt)
+    )
+    combine = dispatch * gate.astype(cdt)[..., None, None]
+
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)  # all-to-all under EP
+    expert_in = expert_in.reshape(E, B * C, D)
+    h = jnp.einsum("egd,edf->egf", expert_in, fc_w.astype(cdt)) + fc_b.astype(cdt)[:, None, :]
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("egf,efd->egd", h, proj_w.astype(cdt)) + proj_b.astype(cdt)[:, None, :]
+    h = h.reshape(E, B, C, D)
+    out = jnp.einsum("bsec,ebcd->bsd", combine, h)  # all-to-all back
+
+    # Switch aux loss: E * sum_e mean_tokens(assignment_e) * mean_tokens(prob_e).
+    assign_frac = jnp.mean(onehot.astype(jnp.float32), axis=(0, 1))  # (E,)
+    prob_frac = jnp.mean(probs, axis=(0, 1))  # (E,)
+    aux = E * jnp.sum(assign_frac * prob_frac)
+
+    return out, aux
+
+
+def init_moe_params(key, n_layer: int, d_model: int, ff_dim: int, n_experts: int, param_dtype):
+    """Stacked per-layer MoE params: router + per-expert FFN weights."""
+    import math
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    std = 0.02
+    proj_std = std / math.sqrt(2 * n_layer)
+    return {
+        "router_w": (jax.random.normal(k1, (n_layer, d_model, n_experts)) * std).astype(param_dtype),
+        "fc_w": (jax.random.normal(k2, (n_layer, n_experts, d_model, ff_dim)) * std).astype(param_dtype),
+        "fc_b": jnp.zeros((n_layer, n_experts, ff_dim), param_dtype),
+        "proj_w": (jax.random.normal(k3, (n_layer, n_experts, ff_dim, d_model)) * proj_std).astype(param_dtype),
+        "proj_b": jnp.zeros((n_layer, n_experts, d_model), param_dtype),
+    }
+
+
+def moe_param_logical_axes() -> Dict[str, Tuple]:
+    return {
+        "router_w": ("layers", "embed", None),
+        "fc_w": ("layers", "expert", "embed", "mlp"),
+        "fc_b": ("layers", "expert", "mlp"),
+        "proj_w": ("layers", "expert", "mlp", "embed"),
+        "proj_b": ("layers", "expert", "embed"),
+    }

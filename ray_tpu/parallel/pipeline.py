@@ -48,8 +48,11 @@ def pipeline_apply(
       x: (B, S, D) activations (embedded tokens).
       block_stack_fn: applies one stage's layer stack to one microbatch:
         (local_params with leading dim L//P, (mb, S, D), first_layer_idx,
-        microbatch_idx) -> (mb, S, D). The microbatch index keeps per-microbatch
-        randomness (dropout) independent, matching non-pipelined semantics.
+        microbatch_idx) -> ((mb, S, D), aux_scalar). The microbatch index keeps
+        per-microbatch randomness (dropout) independent, matching
+        non-pipelined semantics; aux (e.g. MoE load-balance loss) accumulates
+        over REAL ticks only (bubble-tick garbage is masked out), summed over
+        stages via psum and averaged over microbatches.
       num_microbatches: M; must divide B.
       context_manual: also make the `context` axis manual inside the pipeline
         region (sequence dim sharded S/cp per rank) so ring attention — which
@@ -57,9 +60,9 @@ def pipeline_apply(
         stage. Required when combining PP with CP: a nested full shard_map
         cannot open a second manual region over an axis of the same mesh.
 
-    Returns (B, S, D) activations after all L layers, replicated over the
-    pipeline axis (final psum-mask), so the LM head / loss can be computed
-    with ordinary auto-sharded ops.
+    Returns ((B, S, D) activations after all L layers, aux scalar), both
+    replicated over the pipeline axis (final psum-mask), so the LM head /
+    loss can be computed with ordinary auto-sharded ops.
     """
     Pp = mesh.shape["pipeline"]
     B, S, D = x.shape
@@ -77,7 +80,7 @@ def pipeline_apply(
         T = M + Pp - 1
 
         def tick(carry, t):
-            buf, out = carry
+            buf, out, aux_acc = carry
             mb_idx = jnp.clip(t, 0, M - 1)
             inject = jax.lax.dynamic_index_in_dim(x_all, mb_idx, 0, keepdims=False)
             # Stage 0 feeds fresh microbatches; later stages consume what the
@@ -85,7 +88,11 @@ def pipeline_apply(
             x_in = jnp.where(p == 0, inject, buf)
             # The microbatch this rank is processing at tick t.
             mb_proc = jnp.clip(t - p, 0, M - 1)
-            y = block_stack_fn(stage_local, x_in, first_layer, mb_proc)
+            y, aux = block_stack_fn(stage_local, x_in, first_layer, mb_proc)
+            # Bubble ticks compute garbage: only real (stage, microbatch)
+            # pairs contribute aux.
+            real = jnp.logical_and(t - p >= 0, t - p < M)
+            aux_acc = aux_acc + jnp.where(real, aux, 0.0)
             # Last stage banks finished microbatch t-(P-1), other ticks/ranks
             # write back the value already there (masked no-op).
             out_idx = jnp.clip(t - (Pp - 1), 0, M - 1)
@@ -97,14 +104,17 @@ def pipeline_apply(
             buf = jax.lax.ppermute(
                 y, "pipeline", [(i, (i + 1) % Pp) for i in range(Pp)]
             )
-            return (buf, out), None
+            return (buf, out, aux_acc), None
 
         buf0 = jnp.zeros_like(x_all[0])
         out0 = jnp.zeros_like(x_all)
-        (_, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(T))
-        # Replicate the last stage's results across the pipeline axis.
+        aux0 = jnp.zeros((), jnp.float32)
+        (_, out, aux_acc), _ = jax.lax.scan(tick, (buf0, out0, aux0), jnp.arange(T))
+        # Replicate the last stage's results across the pipeline axis; sum
+        # stage aux contributions (each stage owns distinct layers).
         out = jax.lax.psum(jnp.where(p == Pp - 1, out, jnp.zeros_like(out)), "pipeline")
-        return out
+        aux_total = jax.lax.psum(aux_acc, "pipeline") / M
+        return out, aux_total
 
     manual = {"pipeline"}
     x_spec = P()
@@ -116,12 +126,12 @@ def pipeline_apply(
         per_rank,
         mesh=mesh,
         in_specs=(P("pipeline"), x_spec),
-        out_specs=x_spec,
+        out_specs=(x_spec, P()),
         axis_names=frozenset(manual),
         check_vma=False,
     )
-    out = sharded(stage_params, x_mb)
-    return out.reshape(B, S, D)
+    out, aux = sharded(stage_params, x_mb)
+    return out.reshape(B, S, D), aux
 
 
 def to_stages(blocks, num_stages: int):

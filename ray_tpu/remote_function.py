@@ -122,22 +122,42 @@ class RemoteFunction:
             runtime_env={k: v for k, v in renv.items() if k != "env_vars"} or None,
         )
         _apply_strategy(spec, opts.get("scheduling_strategy"))
-        entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
-        return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]
-        blob = None
-        with _sent_lock:
-            if self._function_id not in _sent_functions:
-                blob = self._blob
-                _sent_functions.add(self._function_id)
-        rec = TaskRecord(
-            spec=spec,
-            arg_entries=entries,
-            kwarg_entries=kwentries,
-            return_ids=return_ids,
-            func_blob=blob,
-            retries_left=spec.max_retries,
-        )
-        global_worker.context.submit(rec)
+        from ray_tpu.util import tracing
+
+        submit_span = None
+        if tracing.is_enabled():
+            submit_span = tracing.start_span(
+                f"task::{spec.name}", "submit", attributes={"task_id": task_id.hex()}
+            )
+            spec.trace_context = {
+                "trace_id": submit_span["trace_id"],
+                "parent_id": submit_span["span_id"],
+            }
+            # Workers inherit tracing through the task env, so nested
+            # submissions from inside tasks are traced too.
+            spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
+        try:
+            entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
+            return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]
+            blob = None
+            with _sent_lock:
+                if self._function_id not in _sent_functions:
+                    blob = self._blob
+                    _sent_functions.add(self._function_id)
+            rec = TaskRecord(
+                spec=spec,
+                arg_entries=entries,
+                kwarg_entries=kwentries,
+                return_ids=return_ids,
+                func_blob=blob,
+                retries_left=spec.max_retries,
+            )
+            global_worker.context.submit(rec)
+        finally:
+            # Always close the span: leaving it open would mis-parent every
+            # later span on this thread (and never flush this one).
+            if submit_span is not None:
+                tracing.end_span(submit_span)
         refs = [ObjectRef(oid) for oid in return_ids]
         if num_returns == 1:
             return refs[0]

@@ -77,6 +77,9 @@ class AlgorithmConfig:
         return copy.deepcopy(self)
 
     def build(self) -> "Algorithm":
+        from ray_tpu._private import usage
+
+        usage.record_library_usage("rllib")
         algo_cls = getattr(self, "_algo_cls", None) or Algorithm
         return algo_cls(self.copy())
 

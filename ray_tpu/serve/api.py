@@ -175,6 +175,9 @@ def run(
     _blocking_http: bool = True,
 ) -> DeploymentHandle:
     """Deploy an application (graph); returns a handle to the ingress."""
+    from ray_tpu._private import usage
+
+    usage.record_library_usage("serve")
     ray_tpu._private.worker._auto_init()
     if isinstance(target, Deployment):
         target = target.bind()

@@ -38,6 +38,9 @@ class DataParallelTrainer(BaseTrainer):
         resume_from_checkpoint: Optional[Checkpoint] = None,
         metadata: Optional[Dict[str, Any]] = None,
     ):
+        from ray_tpu._private import usage
+
+        usage.record_library_usage("train")
         super().__init__(
             scaling_config=scaling_config,
             run_config=run_config,

@@ -32,6 +32,9 @@ class Tuner:
         tune_config: Optional[TuneConfig] = None,
         run_config: Optional[RunConfig] = None,
     ):
+        from ray_tpu._private import usage
+
+        usage.record_library_usage("tune")
         self._trainable = trainable
         self._param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()

@@ -114,7 +114,11 @@ def prometheus_text() -> str:
                     },
                 )
                 for b, c in zip(m["buckets"], data["bucket_counts"]):
-                    cur["buckets"][str(b)] += c
+                    # Processes may disagree on boundaries (per-process
+                    # registries, rolling code changes): union the buckets
+                    # instead of KeyError-ing the whole exposition.
+                    k = str(b)
+                    cur["buckets"][k] = cur["buckets"].get(k, 0) + c
                 cur["sum"] += data["sum"]
                 cur["count"] += data["count"]
     seen_headers = set()
@@ -127,8 +131,8 @@ def prometheus_text() -> str:
             lines.append(f"{name}{tagstr} {m['value']}")
         else:
             acc = 0
-            for b, c in m["buckets"].items():
-                acc += c
+            for b in sorted(m["buckets"], key=float):
+                acc += m["buckets"][b]
                 lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
             lines.append(f'{name}_bucket{{le="+Inf"}} {m["count"]}')
             lines.append(f"{name}_sum {m['sum']}")

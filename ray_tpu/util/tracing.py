@@ -1,0 +1,191 @@
+"""Distributed tracing: spans around task/actor submission and execution.
+
+Reference: `python/ray/util/tracing/tracing_helper.py` (`_tracing_task_invocation:284`,
+`_inject_tracing_into_class:443`) — OpenTelemetry spans wrapped around every
+task submit and execute, with trace context propagated caller -> worker.
+Redesign: no hard OpenTelemetry dependency. Spans are plain dicts with
+trace_id/span_id/parent_id; context rides the TaskSpec; finished spans buffer
+per process and flush into the GCS KV (`spans::<pid>`), where the driver can
+collect them, hand them to a registered exporter, or dump a chrome trace.
+
+    from ray_tpu.util import tracing
+    tracing.enable()
+    ... run tasks ...
+    spans = tracing.collect_spans()
+    tracing.chrome_trace("trace.json")
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+_state = threading.local()
+_lock = threading.Lock()
+_enabled = False
+_buffer: List[dict] = []
+_exporter: Optional[Callable[[dict], None]] = None
+_flusher_started = False
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    with _lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+    threading.Thread(target=_flush_loop, daemon=True, name="span-flusher").start()
+
+
+def enable(exporter: Optional[Callable[[dict], None]] = None) -> None:
+    """Turn span recording on in this process (workers inherit via the
+    RAY_TPU_TRACING env var on spawned tasks)."""
+    global _enabled, _exporter
+    _enabled = True
+    _exporter = exporter
+    os.environ["RAY_TPU_TRACING"] = "1"
+    _ensure_flusher()
+
+
+def is_enabled() -> bool:
+    return _enabled or os.environ.get("RAY_TPU_TRACING") == "1"
+
+
+# ------------------------------------------------------------------ span core
+def current_trace_context() -> Optional[Dict[str, str]]:
+    span = getattr(_state, "span", None)
+    if span is not None:
+        return {"trace_id": span["trace_id"], "parent_id": span["span_id"]}
+    return None
+
+
+def start_span(name: str, kind: str, trace_context: Optional[Dict[str, str]] = None,
+               attributes: Optional[Dict[str, Any]] = None) -> dict:
+    parent = trace_context or current_trace_context() or {}
+    span = {
+        "name": name,
+        "kind": kind,  # "submit" | "execute" | custom
+        "trace_id": parent.get("trace_id") or uuid.uuid4().hex,
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_id": parent.get("parent_id"),
+        "start": time.time(),
+        "end": None,
+        "status": "OK",
+        "attributes": attributes or {},
+        "pid": os.getpid(),
+    }
+    span["_prev"] = getattr(_state, "span", None)
+    _state.span = span
+    return span
+
+
+def end_span(span: dict, status: str = "OK") -> None:
+    span["end"] = time.time()
+    span["status"] = status
+    _state.span = span.pop("_prev", None)
+    with _lock:
+        _buffer.append(span)
+    _ensure_flusher()  # workers start flushing on their first finished span
+    if _exporter is not None:
+        try:
+            _exporter(span)
+        except Exception:
+            pass
+
+
+class span:
+    """Context manager for custom application spans."""
+
+    def __init__(self, name: str, attributes: Optional[Dict[str, Any]] = None):
+        self._name = name
+        self._attrs = attributes
+
+    def __enter__(self):
+        self._span = start_span(self._name, "custom", attributes=self._attrs)
+        return self._span
+
+    def __exit__(self, exc_type, _exc, _tb):
+        end_span(self._span, "ERROR" if exc_type else "OK")
+        return False
+
+
+# ------------------------------------------------------------------ flushing
+def _flush_loop():
+    while True:
+        time.sleep(1.0)
+        flush_spans()
+
+
+# Serializes the per-key KV read-modify-write: the 1 Hz flusher and an
+# explicit collect_spans()->flush_spans() would otherwise interleave their
+# get/extend/put sequences and drop each other's batches.
+_kv_flush_lock = threading.Lock()
+
+
+def flush_spans() -> None:
+    """Push buffered spans into the control-plane KV."""
+    from ray_tpu._private.worker import global_worker
+
+    ctx = global_worker.context
+    if ctx is None:
+        return
+    with _kv_flush_lock:
+        with _lock:
+            if not _buffer:
+                return
+            batch, _buffer[:] = list(_buffer), []
+        try:
+            key = f"spans::{os.getpid()}".encode()
+            existing = ctx.kv("get", key)
+            spans = json.loads(existing) if existing else []
+            spans.extend(_strip(s) for s in batch)
+            ctx.kv("put", key, json.dumps(spans[-5000:]).encode())
+        except Exception:
+            with _lock:
+                _buffer[:0] = batch  # retry next flush
+
+
+def _strip(s: dict) -> dict:
+    return {k: v for k, v in s.items() if not k.startswith("_")}
+
+
+def collect_spans() -> List[dict]:
+    """All spans flushed by every process (driver side)."""
+    from ray_tpu._private.worker import global_worker
+
+    flush_spans()
+    ctx = global_worker.context
+    out: List[dict] = []
+    for key in ctx.kv("keys", b"spans::"):
+        raw = ctx.kv("get", key)
+        if raw:
+            out.extend(json.loads(raw))
+    return sorted(out, key=lambda s: s["start"])
+
+
+def chrome_trace(filename: Optional[str] = None) -> List[dict]:
+    """Spans as chrome://tracing complete events (pid = process, tid = trace)."""
+    events = []
+    for s in collect_spans():
+        if s.get("end") is None:
+            continue
+        events.append(
+            {
+                "name": s["name"],
+                "cat": s["kind"],
+                "ph": "X",
+                "ts": int(s["start"] * 1e6),
+                "dur": int((s["end"] - s["start"]) * 1e6),
+                "pid": s["pid"],
+                "tid": s["trace_id"][:8],
+                "args": {**s.get("attributes", {}), "status": s["status"]},
+            }
+        )
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

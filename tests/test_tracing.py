@@ -1,0 +1,86 @@
+"""Tracing spans: submit/execute pairs, context propagation, chrome dump.
+
+Reference: `python/ray/tests/test_tracing.py` over `tracing_helper.py` —
+spans around task invocation AND execution sharing one trace.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_after():
+    yield
+    # enable() is process-global (env var inherited by later workers): turn it
+    # back off so other test modules don't record spans.
+    tracing._enabled = False
+    os.environ.pop("RAY_TPU_TRACING", None)
+
+
+def test_task_spans_propagate_trace(ray_start_regular, tmp_path):
+    tracing.enable()
+
+    @ray_tpu.remote
+    def traced(x):
+        return x + 1
+
+    assert ray_tpu.get(traced.remote(1), timeout=30) == 2
+
+    spans = []
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        spans = tracing.collect_spans()
+        if any(s["kind"] == "execute" for s in spans) and any(
+            s["kind"] == "submit" for s in spans
+        ):
+            break
+        time.sleep(0.2)
+    submits = [s for s in spans if s["kind"] == "submit" and "traced" in s["name"]]
+    execs = [s for s in spans if s["kind"] == "execute" and "traced" in s["name"]]
+    assert submits and execs
+    # Execution span is a child in the SAME trace as its submit span.
+    assert execs[0]["trace_id"] == submits[0]["trace_id"]
+    assert execs[0]["parent_id"] == submits[0]["span_id"]
+    assert execs[0]["status"] == "OK"
+
+    out = str(tmp_path / "spans.json")
+    events = tracing.chrome_trace(out)
+    assert any(e["cat"] == "execute" for e in events)
+
+
+def test_error_span_status(ray_start_regular):
+    tracing.enable()
+
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("nope")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote(), timeout=30)
+    deadline = time.time() + 10
+    err = []
+    while time.time() < deadline:
+        err = [
+            s
+            for s in tracing.collect_spans()
+            if s["kind"] == "execute" and "boom" in s["name"]
+        ]
+        if err:
+            break
+        time.sleep(0.2)
+    assert err and err[0]["status"] == "ERROR"
+
+
+def test_custom_spans_nest(ray_start_regular):
+    tracing.enable()
+    with tracing.span("outer"):
+        with tracing.span("inner"):
+            pass
+    spans = {s["name"]: s for s in tracing.collect_spans() if s["kind"] == "custom"}
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["inner"]["trace_id"] == spans["outer"]["trace_id"]
