@@ -1,0 +1,134 @@
+"""Native runtime components (C++): built on demand with g++, bound via
+ctypes (pybind11 is not in this environment — task constraints), with a pure-
+Python fallback when no toolchain exists.
+
+Current components:
+ - shm_arena: process-shared object-store arena allocator (plasma's
+   dlmalloc-over-shm redesigned without a store process; see shm_arena.cpp).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_SRC_DIR, "libshm_arena.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    src = os.path.join(_SRC_DIR, "shm_arena.cpp")
+    # pid-unique tmp + atomic replace: concurrent first-use builds from many
+    # worker processes each publish a COMPLETE .so (last writer wins).
+    tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src, "-lpthread"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        return False
+    os.replace(tmp, _LIB_PATH)
+    return True
+
+
+def load_arena_lib() -> Optional[ctypes.CDLL]:
+    """The compiled arena library, building it on first use; None when no
+    toolchain is available (callers fall back to per-object file segments)."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(
+            os.path.join(_SRC_DIR, "shm_arena.cpp")
+        ):
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.arena_create.restype = ctypes.c_int
+        lib.arena_attach.argtypes = [ctypes.c_char_p]
+        lib.arena_attach.restype = ctypes.c_void_p
+        lib.arena_detach.argtypes = [ctypes.c_void_p]
+        lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.arena_alloc.restype = ctypes.c_uint64
+        lib.arena_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.arena_free.restype = ctypes.c_int
+        for name in ("arena_used", "arena_capacity", "arena_high_water", "arena_map_size"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p]
+            fn.restype = ctypes.c_uint64
+        lib.arena_base.argtypes = [ctypes.c_void_p]
+        lib.arena_base.restype = ctypes.c_void_p
+        _lib = lib
+        return _lib
+
+
+class Arena:
+    """Python view of one attached arena mapping."""
+
+    def __init__(self, path: str, create_capacity: Optional[int] = None):
+        lib = load_arena_lib()
+        if lib is None:
+            raise RuntimeError("native arena library unavailable (no g++?)")
+        self._lib = lib
+        self.path = path
+        if create_capacity is not None and not os.path.exists(path):
+            rc = lib.arena_create(path.encode(), create_capacity)
+            if rc != 0:
+                raise OSError(-rc, f"arena_create failed for {path}")
+        self._h = lib.arena_attach(path.encode())
+        if not self._h:
+            raise OSError(f"arena_attach failed for {path}")
+        size = lib.arena_map_size(self._h)
+        base = lib.arena_base(self._h)
+        # ctypes arrays report format "<B", which memoryview ops reject;
+        # cast() to plain "B" makes slices read/writable like bytes.
+        self._mem = (ctypes.c_ubyte * size).from_address(base)
+        self._view = memoryview(self._mem).cast("B")
+
+    def alloc(self, size: int) -> int:
+        """Payload offset, or 0 when the arena is full."""
+        return self._lib.arena_alloc(self._h, size)
+
+    def free(self, offset: int) -> None:
+        self._lib.arena_free(self._h, offset)
+
+    def view(self, offset: int, length: int) -> memoryview:
+        """Zero-copy view of [offset, offset+length)."""
+        return self._view[offset:offset + length]
+
+    @property
+    def used(self) -> int:
+        return self._lib.arena_used(self._h)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.arena_capacity(self._h)
+
+    def detach(self) -> None:
+        if self._h:
+            # The ctypes view must die before munmap; drop our references.
+            self._view = None
+            self._mem = None
+            self._lib.arena_detach(self._h)
+            self._h = None
+
+
+def available() -> bool:
+    return load_arena_lib() is not None

@@ -36,6 +36,14 @@ class Config:
     object_store_full_delay_ms: int = 100
     # Ceiling on one inter-node object pull (relay through the head).
     object_pull_timeout_s: float = 300.0
+    # Store large objects in the node's native C++ shm arena (ray_tpu/_native/
+    # shm_arena.cpp — one mapping, offset allocations, no per-object file
+    # create/unlink) instead of one file per object. Falls back to files
+    # automatically when no toolchain / arena full.
+    use_native_object_arena: bool = True
+    # Native arena size per node; 0 = same as object_store_memory. Objects
+    # that don't fit the arena overflow to per-object file segments.
+    object_arena_bytes: int = 0
     # Testing hook: treat every segment sealed on another node as remote even if
     # its path happens to be readable (single-machine multi-daemon clusters share
     # a filesystem), so the inter-node pull path is exercised.

@@ -97,6 +97,19 @@ class NodeDaemon:
         with self._lock:
             self.procs[worker_id_hex] = popen
 
+    def _delete_object(self, path: str, arena_offset):
+        if arena_offset is not None:
+            from ray_tpu._private.object_store import get_node_arena
+
+            arena = get_node_arena(os.path.dirname(path))
+            if arena is not None:
+                arena.free(arena_offset)
+            return
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
     def _kill_worker(self, worker_id_hex: str):
         with self._lock:
             popen = self.procs.pop(worker_id_hex, None)
@@ -106,12 +119,17 @@ class NodeDaemon:
             except ProcessLookupError:
                 pass
 
-    def _read_object(self, token: int, path: str):
+    def _read_object(self, token: int, path: str, offset=None, length=None):
         # Off-thread: a large segment read must not block spawn/kill commands.
+        # Arena objects read [offset, offset+length) of the arena file.
         def _read():
             try:
                 with open(path, "rb") as f:
-                    data = f.read()
+                    if offset is not None:
+                        f.seek(offset)
+                        data = f.read(length)
+                    else:
+                        data = f.read()
                 self._send(("object_data", token, True, data))
             except OSError as e:
                 self._send(("object_data", token, False, repr(e)))
@@ -145,12 +163,9 @@ class NodeDaemon:
                 elif kind == "kill_worker":
                     self._kill_worker(msg[1])
                 elif kind == "read_object":
-                    self._read_object(msg[1], msg[2])
+                    self._read_object(msg[1], msg[2], *msg[3:])
                 elif kind == "delete_object":
-                    try:
-                        os.unlink(msg[1])
-                    except OSError:
-                        pass
+                    self._delete_object(msg[1], msg[2] if len(msg) > 2 else None)
                 elif kind == "shutdown":
                     break
         except (EOFError, OSError):

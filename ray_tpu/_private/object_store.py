@@ -25,7 +25,7 @@ import mmap
 import os
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import SerializedValue, deserialize, serialize
@@ -55,6 +55,13 @@ class ObjectMeta:
     # nodes use it to route a pull (the analogue of the reference's object
     # directory, `/root/reference/src/ray/object_manager/ownership_based_object_directory.h`).
     node_id: Optional[bytes] = None
+    # Set when the bytes live inside the node's native shm ARENA (segment is
+    # then the arena path): payload offset of this object's allocation.
+    # buffer_layout offsets are relative to the allocation either way.
+    arena_offset: Optional[int] = None
+    # False for metas that ALIAS another object's payload (dependency-error
+    # propagation): readers use the location, but freeing is the owner's job.
+    owns_payload: bool = True
     # ObjectRef ids pickled inside this value: the control plane keeps them
     # pinned while this object lives (reference: contained-object tracking,
     # `core_worker/reference_count.h`).
@@ -95,6 +102,110 @@ class SharedSegment:
             os.unlink(self.path)
         except FileNotFoundError:
             pass
+
+
+ARENA_FILENAME = "arena.shm"
+_arenas: Dict[str, object] = {}
+_arena_lock = threading.Lock()
+
+
+def get_node_arena(shm_dir: str, capacity: Optional[int] = None):
+    """Attach (creating once per node, creation-raced via an O_EXCL claim
+    file) the node's native arena; None when the native lib is unavailable or
+    creation failed (callers fall back to per-object files — a None result is
+    cached so a broken arena never stalls the put path again)."""
+    import time
+
+    from ray_tpu._native import available, Arena
+
+    if not available():
+        return None
+    path = os.path.join(shm_dir, ARENA_FILENAME)
+    with _arena_lock:
+        if path in _arenas:  # may be a cached None (permanent fallback)
+            return _arenas[path]
+    arena = None
+    try:
+        arena = _create_or_attach_arena(path, capacity)
+    except OSError:
+        arena = None
+    with _arena_lock:
+        if path in _arenas and _arenas[path] is not None:
+            if arena is not None and arena is not _arenas[path]:
+                arena.detach()  # lost the caching race
+            return _arenas[path]
+        _arenas[path] = arena
+        return arena
+
+
+def _create_or_attach_arena(path: str, capacity: Optional[int]):
+    """Claim-or-wait creation protocol. Runs WITHOUT the module lock (the
+    wait must not block other arenas' operations); handles a creator that died
+    between claiming and publishing by retiring the stale claim once."""
+    import time
+
+    from ray_tpu._native import Arena
+
+    ready = path + ".ready"
+    claim = path + ".init"
+    for attempt in range(2):
+        if os.path.exists(ready):
+            return Arena(path)
+        if capacity is None:
+            from ray_tpu._private.config import get_config
+
+            cfg = get_config()
+            capacity = cfg.object_arena_bytes or cfg.object_store_memory
+        try:
+            fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            Arena(path, create_capacity=capacity).detach()
+            with open(ready, "w") as f:
+                f.write("1")
+            return Arena(path)
+        except FileExistsError:
+            deadline = time.time() + 10
+            while not os.path.exists(ready):
+                if time.time() > deadline:
+                    # Creator likely died mid-creation: retire the stale claim
+                    # (and any partial arena file) and retry once.
+                    for p in (claim, path):
+                        try:
+                            os.unlink(p)
+                        except OSError:
+                            pass
+                    break
+                time.sleep(0.02)
+            else:
+                return Arena(path)
+    return None
+
+
+def write_arena_object(arena, arena_path: str, sv: SerializedValue) -> Optional[ObjectMeta]:
+    """Place `sv` into the node arena; None when the arena is full (caller
+    falls back to a per-object file segment)."""
+    header = 8 + len(sv.inband)
+    layout: List[Tuple[int, int]] = []
+    offset = _align(header)
+    for b in sv.buffers:
+        layout.append((offset, b.nbytes))
+        offset = _align(offset + b.nbytes)
+    total = max(offset, header)
+    alloc = arena.alloc(total)
+    if alloc == 0:
+        return None
+    view = arena.view(alloc, total)
+    view[0:8] = len(sv.inband).to_bytes(8, "little")
+    view[8:header] = sv.inband
+    for (off, length), buf in zip(layout, sv.buffers):
+        view[off:off + length] = buf
+    return ObjectMeta(
+        object_id=None,  # set by caller
+        size=total,
+        segment=arena_path,
+        buffer_layout=layout,
+        arena_offset=alloc,
+    )
 
 
 def write_segment(dir_path: str, object_id: ObjectID, sv: SerializedValue) -> ObjectMeta:
@@ -142,19 +253,47 @@ def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn, force
     remote = force_remote and meta.node_id is not None and meta.node_id != store.node_id
     if not remote and os.path.exists(meta.segment):
         return meta
-    local_path = os.path.join(store.shm_dir, os.path.basename(meta.segment))
+    # Pulled copies cache under the OBJECT id (arena objects share one file
+    # path, so the segment basename isn't unique) as plain file segments.
+    local_path = os.path.join(store.shm_dir, meta.object_id.hex())
     if os.path.exists(local_path):
-        return dataclasses.replace(meta, segment=local_path)
+        return dataclasses.replace(meta, segment=local_path, arena_offset=None)
     fetched, data = pull_fn(meta.object_id.binary())
     if fetched.segment is None:
         return fetched  # became inline (e.g. error overwrite)
-    local_path = os.path.join(store.shm_dir, os.path.basename(fetched.segment))
+    local_path = os.path.join(store.shm_dir, fetched.object_id.hex())
     if not os.path.exists(local_path):
         tmp = f"{local_path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(data or b"")
         os.replace(tmp, local_path)
-    return dataclasses.replace(fetched, segment=local_path)
+    return dataclasses.replace(fetched, segment=local_path, arena_offset=None)
+
+
+class _PinnedArenaBuffer:
+    """Zero-copy buffer exporter that keeps its arena object refcounted while
+    any consumer (numpy array, bytes view) is alive — the client half of
+    plasma's pin-while-mapped rule (`object_lifecycle_manager.h`)."""
+
+    __slots__ = ("_mv", "_key")
+
+    def __init__(self, mv: memoryview, key: bytes):
+        self._mv = mv
+        self._key = key
+        from ray_tpu._private.worker import _ref_tracker
+
+        _ref_tracker.incref(key)
+
+    def __buffer__(self, flags):
+        return self._mv
+
+    def __del__(self):
+        try:
+            from ray_tpu._private.worker import _ref_tracker
+
+            _ref_tracker.decref(self._key)
+        except Exception:
+            pass  # interpreter teardown
 
 
 class LocalObjectStore:
@@ -172,6 +311,9 @@ class LocalObjectStore:
         os.makedirs(shm_dir, exist_ok=True)
         self._segments: Dict[str, SharedSegment] = {}
         self._lock = threading.Lock()
+        # Arena handle cached per store: False = not yet resolved (None is a
+        # meaningful "unavailable" result from get_node_arena).
+        self._arena: Any = False
 
     # --- write path ---
     def put_serialized(self, object_id: ObjectID, sv: SerializedValue, inline_threshold: int) -> ObjectMeta:
@@ -184,7 +326,24 @@ class LocalObjectStore:
                 inline_buffers=[bytes(b) for b in sv.buffers],
                 contained_ids=contained,
             )
-        meta = write_segment(self.shm_dir, object_id, sv)
+        meta = None
+        if self._arena is False:  # resolve once per store
+            from ray_tpu._private.config import get_config
+
+            self._arena = (
+                get_node_arena(self.shm_dir)
+                if get_config().use_native_object_arena
+                else None
+            )
+        if self._arena is not None:
+            meta = write_arena_object(
+                self._arena, os.path.join(self.shm_dir, ARENA_FILENAME), sv
+            )
+            if meta is not None:
+                meta.object_id = object_id
+        if meta is None:
+            # No native lib, arena disabled, or arena full: per-object file.
+            meta = write_segment(self.shm_dir, object_id, sv)
         meta.node_id = self.node_id
         meta.contained_ids = contained
         return meta
@@ -197,6 +356,23 @@ class LocalObjectStore:
         if meta.segment is None:
             buffers = [memoryview(b) for b in (meta.inline_buffers or [])]
             return deserialize(meta.inband, buffers)
+        if meta.arena_offset is not None:
+            arena = get_node_arena(os.path.dirname(meta.segment))
+            if arena is None:
+                raise OSError(f"native arena unavailable for {meta.segment}")
+            mv = arena.view(meta.arena_offset, meta.size)
+            inband_len = int.from_bytes(mv[0:8], "little")
+            inband = bytes(mv[8 : 8 + inband_len])
+            # Unlike unlinked file mmaps (which stay valid for existing views),
+            # a freed arena block gets RECYCLED — so zero-copy views must pin
+            # the object. Each buffer is wrapped in a PEP-688 exporter that
+            # holds a process-local ref until the consuming arrays die.
+            key = meta.object_id.binary()
+            buffers = [
+                _PinnedArenaBuffer(mv[off : off + length], key)
+                for off, length in meta.buffer_layout or []
+            ]
+            return deserialize(inband, buffers)
         with self._lock:
             seg = self._segments.get(meta.segment)
             if seg is None:
@@ -211,6 +387,11 @@ class LocalObjectStore:
     # --- lifecycle (owner side) ---
     def free(self, meta: ObjectMeta):
         if meta.segment is None:
+            return
+        if meta.arena_offset is not None:
+            arena = get_node_arena(os.path.dirname(meta.segment))
+            if arena is not None:
+                arena.free(meta.arena_offset)
             return
         with self._lock:
             seg = self._segments.pop(meta.segment, None)

@@ -1021,7 +1021,7 @@ class Scheduler:
             # accounting before the new one takes over.
             self._retire_meta_accounting(old)
         self.object_table[key] = meta
-        if meta.segment and meta.node_id:
+        if meta.segment and meta.node_id and meta.owns_payload:
             nid = NodeID(meta.node_id)
             self.node_usage[nid] = self.node_usage.get(nid, 0) + meta.size
         if meta.contained_ids:
@@ -1087,25 +1087,33 @@ class Scheduler:
 
     def _retire_meta_accounting(self, meta: ObjectMeta):
         key = meta.object_id.binary()
-        if meta.segment and meta.node_id:
+        if meta.segment and meta.node_id and meta.owns_payload:
             nid = NodeID(meta.node_id)
             self.node_usage[nid] = max(0, self.node_usage.get(nid, 0) - meta.size)
         for child in self.contained_pins.pop(key, []):
             self._unpin(child)
 
     def _delete_segment(self, meta: ObjectMeta):
-        if not meta.segment:
+        if not meta.segment or not meta.owns_payload:
             return
-        # Dependency-error metas alias their parent's segment; only the object
-        # that actually owns the file (segments are named by creator id) may
-        # unlink it.
-        if os.path.basename(meta.segment) != meta.object_id.hex():
-            return
-        # Daemons and client drivers both honor ("delete_object", path) on
-        # their connections; head-local (virtual-node) segments unlink here.
+        if meta.arena_offset is None:
+            # Dependency-error metas alias their parent's segment; only the
+            # object that actually owns the file (segments are named by
+            # creator id) may unlink it. (Arena allocations are per-object by
+            # construction, so the guard only applies to file segments.)
+            if os.path.basename(meta.segment) != meta.object_id.hex():
+                return
+        # Daemons and client drivers both honor ("delete_object", path, off)
+        # on their connections; head-local (virtual-node) segments free here.
         source = self._pull_sources.get(meta.node_id or b"")
         if source is not None:
-            source.send(("delete_object", meta.segment))
+            source.send(("delete_object", meta.segment, meta.arena_offset))
+        elif meta.arena_offset is not None:
+            from ray_tpu._private.object_store import get_node_arena
+
+            arena = get_node_arena(os.path.dirname(meta.segment))
+            if arena is not None:
+                arena.free(meta.arena_offset)
         else:
             try:
                 os.unlink(meta.segment)
@@ -1142,6 +1150,26 @@ class Scheduler:
                 "(del / let them go out of scope) or raise object_store_memory."
             )
         return None
+
+    def _alias_error_meta(self, oid: ObjectID, err: ObjectMeta) -> ObjectMeta:
+        """A dependent's error result aliasing the failed dependency's payload.
+        The alias copies the full location (segment/arena_offset/node_id) so
+        remote and arena-stored errors read correctly, owns_payload=False so
+        freeing stays the owner's job, and contained_ids pins the owner so the
+        payload cannot be recycled while the alias is referenced."""
+        return ObjectMeta(
+            object_id=oid,
+            size=err.size,
+            inband=err.inband,
+            inline_buffers=err.inline_buffers,
+            segment=err.segment,
+            buffer_layout=err.buffer_layout,
+            is_error=True,
+            node_id=err.node_id,
+            arena_offset=err.arena_offset,
+            owns_payload=err.segment is None,
+            contained_ids=[err.object_id.binary()] if err.segment else None,
+        )
 
     def _store_error_results(self, rec: TaskRecord, err: Exception):
         sv = serialization.serialize(err)
@@ -1590,11 +1618,16 @@ class Scheduler:
             # Head-local: virtual nodes and the head node share the head's shm
             # dir, so the segment is directly readable here. Read off-thread —
             # a multi-GB read must not stall the scheduling loop (responses are
-            # lock-protected sends, safe from other threads).
+            # lock-protected sends, safe from other threads). Arena objects
+            # read their allocation slice of the arena file.
             def _read_and_respond():
                 try:
                     with open(meta.segment, "rb") as f:
-                        data = f.read()
+                        if meta.arena_offset is not None:
+                            f.seek(meta.arena_offset)
+                            data = f.read(meta.size)
+                        else:
+                            data = f.read()
                 except OSError as e:
                     respond(False, e)
                     return
@@ -1605,7 +1638,9 @@ class Scheduler:
         self._pull_token += 1
         token = self._pull_token
         self._pending_pulls[token] = (respond, meta)
-        if not source.send(("read_object", token, meta.segment)):
+        if not source.send(
+            ("read_object", token, meta.segment, meta.arena_offset, meta.size)
+        ):
             self._pending_pulls.pop(token, None)
             respond(False, ConnectionError("object source node is unreachable"))
 
@@ -1896,16 +1931,7 @@ class Scheduler:
             rec = self.tasks.get(req.spec.task_id)
             if err_meta is not None and rec is not None:
                 for oid in rec.return_ids:
-                    m = ObjectMeta(
-                        object_id=oid,
-                        size=err_meta.size,
-                        inband=err_meta.inband,
-                        inline_buffers=err_meta.inline_buffers,
-                        segment=err_meta.segment,
-                        buffer_layout=err_meta.buffer_layout,
-                        is_error=True,
-                    )
-                    self._seal_object(m)
+                    self._seal_object(self._alias_error_meta(oid, err_meta))
                 rec.state = "FAILED"
                 self._release_task_pins(rec)
                 return
@@ -2159,12 +2185,7 @@ class Scheduler:
         err = next((m for m in list(metas) + list(kw.values()) if m.is_error), None)
         if err is not None:
             for oid in rec.return_ids:
-                m = ObjectMeta(
-                    object_id=oid, size=err.size, inband=err.inband,
-                    inline_buffers=err.inline_buffers, segment=err.segment,
-                    buffer_layout=err.buffer_layout, is_error=True,
-                )
-                self._seal_object(m)
+                self._seal_object(self._alias_error_meta(oid, err))
             rec.state = "FAILED"
             self._release_task_pins(rec)
             return True

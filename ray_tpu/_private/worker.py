@@ -388,10 +388,18 @@ class RemoteDriverContext:
 
             threading.Thread(target=_read, daemon=True).start()
         elif msg[0] == "delete_object":
-            try:
-                os.unlink(msg[1])
-            except OSError:
-                pass
+            arena_offset = msg[2] if len(msg) > 2 else None
+            if arena_offset is not None:
+                from ray_tpu._private.object_store import get_node_arena
+
+                arena = get_node_arena(os.path.dirname(msg[1]))
+                if arena is not None:
+                    arena.free(arena_offset)
+            else:
+                try:
+                    os.unlink(msg[1])
+                except OSError:
+                    pass
 
     def close(self):
         try:

@@ -123,10 +123,20 @@ class _JobSupervisor:
 
 
 @ray_tpu.remote(num_cpus=0)
-def _reap_supervisor(_run_status, job_id: str):
-    """Runs AFTER the supervisor's run() result seals (it's a dependency), so
-    killing the actor can never race the job's result/status flush — the
-    reference JobManager's supervisor teardown, dependency-ordered."""
+def _reap_supervisor(run_refs, job_id: str):
+    """Waits (list-wrapped ref: NOT a dependency — dependency-error
+    propagation would skip this task exactly when run() raised) for the
+    supervisor's run() result to seal, then tears the supervisor down and
+    repairs a non-terminal status left by a crash — the reference
+    JobManager's supervisor teardown."""
+    ray_tpu.wait(run_refs)  # blocks without consuming CPU (worker unblocks it)
+    from ray_tpu._private.worker import global_worker
+
+    ctx = global_worker.context
+    status = ctx.kv("get", _status_key(job_id))
+    if status not in (s.encode() for s in JobStatus.TERMINAL):
+        # run() died before writing a terminal status.
+        ctx.kv("put", _status_key(job_id), JobStatus.FAILED.encode())
     try:
         sup = ray_tpu.get_actor(f"JOB_SUPERVISOR::{job_id}")
     except ValueError:
@@ -184,10 +194,9 @@ class JobSubmissionClient:
             runtime_env=runtime_env,
         ).remote(job_id, entrypoint)
         run_ref = sup.run.remote()
-        # Dependency-ordered teardown: reap fires only after run()'s result
-        # seals, so the supervisor (0.1 CPU + worker process) never leaks and
-        # never dies mid-flush.
-        _reap_supervisor.remote(run_ref, job_id)
+        # Teardown: reap waits on run()'s result (even an error) and then
+        # kills the supervisor, so it never leaks and never dies mid-flush.
+        _reap_supervisor.remote([run_ref], job_id)
         self._supervisors = getattr(self, "_supervisors", {})
         self._supervisors[job_id] = sup
         return job_id

@@ -22,8 +22,9 @@ import jax.numpy as jnp
 
 
 def moe_capacity(num_tokens: int, num_experts: int, capacity_factor: float) -> int:
-    cap = int(num_tokens * capacity_factor / num_experts)
-    return max(cap, 1)
+    import math
+
+    return max(math.ceil(num_tokens * capacity_factor / num_experts), 1)
 
 
 def moe_mlp(

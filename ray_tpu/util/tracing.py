@@ -154,11 +154,14 @@ def _strip(s: dict) -> dict:
 
 
 def collect_spans() -> List[dict]:
-    """All spans flushed by every process (driver side)."""
+    """All spans flushed by every process (driver side); empty when no
+    runtime is connected."""
     from ray_tpu._private.worker import global_worker
 
     flush_spans()
     ctx = global_worker.context
+    if ctx is None:
+        return []
     out: List[dict] = []
     for key in ctx.kv("keys", b"spans::"):
         raw = ctx.kv("get", key)

@@ -20,10 +20,25 @@ from ray_tpu._private.worker import flush_ref_ops, global_worker
 
 
 @pytest.fixture
-def small_store():
-    """Runtime with a 40MB object store cap."""
+def ray_start_regular():
+    """File-segment mode: these tests assert on per-object segment files
+    (the native-arena store has its own suite, test_native_arena.py)."""
     ctx = ray_tpu.init(
-        num_cpus=2, _system_config={"object_store_memory": 40 * 1024 * 1024}
+        num_cpus=4, _system_config={"use_native_object_arena": False}
+    )
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def small_store():
+    """Runtime with a 40MB object store cap (file-segment mode)."""
+    ctx = ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "object_store_memory": 40 * 1024 * 1024,
+            "use_native_object_arena": False,
+        },
     )
     yield ctx
     ray_tpu.shutdown()
