@@ -7,6 +7,7 @@ from ray_tpu.models.gpt import (
     param_logical_axes,
     train_flops_per_token,
 )
+from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.models.training import (
     TrainState,
     create_train_state,
@@ -18,6 +19,7 @@ from ray_tpu.models.training import (
 
 __all__ = [
     "GPTConfig",
+    "LlamaConfig",
     "TrainState",
     "create_train_state",
     "default_optimizer",

@@ -203,16 +203,9 @@ def _layer_norm(x, scale, bias, eps=1e-5):
 
 
 def _attention(q, k, v, config: GPTConfig, attention_fn):
-    if attention_fn is not None:
-        return attention_fn(q, k, v)
-    from ray_tpu.ops.flash_attention import flash_attention, xla_attention
+    from ray_tpu.models.stack import resolve_attention
 
-    mode = config.attention
-    if mode == "auto":
-        mode = "flash" if jax.default_backend() == "tpu" else "xla"
-    if mode == "flash":
-        return flash_attention(q, k, v, causal=True)
-    return xla_attention(q, k, v, causal=True)
+    return resolve_attention(q, k, v, config.attention, attention_fn)
 
 
 def _dropout(x, rate: float, rng):
@@ -296,7 +289,7 @@ def forward(
         else None
     )
 
-    def make_block_fn(first_layer, attn, mb_idx=None):
+    def make_block_fn(first_layer, attn, mb_idx=None, seq_streams=()):
         def block_fn(x, xs):
             layer, idx = xs
             rng = None
@@ -312,47 +305,17 @@ def forward(
             block_fn = jax.checkpoint(block_fn, prevent_cse=False, policy=remat_policy)
         return block_fn
 
-    n_pipeline = int(mesh.shape.get("pipeline", 1)) if mesh is not None else 1
-    if n_pipeline > 1:
-        from ray_tpu.parallel.pipeline import pipeline_apply, to_stages
+    from ray_tpu.models.stack import apply_stack
 
-        # Combining PP with CP: the pipeline region is already manual over the
-        # `pipeline` axis, so context parallelism must join the same manual
-        # region — use the inside-shard_map ring attention over `context`
-        # instead of whatever full-shard_map wrapper the caller passed.
-        n_context = int(mesh.shape.get("context", 1))
-        context_manual = n_context > 1
-        inner_attn = attention_fn
-        if context_manual:
-            import functools
-
-            from ray_tpu.parallel.ring_attention import ring_attention
-
-            inner_attn = functools.partial(ring_attention, axis_name="context")
-
-        def stack_fn(stage_local, xm, first_layer, mb_idx):
-            n_local = config.n_layer // n_pipeline
-            xm, auxs = jax.lax.scan(
-                make_block_fn(first_layer, inner_attn, mb_idx),
-                xm,
-                (stage_local, jnp.arange(n_local)),
-            )
-            return xm, jnp.sum(auxs)
-
-        M = num_microbatches or (2 * n_pipeline if B % (2 * n_pipeline) == 0 else n_pipeline)
-        x, moe_aux = pipeline_apply(
-            mesh,
-            to_stages(params["blocks"], n_pipeline),
-            x,
-            stack_fn,
-            M,
-            context_manual=context_manual,
-        )
-    else:
-        x, auxs = jax.lax.scan(
-            make_block_fn(0, attention_fn), x, (params["blocks"], jnp.arange(config.n_layer))
-        )
-        moe_aux = jnp.sum(auxs)
+    x, moe_aux = apply_stack(
+        params["blocks"],
+        x,
+        make_block_fn,
+        n_layer=config.n_layer,
+        attention_fn=attention_fn,
+        mesh=mesh,
+        num_microbatches=num_microbatches,
+    )
 
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     # Tied LM head: bf16 operands on the MXU, f32 accumulation — an f32×f32
@@ -388,12 +351,9 @@ def loss_fn(
         params, inputs, config, attention_fn, dropout_rng, mesh, num_microbatches,
         return_aux=True,
     )
-    # logsumexp - logit[target]: one reduction pass over V instead of
-    # materializing the full (B, S, V) log-softmax array (saves ~2x V-sized
-    # HBM traffic, ~19ms/step for GPT-2-small at B=16 on v5e).
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    at_target = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    loss = (lse - at_target).mean()
+    from ray_tpu.models.stack import causal_lm_loss
+
+    loss = causal_lm_loss(logits, targets)
     if config.moe_experts:
         loss = loss + config.moe_aux_weight * moe_aux
     return loss

@@ -1,0 +1,279 @@
+"""Llama-family transformer, TPU-first: RMSNorm, SwiGLU MLP, rotary position
+embeddings, grouped-query attention, untied LM head.
+
+Second model family of the zoo (same design rules as gpt.py): plain pytree
+params with per-leaf logical axes, layers stacked + scanned (shared
+`models/stack.py` scaffolding, so DP/FSDP/TP/PP/CP all compose exactly as for
+GPT), bf16 matmuls with f32 norms/softmax/logits, pallas flash attention on
+TPU with ring attention injectable for context parallelism.
+
+The reference ships no model code; its user-facing analogue is the HF
+workloads in `release/air_tests/air_benchmarks/` (e.g. Llama fine-tunes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 32  # < n_head = grouped-query attention
+    d_model: int = 4096
+    d_ff: int = 11008  # SwiGLU hidden dim (~8/3 * d, rounded to hardware-friendly)
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attention: str = "auto"  # auto | flash | xla
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    @property
+    def group_size(self) -> int:
+        assert self.n_head % self.n_kv_head == 0
+        return self.n_head // self.n_kv_head
+
+    # ---- presets ----
+    @classmethod
+    def llama2_7b(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def llama2_13b(cls, **kw):
+        return cls(n_layer=40, n_head=40, n_kv_head=40, d_model=5120, d_ff=13824, **kw)
+
+    @classmethod
+    def llama3_8b(cls, **kw):
+        return cls(
+            vocab_size=128256, n_layer=32, n_head=32, n_kv_head=8,
+            d_model=4096, d_ff=14336, max_seq_len=8192, rope_theta=500000.0, **kw
+        )
+
+    @classmethod
+    def nano(cls, **kw):
+        """Tiny GQA config for CPU tests (2 kv heads for 4 q heads)."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq_len", 128)
+        return cls(n_layer=2, n_head=4, n_kv_head=2, d_model=64, d_ff=128, **kw)
+
+
+def num_params(config: LlamaConfig) -> int:
+    d, L, V, F = config.d_model, config.n_layer, config.vocab_size, config.d_ff
+    kvd = config.n_kv_head * config.head_dim
+    per_layer = (
+        d * d            # wq
+        + 2 * d * kvd    # wk, wv
+        + d * d          # wo
+        + 2 * d * F      # w_gate, w_up
+        + F * d          # w_down
+        + 2 * d          # 2 rmsnorm scales
+    )
+    return 2 * V * d + L * per_layer + d  # embed + untied head + final norm
+
+
+def train_flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    attn = 12 * config.n_layer * config.d_model * seq_len
+    return 6.0 * num_params(config) + attn
+
+
+# --------------------------------------------------------------------------- init
+def init_params(config: LlamaConfig, key) -> Dict[str, Any]:
+    d, L, V, F = config.d_model, config.n_layer, config.vocab_size, config.d_ff
+    nh, nkv, hd = config.n_head, config.n_kv_head, config.head_dim
+    k = iter(jax.random.split(key, 16))
+    std = 0.02
+    out_std = std / math.sqrt(2 * L)
+    pd = config.param_dtype
+
+    def norm(key, shape, s):
+        return (jax.random.normal(key, shape) * s).astype(pd)
+
+    return {
+        "embed": norm(next(k), (V, d), std),
+        "blocks": {
+            "attn_norm": jnp.ones((L, d), pd),
+            "wq": norm(next(k), (L, d, nh, hd), std),
+            "wk": norm(next(k), (L, d, nkv, hd), std),
+            "wv": norm(next(k), (L, d, nkv, hd), std),
+            "wo": norm(next(k), (L, nh, hd, d), out_std),
+            "mlp_norm": jnp.ones((L, d), pd),
+            "w_gate": norm(next(k), (L, d, F), std),
+            "w_up": norm(next(k), (L, d, F), std),
+            "w_down": norm(next(k), (L, F, d), out_std),
+        },
+        "final_norm": jnp.ones((d,), pd),
+        "lm_head": norm(next(k), (V, d), std),
+    }
+
+
+def param_logical_axes(config: LlamaConfig) -> Dict[str, Any]:
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("layers", None),
+            "wq": ("layers", "embed", "heads", None),
+            "wk": ("layers", "embed", "kv_heads", None),
+            "wv": ("layers", "embed", "kv_heads", None),
+            "wo": ("layers", "heads", None, "embed"),
+            "mlp_norm": ("layers", None),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": (None,),
+        "lm_head": ("vocab", "embed"),
+    }
+
+
+# --------------------------------------------------------------------------- forward
+def _rms_norm(x, scale, eps):
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return xf * rms * scale
+
+
+def rope_tables(seq_len: int, head_dim: int, theta: float):
+    """Precomputed (S, head_dim/2) cos/sin tables with GLOBAL positions —
+    computed once per forward and passed through the stack as sequence
+    streams, so context-parallel shards rotate with their true positions (a
+    locally-indexed arange inside the block would restart every CP shard at
+    position 0) and the tables aren't rebuilt per layer under remat."""
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope(x, cos, sin):
+    """Apply rotary embeddings. x: (B, H, S_local, hd); cos/sin: (S_local, hd/2)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x2 * cos + x1 * sin
+    return jnp.concatenate([rx1, rx2], axis=-1).astype(x.dtype)
+
+
+def _attention(q, k, v, config: LlamaConfig, attention_fn):
+    from ray_tpu.models.stack import resolve_attention
+
+    return resolve_attention(q, k, v, config.attention, attention_fn)
+
+
+def _block(x, layer, config: LlamaConfig, attention_fn, cos, sin):
+    """One Llama block. x: (B, S, D). Returns (x, aux=0)."""
+    cdt = config.dtype
+    g = config.group_size
+
+    h = _rms_norm(x, layer["attn_norm"], config.norm_eps).astype(cdt)
+    q = jnp.einsum("bsd,dnh->bnsh", h, layer["wq"].astype(cdt))
+    k = jnp.einsum("bsd,dnh->bnsh", h, layer["wk"].astype(cdt))
+    v = jnp.einsum("bsd,dnh->bnsh", h, layer["wv"].astype(cdt))
+    q = _rope(q, cos, sin)
+    k = _rope(k, cos, sin)
+    if g > 1:
+        # GQA: each kv head serves `group_size` query heads.
+        k = jnp.repeat(k, g, axis=1)
+        v = jnp.repeat(v, g, axis=1)
+    o = _attention(q, k, v, config, attention_fn)  # (B, nh, S, hd)
+    o = jnp.einsum("bnsh,nhd->bsd", o.astype(cdt), layer["wo"].astype(cdt))
+    x = x + o
+
+    h = _rms_norm(x, layer["mlp_norm"], config.norm_eps).astype(cdt)
+    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cdt))
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cdt))
+    h = jax.nn.silu(gate) * up
+    h = jnp.einsum("bsf,fd->bsd", h, layer["w_down"].astype(cdt))
+    return x + h, jnp.zeros((), jnp.float32)
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens,  # (B, S) int32
+    config: LlamaConfig,
+    attention_fn: Optional[Callable] = None,
+    dropout_rng=None,  # accepted for API parity; Llama pretraining uses none
+    mesh=None,
+    num_microbatches: Optional[int] = None,
+    return_aux: bool = False,
+):
+    """Logits (B, S, vocab) f32; pipelines over the `pipeline` mesh axis like
+    GPT (shared stack scaffolding)."""
+    del dropout_rng
+    cdt = config.dtype
+    S = tokens.shape[1]
+    x = params["embed"].astype(cdt)[tokens]
+    cos, sin = rope_tables(S, config.head_dim, config.rope_theta)
+
+    remat_cfg = config.remat
+
+    def make_block_fn(first_layer, attn, mb_idx=None, seq_streams=()):
+        del first_layer, mb_idx  # no per-layer RNG (no dropout)
+        cos_s, sin_s = seq_streams  # context-sharded slices under PPxCP
+
+        def block_fn(x, xs):
+            layer, _idx = xs
+            return _block(x, layer, config, attn, cos_s, sin_s)
+
+        if remat_cfg:
+            block_fn = jax.checkpoint(block_fn, prevent_cse=False)
+        return block_fn
+
+    from ray_tpu.models.stack import apply_stack
+
+    x, aux = apply_stack(
+        params["blocks"],
+        x,
+        make_block_fn,
+        n_layer=config.n_layer,
+        attention_fn=attention_fn,
+        mesh=mesh,
+        num_microbatches=num_microbatches,
+        seq_streams=(cos, sin),
+    )
+
+    x = _rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum(
+        "bsd,vd->bsv",
+        x.astype(cdt),
+        params["lm_head"].astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
+    if return_aux:
+        return logits, aux
+    return logits
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, Any],
+    config: LlamaConfig,
+    attention_fn: Optional[Callable] = None,
+    dropout_rng=None,
+    mesh=None,
+    num_microbatches: Optional[int] = None,
+):
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+    else:
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(
+        params, inputs, config, attention_fn, dropout_rng, mesh, num_microbatches
+    )
+    from ray_tpu.models.stack import causal_lm_loss
+
+    return causal_lm_loss(logits, targets)

@@ -22,6 +22,16 @@ from ray_tpu.parallel import ShardingRules, batch_spec
 from ray_tpu.models import gpt
 
 
+def model_for(config):
+    """Dispatch a config dataclass to its model module (gpt, llama, ...), so
+    one TrainState/step factory serves the whole zoo."""
+    from ray_tpu.models import llama
+
+    if isinstance(config, llama.LlamaConfig):
+        return llama
+    return gpt
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class TrainState:
@@ -30,9 +40,10 @@ class TrainState:
     step: jax.Array
 
 
-def param_shardings(config: gpt.GPTConfig, mesh, rules: ShardingRules):
-    axes = gpt.param_logical_axes(config)
-    shapes = jax.eval_shape(lambda: gpt.init_params(config, jax.random.PRNGKey(0)))
+def param_shardings(config, mesh, rules: ShardingRules):
+    model = model_for(config)
+    axes = model.param_logical_axes(config)
+    shapes = jax.eval_shape(lambda: model.init_params(config, jax.random.PRNGKey(0)))
     return jax.tree.map(
         lambda ax, s: rules.sharding(mesh, ax, shape=s.shape),
         axes,
@@ -44,7 +55,7 @@ def param_shardings(config: gpt.GPTConfig, mesh, rules: ShardingRules):
 
 
 def create_train_state(
-    config: gpt.GPTConfig,
+    config,
     key,
     optimizer,
     mesh=None,
@@ -54,9 +65,9 @@ def create_train_state(
     if mesh is not None:
         rules = rules or ShardingRules()
         shardings = param_shardings(config, mesh, rules)
-        init = jax.jit(lambda k: gpt.init_params(config, k), out_shardings=shardings)
+        init = jax.jit(lambda k: model_for(config).init_params(config, k), out_shardings=shardings)
     else:
-        init = jax.jit(lambda k: gpt.init_params(config, k))
+        init = jax.jit(lambda k: model_for(config).init_params(config, k))
     params = init(key)
     # Optimizer state (adam mu/nu) inherits the param shardings by propagation.
     opt_state = jax.jit(optimizer.init)(params)
@@ -64,7 +75,7 @@ def create_train_state(
 
 
 def make_train_step(
-    config: gpt.GPTConfig,
+    config,
     optimizer,
     mesh=None,
     attention_fn: Optional[Callable] = None,
@@ -76,11 +87,13 @@ def make_train_step(
 
     def step_fn(state: TrainState, batch):
         dropout_rng = (
-            jax.random.fold_in(base_rng, state.step) if config.dropout > 0 else None
+            jax.random.fold_in(base_rng, state.step)
+            if getattr(config, "dropout", 0) > 0
+            else None
         )
 
         def loss_of(p):
-            return gpt.loss_fn(
+            return model_for(config).loss_fn(
                 p, batch, config, attention_fn, dropout_rng, mesh=mesh
             )
 

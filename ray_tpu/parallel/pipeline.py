@@ -37,6 +37,7 @@ def pipeline_apply(
     block_stack_fn: Callable,
     num_microbatches: int,
     context_manual: bool = False,
+    seq_streams: tuple = (),
 ):
     """Run `block_stack_fn(stage_params_local, x_mb, first_layer_idx)` as a
     P-stage pipeline over microbatches of `x`.
@@ -48,11 +49,15 @@ def pipeline_apply(
       x: (B, S, D) activations (embedded tokens).
       block_stack_fn: applies one stage's layer stack to one microbatch:
         (local_params with leading dim L//P, (mb, S, D), first_layer_idx,
-        microbatch_idx) -> ((mb, S, D), aux_scalar). The microbatch index keeps
-        per-microbatch randomness (dropout) independent, matching
-        non-pipelined semantics; aux (e.g. MoE load-balance loss) accumulates
-        over REAL ticks only (bubble-tick garbage is masked out), summed over
-        stages via psum and averaged over microbatches.
+        microbatch_idx, seq_streams) -> ((mb, S, D), aux_scalar). The
+        microbatch index keeps per-microbatch randomness (dropout)
+        independent, matching non-pipelined semantics; aux (e.g. MoE
+        load-balance loss) accumulates over REAL ticks only (bubble-tick
+        garbage is masked out), summed over stages via psum and averaged over
+        microbatches.
+      seq_streams: per-position arrays with leading dim S (e.g. RoPE cos/sin
+        tables) that must shard with the sequence: inside the region each rank
+        sees its context shard, keeping GLOBAL positions correct under CP.
       num_microbatches: M; must divide B.
       context_manual: also make the `context` axis manual inside the pipeline
         region (sequence dim sharded S/cp per rank) so ring attention — which
@@ -71,7 +76,7 @@ def pipeline_apply(
         raise ValueError(f"num_microbatches={M} must divide batch {B}")
     x_mb = x.reshape(M, B // M, S, D)
 
-    def per_rank(stage_local, x_all):
+    def per_rank(stage_local, x_all, *streams):
         # stage_local leaves: (1, L//P, ...) — this rank's stage slice.
         stage_local = jax.tree.map(lambda a: a[0], stage_local)
         p = jax.lax.axis_index("pipeline")
@@ -88,7 +93,7 @@ def pipeline_apply(
             x_in = jnp.where(p == 0, inject, buf)
             # The microbatch this rank is processing at tick t.
             mb_proc = jnp.clip(t - p, 0, M - 1)
-            y, aux = block_stack_fn(stage_local, x_in, first_layer, mb_proc)
+            y, aux = block_stack_fn(stage_local, x_in, first_layer, mb_proc, streams)
             # Bubble ticks compute garbage: only real (stage, microbatch)
             # pairs contribute aux.
             real = jnp.logical_and(t - p >= 0, t - p < M)
@@ -118,19 +123,22 @@ def pipeline_apply(
 
     manual = {"pipeline"}
     x_spec = P()
+    stream_spec = P()
     if context_manual:
         manual.add("context")
-        # x_mb is (M, mb, S, D): shard the sequence dim over context.
+        # x_mb is (M, mb, S, D): shard the sequence dim over context; streams
+        # shard their leading (position) dim the same way.
         x_spec = P(None, None, "context", None)
+        stream_spec = P("context")
     sharded = jax.shard_map(
         per_rank,
         mesh=mesh,
-        in_specs=(P("pipeline"), x_spec),
+        in_specs=(P("pipeline"), x_spec) + (stream_spec,) * len(seq_streams),
         out_specs=(x_spec, P()),
         axis_names=frozenset(manual),
         check_vma=False,
     )
-    out, aux = sharded(stage_params, x_mb)
+    out, aux = sharded(stage_params, x_mb, *seq_streams)
     return out.reshape(B, S, D), aux
 
 
