@@ -27,7 +27,7 @@ import os
 import subprocess
 import sys
 
-B, S, WARMUP, ITERS = 16, 1024, 3, 20
+B, S, WARMUP, ITERS, WINDOWS = 16, 1024, 5, 30, 2
 
 
 def _timed_tokens_per_sec():
@@ -67,12 +67,17 @@ def _timed_tokens_per_sec():
     for _ in range(WARMUP):
         state, m = step(state, batch)
     _ = float(m["loss"])  # sync
-    t0 = time.time()
-    for _ in range(ITERS):
-        state, m = step(state, batch)
-    _ = float(m["loss"])  # sync
-    dt = (time.time() - t0) / ITERS
-    return B * S / dt, len(devices)
+    # Best of N windows: the relay/link adds per-window jitter that a single
+    # window folds into the headline number.
+    best_dt = None
+    for _ in range(WINDOWS):
+        t0 = time.time()
+        for _ in range(ITERS):
+            state, m = step(state, batch)
+        _ = float(m["loss"])  # sync
+        dt = (time.time() - t0) / ITERS
+        best_dt = dt if best_dt is None else min(best_dt, dt)
+    return B * S / best_dt, len(devices)
 
 
 def _train_loop(config):

@@ -202,10 +202,6 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return ((x - mean) * jax.lax.rsqrt(var + eps) * scale + bias)
 
 
-def _attention(q, k, v, config: GPTConfig, attention_fn):
-    from ray_tpu.models.stack import resolve_attention
-
-    return resolve_attention(q, k, v, config.attention, attention_fn)
 
 
 def _dropout(x, rate: float, rng):
@@ -230,7 +226,9 @@ def _block(x, layer, config: GPTConfig, attention_fn, drop_rng=None):
         "qkv_b"
     ].astype(cdt)
     q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))  # (B, nh, S, hd)
-    o = _attention(q, k, v, config, attention_fn)  # (B, nh, S, hd)
+    from ray_tpu.models.stack import resolve_attention
+
+    o = resolve_attention(q, k, v, config.attention, attention_fn)  # (B, nh, S, hd)
     o = jnp.einsum("bnsh,nhd->bsd", o.astype(cdt), layer["out_w"].astype(cdt)) + layer[
         "out_b"
     ].astype(cdt)

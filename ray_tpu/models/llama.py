@@ -167,10 +167,6 @@ def _rope(x, cos, sin):
     return jnp.concatenate([rx1, rx2], axis=-1).astype(x.dtype)
 
 
-def _attention(q, k, v, config: LlamaConfig, attention_fn):
-    from ray_tpu.models.stack import resolve_attention
-
-    return resolve_attention(q, k, v, config.attention, attention_fn)
 
 
 def _block(x, layer, config: LlamaConfig, attention_fn, cos, sin):
@@ -188,7 +184,9 @@ def _block(x, layer, config: LlamaConfig, attention_fn, cos, sin):
         # GQA: each kv head serves `group_size` query heads.
         k = jnp.repeat(k, g, axis=1)
         v = jnp.repeat(v, g, axis=1)
-    o = _attention(q, k, v, config, attention_fn)  # (B, nh, S, hd)
+    from ray_tpu.models.stack import resolve_attention
+
+    o = resolve_attention(q, k, v, config.attention, attention_fn)  # (B, nh, S, hd)
     o = jnp.einsum("bnsh,nhd->bsd", o.astype(cdt), layer["wo"].astype(cdt))
     x = x + o
 
