@@ -197,6 +197,29 @@ def _remote(fn, num_returns: int = 1):
     return _remote_cache[key]
 
 
+class _MapWorker:
+    """Actor-pool map worker: constructs the UDF once, applies it per block."""
+
+    def __init__(self, fn, ctor_args):
+        self._fn = fn(*ctor_args) if isinstance(fn, type) else fn
+
+    def apply(self, block: Block, batch_size, batch_format) -> Block:
+        return _apply_chain(block, [("map_batches", (self._fn, batch_size, batch_format))])
+
+
+def _reap_pool(refs, handles):
+    """Kill a stage's actors once every block result is sealed (results live
+    in the object store independently of the producing actors). Runs as a
+    task so fire-and-forget datasets still release their pool processes."""
+    if refs:
+        ray_tpu.wait(refs, num_returns=len(refs))
+    for h in handles:
+        try:
+            ray_tpu.kill(h)
+        except Exception:
+            pass
+
+
 # ------------------------------------------------------------------------ Dataset
 class Dataset:
     """A lazy sequence of blocks + pending per-block op chain."""
@@ -217,7 +240,34 @@ class Dataset:
         *,
         batch_size: Optional[int] = 4096,
         batch_format: str = "numpy",
+        compute: str = "tasks",
+        num_actors: int = 2,
+        fn_constructor_args: Tuple = (),
     ) -> "Dataset":
+        """Transform batches. With ``compute="actors"`` (required for CLASS
+        fns — the reference's ActorPoolStrategy + callable-class pattern),
+        blocks run through a pool of ``num_actors`` actors that construct `fn`
+        ONCE each: the vehicle for expensive per-worker state like loaded
+        model weights (reference: batch inference, `_internal/execution`
+        actor pools)."""
+        if compute not in ("tasks", "actors"):
+            raise ValueError(
+                f"compute must be 'tasks' or 'actors', got {compute!r}"
+            )
+        if isinstance(fn, type):
+            if compute == "tasks":
+                raise TypeError(
+                    "class UDFs run on actor pools (construct-once state); "
+                    "pass compute='actors' (or a plain function for tasks)"
+                )
+            compute = "actors"
+        if compute == "actors":
+            return self._derive(
+                (
+                    "map_batches_actors",
+                    (fn, fn_constructor_args, batch_size, batch_format, num_actors),
+                )
+            )
         return self._derive(("map_batches", (fn, batch_size, batch_format)))
 
     def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
@@ -240,12 +290,40 @@ class Dataset:
 
     # ------------------------------------------------------------- execution
     def _execute(self) -> List[Any]:
-        if self._materialized is None:
-            chain = self._ops
-            apply_remote = _remote(_apply_chain)
-            self._materialized = [
-                apply_remote.remote(ref, chain) for ref in self._input_refs
-            ]
+        if self._materialized is not None:
+            return self._materialized
+        refs = list(self._input_refs)
+        segment: List[PerBlockOp] = []
+
+        def flush():
+            nonlocal refs
+            if segment:
+                apply_remote = _remote(_apply_chain)
+                chain = list(segment)
+                refs = [apply_remote.remote(r, chain) for r in refs]
+                segment.clear()
+
+        for op in self._ops:
+            if op[0] == "map_batches_actors":
+                # Actor stages break task fusion: run the fused prefix, then
+                # round-robin blocks over a fresh actor pool.
+                flush()
+                fn, ctor_args, batch_size, batch_format, num_actors = op[1]
+                pool = [
+                    _remote(_MapWorker).remote(fn, ctor_args)
+                    for _ in range(max(1, num_actors))
+                ]
+                refs = [
+                    pool[i % len(pool)].apply.remote(r, batch_size, batch_format)
+                    for i, r in enumerate(refs)
+                ]
+                # Release the pool once all block results seal (list-wrapped:
+                # waits inside rather than becoming a dependency).
+                _remote(_reap_pool).remote(list(refs), pool)
+            else:
+                segment.append(op)
+        flush()
+        self._materialized = refs
         return self._materialized
 
     def materialize(self) -> "Dataset":
@@ -406,6 +484,10 @@ class Dataset:
     ) -> Iterator[Any]:
         """Streaming iteration: per-block task chains are submitted a window
         ahead of consumption; leftover rows carry across block boundaries."""
+        if any(op[0] == "map_batches_actors" for op in self._ops):
+            # Actor stages need pool construction: run the staged executor
+            # first; the prefetch window then streams the materialized refs.
+            self._execute()
         chain = self._ops
         apply_remote = _remote(_apply_chain)
         pending = list(

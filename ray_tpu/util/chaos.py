@@ -1,0 +1,83 @@
+"""Chaos testing: kill nodes/workers on an interval while a workload runs.
+
+Reference: `python/ray/_private/test_utils.py:1355 get_and_run_node_killer` —
+a NodeKillerActor SIGKILLs raylets on a schedule; `tests/test_chaos.py` and
+the nightly chaos suites assert workloads survive. Here the killer is a
+driver-side thread targeting `cluster_utils.Cluster` nodes (virtual or real
+daemon processes — killing a real daemon exercises the genuine
+connection-drop failure path).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+
+class NodeKiller:
+    """Kills a random non-head node every `interval_s` until stopped.
+
+    With `respawn=True` each killed node is replaced with an identical one
+    (resources copied), emulating a flaky-but-recovering fleet.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        interval_s: float = 2.0,
+        respawn: bool = True,
+        max_kills: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self._cluster = cluster
+        self._interval = interval_s
+        self._respawn = respawn
+        self._max_kills = max_kills
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills: List[str] = []
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="node-killer")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        import ray_tpu
+
+        while not self._stop.wait(self._interval):
+            if self._max_kills is not None and len(self.kills) >= self._max_kills:
+                return
+            victims = [
+                n for n in ray_tpu.nodes() if n["alive"] and n["labels"].get("head") != "1"
+            ]
+            if not victims:
+                continue
+            victim = self._rng.choice(victims)
+            resources = {
+                k: v for k, v in victim["resources"].items() if k != "memory"
+            }
+            from ray_tpu._private.ids import NodeID
+
+            try:
+                self._cluster.remove_node(NodeID.from_hex(victim["node_id"]))
+            except Exception:
+                continue
+            self.kills.append(victim["node_id"])
+            if self._respawn and not self._stop.is_set():
+                cpus = resources.pop("CPU", 1)
+                tpus = resources.pop("TPU", 0)
+                try:
+                    self._cluster.add_node(
+                        num_cpus=cpus, num_tpus=tpus, resources=resources
+                    )
+                except Exception:
+                    pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)

@@ -171,3 +171,56 @@ def test_trainer_dataset_split_integration(ray_ctx, tmp_path):
     )
     result = trainer.fit()
     assert result.metrics["n"] == 20
+
+
+def test_map_batches_actor_pool(ray_ctx):
+    """Class UDFs run on an actor pool: constructed once per actor (expensive
+    state like model weights loads num_actors times, not once per block)."""
+    import numpy as np
+
+    from ray_tpu import data as rdata
+
+    class AddBias:
+        def __init__(self, bias):
+            import os
+
+            from ray_tpu._private.worker import global_worker
+
+            # One key per constructing process (concurrent inits would race a
+            # read-modify-write counter).
+            global_worker.context.kv("put", f"udf_init::{os.getpid()}".encode(), b"1")
+            self.bias = bias
+
+        def __call__(self, batch):
+            batch["value"] = batch["value"] + self.bias
+            return batch
+
+    ds = rdata.from_items([{"value": i} for i in range(64)]).repartition(8)
+    out = ds.map_batches(
+        AddBias, compute="actors", num_actors=2, fn_constructor_args=(100,)
+    )
+    values = sorted(r["value"] for r in out.take_all())
+    assert values == [i + 100 for i in range(64)]
+    from ray_tpu._private.worker import global_worker
+
+    assert len(global_worker.context.kv("keys", b"udf_init::")) == 2
+
+
+def test_map_batches_actors_after_fused_ops(ray_ctx):
+    """Fused task prefix -> actor stage -> fused suffix all compose."""
+    from ray_tpu import data as rdata
+
+    class Doubler:
+        def __call__(self, batch):
+            batch["value"] = batch["value"] * 2
+            return batch
+
+    ds = (
+        rdata.from_items([{"value": i} for i in range(20)])
+        .repartition(4)
+        .map(lambda r: {"value": r["value"] + 1})      # fused task stage
+        .map_batches(Doubler, compute="actors", num_actors=2)  # actor stage
+        .filter(lambda r: r["value"] > 10)              # fused task stage
+    )
+    values = sorted(r["value"] for r in ds.take_all())
+    assert values == sorted(v for v in ((i + 1) * 2 for i in range(20)) if v > 10)
