@@ -17,11 +17,14 @@ from ray_tpu.rllib.core.learner import JaxLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.env.env_runner import EnvRunner
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "DQN",
+    "DQNConfig",
     "EnvRunner",
     "JaxLearner",
     "LearnerGroup",

@@ -101,7 +101,6 @@ class Algorithm:
         import gymnasium as gym
 
         from ray_tpu.rllib.core.learner_group import LearnerGroup
-        from ray_tpu.rllib.core.rl_module import MLPModule
         from ray_tpu.rllib.env.env_runner import EnvRunner
         import ray_tpu
 
@@ -112,11 +111,9 @@ class Algorithm:
         obs_space, act_space = probe.observation_space, probe.action_space
         probe.close()
         if not isinstance(act_space, gym.spaces.Discrete):
-            raise NotImplementedError("round-1 supports Discrete action spaces")
-        self.module = MLPModule(
-            int(np.prod(obs_space.shape)),
-            int(act_space.n),
-            hiddens=tuple(config.model.get("hiddens", (64, 64))),
+            raise NotImplementedError("only Discrete action spaces so far")
+        self.module = self.make_module(
+            int(np.prod(obs_space.shape)), int(act_space.n)
         )
         self.learner_group = LearnerGroup(
             self.module,
@@ -140,6 +137,15 @@ class Algorithm:
         ]
 
     # -------------------------------------------------------------- interface
+    def make_module(self, obs_dim: int, num_actions: int):
+        """The RLModule for this algorithm (policy-gradient default; value-
+        based algorithms override, e.g. DQN's Q-network)."""
+        from ray_tpu.rllib.core.rl_module import MLPModule
+
+        return MLPModule(
+            obs_dim, num_actions, hiddens=tuple(self.config.model.get("hiddens", (64, 64)))
+        )
+
     def make_loss(self) -> Callable:
         raise NotImplementedError
 

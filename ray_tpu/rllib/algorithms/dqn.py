@@ -1,0 +1,229 @@
+"""DQN: deep Q-learning with replay, target network, and double-Q targets.
+
+Reference: `rllib/algorithms/dqn/dqn.py` (DQNConfig: replay buffer,
+`target_network_update_freq`, `n_step`, double-Q default) and the TD loss in
+`dqn_torch_policy.py` (huber on Q(s,a) - y, y = r + gamma^n * Q_target).
+
+TPU-first shape: the TD loss is one pure jitted function on the JaxLearner
+stack (same learner/LearnerGroup machinery as PPO); the target network's
+parameters are the learner's replicated EXTRA state (`set_extra`) — never in
+the batch, which shards over data and slices per remote learner — so target
+syncs neither trigger recompilation nor collide with batch sharding. The
+replay buffer is host-side numpy in the driver — random uniform sampling is
+memory bookkeeping, not MXU work. Exploration is epsilon-greedy with the
+schedule held by the driver and pushed to runners as a traced scalar.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_capacity = 50_000
+        self.learning_starts = 1_000
+        self.train_batch_size = 64
+        self.updates_per_iteration = 32
+        self.target_network_update_freq = 200  # in learner updates
+        self.double_q = True
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 10_000  # env steps
+        self.grad_clip = 10.0
+        self._algo_cls = DQN
+
+    def training(self, **kwargs) -> "DQNConfig":
+        aliases = {"target_update_freq": "target_network_update_freq"}
+        kwargs = {aliases.get(k, k): v for k, v in kwargs.items()}
+        super().training(**kwargs)
+        return self
+
+
+class ReplayBuffer:
+    """Uniform ring buffer over flat numpy transitions (reference:
+    `rllib/utils/replay_buffers/replay_buffer.py`)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._store: Dict[str, np.ndarray] = {}
+        self._next = 0
+        self.size = 0
+
+    def add(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(next(iter(batch.values())))
+        if not self._store:
+            for k, v in batch.items():
+                self._store[k] = np.zeros((self.capacity,) + v.shape[1:], v.dtype)
+        idx = (self._next + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._store[k][idx] = v
+        self._next = (self._next + n) % self.capacity
+        self.size = min(self.size + n, self.capacity)
+
+    def sample(self, batch_size: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, batch_size)
+        return {k: v[idx] for k, v in self._store.items()}
+
+
+def make_dqn_loss(config: DQNConfig) -> Callable:
+    """Pure (module, params, batch, extra) -> (loss, aux): huber TD error with
+    (double-)Q targets from the target params in the learner's extra state."""
+    gamma = config.gamma
+    double_q = config.double_q
+
+    def loss(module, params, batch, extra):
+        import jax.numpy as jnp
+
+        target_params = extra["target_params"]
+        q_all, _ = module.forward(params, batch["obs"])
+        q_sa = jnp.take_along_axis(q_all, batch["actions"][..., None], axis=-1)[..., 0]
+
+        tq_all, _ = module.forward(target_params, batch["next_obs"])
+        if double_q:
+            # Online net picks the action, target net evaluates it.
+            next_q_online, _ = module.forward(params, batch["next_obs"])
+            a_star = jnp.argmax(next_q_online, axis=-1)
+            tq = jnp.take_along_axis(tq_all, a_star[..., None], axis=-1)[..., 0]
+        else:
+            tq = tq_all.max(axis=-1)
+        y = batch["rewards"] + gamma * (1.0 - batch["terminateds"]) * tq
+        y = jnp.asarray(y, jnp.float32)
+        td = q_sa - jnp.where(jnp.isfinite(y), y, 0.0)
+        # Truncated (time-limit) rows have a reset obs in next_obs: exclude
+        # them rather than bootstrap through the wrong state.
+        weight = batch["loss_weight"]
+        huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td, jnp.abs(td) - 0.5)
+        total = jnp.sum(weight * huber) / jnp.maximum(jnp.sum(weight), 1.0)
+        aux = {
+            "td_error_mean": jnp.sum(weight * jnp.abs(td)) / jnp.maximum(jnp.sum(weight), 1.0),
+            "q_mean": jnp.mean(q_sa),
+        }
+        return total, aux
+
+    return loss
+
+
+class DQN(Algorithm):
+    def __init__(self, config: DQNConfig):
+        super().__init__(config)
+        self.buffer = ReplayBuffer(config.buffer_capacity)
+        self.num_updates = 0
+        self.env_steps = 0
+        self._rng = np.random.default_rng(config.seed)
+        self._sync_target()
+
+    def _sync_target(self) -> None:
+        self.target_params = self.learner_group.get_weights()
+        self.learner_group.set_extra({"target_params": self.target_params})
+
+    def make_module(self, obs_dim: int, num_actions: int):
+        from ray_tpu.rllib.core.rl_module import QMLPModule
+
+        return QMLPModule(
+            obs_dim, num_actions,
+            hiddens=tuple(self.config.model.get("hiddens", (64, 64))),
+        )
+
+    def make_loss(self) -> Callable:
+        return make_dqn_loss(self.config)
+
+    def make_optimizer(self):
+        import optax
+
+        return optax.chain(
+            optax.clip_by_global_norm(self.config.grad_clip),
+            optax.adam(self.config.lr),
+        )
+
+    # -------------------------------------------------------------- schedule
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    # ----------------------------------------------------------- one iteration
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.config
+        weights = self.learner_group.get_weights()
+        eps = self.epsilon()
+        ray_tpu.get(
+            [r.set_weights.remote(weights) for r in self.env_runners]
+            + [r.set_exploration.remote(eps) for r in self.env_runners]
+        )
+        rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
+        for ro in rollouts:
+            self.buffer.add(self._transitions(ro))
+            self.env_steps += int(ro["rewards"].size)
+
+        out: Dict[str, Any] = {
+            "epsilon": eps,
+            "buffer_size": self.buffer.size,
+            "num_env_steps_sampled": self.env_steps,
+        }
+        if self.buffer.size >= cfg.learning_starts:
+            metrics_acc: List[Dict[str, float]] = []
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size, self._rng)
+                metrics_acc.append(self.learner_group.update(batch))
+                self.num_updates += 1
+                if self.num_updates % cfg.target_network_update_freq == 0:
+                    self._sync_target()
+            out.update(
+                {k: float(np.mean([m[k] for m in metrics_acc])) for k in metrics_acc[0]}
+            )
+        # Episode stats.
+        stats = ray_tpu.get([r.episode_stats.remote() for r in self.env_runners])
+        episodes = [s for s in stats if s.get("episodes", 0) > 0]
+        if episodes:
+            out["episode_return_mean"] = float(
+                np.average(
+                    [s["episode_return_mean"] for s in episodes],
+                    weights=[s["episodes"] for s in episodes],
+                )
+            )
+            out["episodes_this_iter"] = int(sum(s["episodes"] for s in episodes))
+        return out
+
+    @staticmethod
+    def _transitions(ro: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """(T, N) rollout buffers -> flat (s, a, r, s', terminated, weight)."""
+        obs, dones, terms = ro["obs"], ro["dones"], ro["terminateds"]
+        T = obs.shape[0]
+        next_obs = np.concatenate([obs[1:], ro["last_obs"][None]], axis=0)
+        # SAME_STEP autoreset: the row after a done holds the reset obs, which
+        # is the CORRECT s' only for rows that didn't end; terminated rows
+        # never use s', truncated rows are excluded via weight.
+        truncated = dones - terms
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
+        return {
+            "obs": flat(obs).astype(np.float32),
+            "actions": flat(ro["actions"]),
+            "rewards": flat(ro["rewards"]).astype(np.float32),
+            "next_obs": flat(next_obs).astype(np.float32),
+            "terminateds": flat(terms).astype(np.float32),
+            "loss_weight": flat(1.0 - truncated).astype(np.float32),
+        }
+
+    # -------------------------------------------------------------- checkpoint
+    def _extra_state(self) -> Dict[str, Any]:
+        return {
+            "target_params": self.target_params,
+            "num_updates": self.num_updates,
+            "env_steps": self.env_steps,
+        }
+
+    def _load_extra_state(self, state: Dict[str, Any]) -> None:
+        if "target_params" in state:
+            self.target_params = state["target_params"]
+            self.learner_group.set_extra({"target_params": self.target_params})
+        self.num_updates = int(state.get("num_updates", 0))
+        self.env_steps = int(state.get("env_steps", 0))

@@ -89,7 +89,7 @@ def _flatten(rollout: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     """(T, N, ...) buffers -> (T*N, ...) flat transition batch."""
     out = {}
     for k, v in rollout.items():
-        if k == "last_values":
+        if k in ("last_values", "last_obs"):
             continue
         out[k] = v.reshape((-1,) + v.shape[2:])
     return out

@@ -29,12 +29,20 @@ class JaxLearner:
         import jax
         import optax
 
+        import inspect
+
         self.module = module
         self._loss_fn = loss_fn
         self.optimizer = optimizer or optax.adam(learning_rate)
         self.mesh = mesh
         self.params = module.init(jax.random.PRNGKey(seed))
         self.opt_state = self.optimizer.init(self.params)
+        # Replicated auxiliary state the loss may consume (e.g. DQN's target
+        # network params): loss_fn(module, params, batch, extra). It rides as
+        # a jit argument with replicated sharding — never through the batch,
+        # which shards over data and slices per remote learner.
+        self.extra: Any = None
+        self._loss_wants_extra = len(inspect.signature(loss_fn).parameters) >= 4
         self._update = self._build_update()
 
     def _build_update(self):
@@ -42,9 +50,12 @@ class JaxLearner:
         import optax
 
         module, loss_fn, optimizer = self.module, self._loss_fn, self.optimizer
+        wants_extra = self._loss_wants_extra
 
-        def step(params, opt_state, batch):
+        def step(params, opt_state, extra, batch):
             def loss_of(p):
+                if wants_extra:
+                    return loss_fn(module, p, batch, extra)
                 return loss_fn(module, p, batch)
 
             (loss, aux), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
@@ -62,7 +73,7 @@ class JaxLearner:
             data = NamedSharding(self.mesh, P("data"))
             return jax.jit(
                 step,
-                in_shardings=(repl, repl, data),
+                in_shardings=(repl, repl, repl, data),
                 out_shardings=(repl, repl, repl),
                 donate_argnums=(0, 1),
             )
@@ -78,9 +89,13 @@ class JaxLearner:
             sharding = NamedSharding(self.mesh, P("data"))
             batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
         self.params, self.opt_state, aux = self._update(
-            self.params, self.opt_state, batch
+            self.params, self.opt_state, self.extra, batch
         )
         return {k: float(v) for k, v in aux.items()}
+
+    def set_extra(self, extra: Any) -> None:
+        """Swap the replicated auxiliary state (e.g. a synced target network)."""
+        self.extra = extra
 
     # ------------------------------------------------------------- state sync
     def get_weights(self) -> Any:

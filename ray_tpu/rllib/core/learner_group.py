@@ -29,6 +29,9 @@ class _RemoteLearner:
     def update(self, batch):
         return self.learner.update(batch)
 
+    def set_extra(self, extra):
+        self.learner.set_extra(extra)
+
     def get_weights(self):
         return self.learner.get_weights()
 
@@ -106,6 +109,16 @@ class LearnerGroup:
         for k in metrics[0]:
             out[k] = float(np.mean([m[k] for m in metrics]))
         return out
+
+    def set_extra(self, extra) -> None:
+        """Push replicated auxiliary loss state (e.g. DQN target params) to
+        every learner — it must never ride the (data-sharded, sliced) batch."""
+        if self._local is not None:
+            self._local.set_extra(extra)
+        else:
+            import ray_tpu
+
+            ray_tpu.get([lr.set_extra.remote(extra) for lr in self._remote])
 
     def get_weights(self):
         if self._local is not None:

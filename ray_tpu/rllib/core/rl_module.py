@@ -44,6 +44,64 @@ class RLModule:
         return action, act_logp, value, logits
 
 
+class QMLPModule(RLModule):
+    """Single-tower Q-network MLP for value-based algorithms: forward returns
+    per-action Q-values (logits slot) + max-Q (value slot); exploration is
+    epsilon-greedy with epsilon passed as a traced scalar (the runner jits
+    once and decays epsilon without recompiling). No value tower — every
+    weight here is read on the Q path (checkpoints, target copies, and weight
+    syncs stay half the size of the two-tower policy module)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hiddens: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        sizes = (self.obs_dim, *self.hiddens, self.num_actions)
+        layers = []
+        for m, n in zip(sizes[:-1], sizes[1:]):
+            key, sub = jax.random.split(key)
+            scale = jnp.sqrt(2.0 / m)
+            layers.append(
+                {
+                    "w": jax.random.normal(sub, (m, n), jnp.float32) * scale,
+                    "b": jnp.zeros((n,), jnp.float32),
+                }
+            )
+        return {"q": layers}
+
+    def forward(self, params, obs):
+        import jax.numpy as jnp
+
+        x = obs
+        layers = params["q"]
+        for i, lyr in enumerate(layers):
+            x = x @ lyr["w"] + lyr["b"]
+            if i < len(layers) - 1:
+                x = jnp.tanh(x)
+        return x, x.max(axis=-1)
+
+    def epsilon_greedy(self, params, obs, key, explore: bool, epsilon):
+        import jax
+        import jax.numpy as jnp
+
+        q, value = self.forward(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        if explore:
+            k1, k2 = jax.random.split(key)
+            random_a = jax.random.randint(k1, greedy.shape, 0, q.shape[-1])
+            u = jax.random.uniform(k2, greedy.shape)
+            action = jnp.where(u < epsilon, random_a, greedy)
+        else:
+            action = greedy
+        # logp slot unused for value-based policies; q rides the logits slot.
+        return action, jnp.zeros(greedy.shape, jnp.float32), value, q
+
+
 class MLPModule(RLModule):
     """Policy + value MLP with shared-nothing towers (categorical actions)."""
 

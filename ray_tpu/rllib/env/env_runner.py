@@ -62,12 +62,28 @@ class EnvRunner:
         self._episode_returns = np.zeros(num_envs)
         self._episode_lengths = np.zeros(num_envs, dtype=np.int64)
         self._completed: list = []
-        self._act = jax.jit(
-            lambda p, o, k, explore: module.action_dist(p, o, k, explore)
-        , static_argnums=(3,))
+        if hasattr(module, "epsilon_greedy"):
+            # Value-based modules (DQN): epsilon rides as a traced scalar so
+            # exploration decay never retriggers compilation.
+            jitted = jax.jit(
+                lambda p, o, k, explore, eps: module.epsilon_greedy(p, o, k, explore, eps),
+                static_argnums=(3,),
+            )
+            self._epsilon = 1.0
+            self._act = lambda p, o, k, explore: jitted(
+                p, o, k, explore, np.float32(self._epsilon)
+            )
+        else:
+            self._act = jax.jit(
+                lambda p, o, k, explore: module.action_dist(p, o, k, explore)
+            , static_argnums=(3,))
 
     def set_weights(self, weights) -> None:
         self._params = weights
+
+    def set_exploration(self, epsilon: float) -> None:
+        """Exploration state push (DQN epsilon schedule lives in the driver)."""
+        self._epsilon = float(epsilon)
 
     def sample(self, explore: bool = True) -> Dict[str, np.ndarray]:
         """One rollout fragment: (T*num_envs) flat transition batch."""
@@ -138,6 +154,9 @@ class EnvRunner:
             "terminateds": term_buf,
             "bootstrap_values": boot_buf,
             "last_values": np.asarray(last_val, np.float32),
+            # Final observations (value-based algorithms build next_obs by
+            # shifting obs and closing the tail with these).
+            "last_obs": self._obs.astype(np.float32),
         }
 
     def _final_observations(self, infos, nxt: np.ndarray) -> np.ndarray:

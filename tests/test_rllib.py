@@ -262,3 +262,97 @@ def test_ppo_loss_clipping_semantics():
     batch["logp"] = curr_logp - 10.0
     total_clipped, _ = loss_fn(module, params, batch)
     assert float(total_clipped) == pytest.approx(-(1.0 + cfg.clip_param), abs=1e-4)
+
+
+def _dqn_config(**training):
+    from ray_tpu.rllib import DQNConfig
+
+    opts = dict(
+        lr=1e-3,
+        gamma=0.99,
+        learning_starts=500,
+        train_batch_size=64,
+        updates_per_iteration=48,
+        target_network_update_freq=100,
+        epsilon_decay_steps=6000,
+    )
+    opts.update(training)
+    cfg = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=2, num_envs_per_runner=4, rollout_fragment_length=64
+        )
+        .training(**opts)
+    )
+    return cfg
+
+
+def test_dqn_cartpole_improves(ray_start_regular):
+    """DQN learns CartPole: mean return clearly above the random baseline."""
+    algo = _dqn_config().build()
+    try:
+        best = 0.0
+        for _ in range(25):
+            m = algo.train()
+            best = max(best, m.get("episode_return_mean", 0.0))
+            if best >= 60.0:
+                break
+        assert best >= 60.0, f"best return {best}"
+        assert m["epsilon"] < 1.0  # schedule is decaying
+        assert m["buffer_size"] > 0
+    finally:
+        algo.stop()
+
+
+def test_dqn_checkpoint_save_restore(ray_start_regular, tmp_path):
+    algo = _dqn_config().build()
+    try:
+        for _ in range(3):
+            algo.train()
+        path = algo.save(str(tmp_path / "ck"))
+        steps, updates = algo.env_steps, algo.num_updates
+    finally:
+        algo.stop()
+    algo2 = _dqn_config().build()
+    try:
+        algo2.restore(path)
+        assert algo2.env_steps == steps
+        assert algo2.num_updates == updates
+        algo2.train()  # trains on after restore
+    finally:
+        algo2.stop()
+
+
+def test_dqn_replay_buffer_semantics():
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=100)
+    batch = {
+        "obs": np.arange(40, dtype=np.float32).reshape(40, 1),
+        "actions": np.arange(40),
+    }
+    for _ in range(4):  # 160 rows into capacity 100 -> wraps
+        buf.add(batch)
+    assert buf.size == 100
+    s = buf.sample(32, np.random.default_rng(0))
+    assert s["obs"].shape == (32, 1) and s["actions"].shape == (32,)
+    # All sampled rows are valid (obs value equals its action id).
+    assert np.array_equal(s["obs"][:, 0].astype(np.int64), s["actions"])
+
+
+def test_dqn_multi_learner(ray_start_regular):
+    """Target params as replicated learner extra state: multi-learner DQN
+    updates run (batch slicing never touches the target pytree)."""
+    algo = _dqn_config(learning_starts=200, updates_per_iteration=8).learners(
+        num_learners=2
+    ).build()
+    try:
+        for _ in range(4):
+            m = algo.train()
+        assert m["buffer_size"] >= 200
+        assert "td_error_mean" in m  # learner updates actually ran
+    finally:
+        algo.stop()
