@@ -6,8 +6,11 @@ Design (pallas_guide.md playbook):
    in VMEM; online-softmax accumulation in fp32 over K blocks (fori_loop, no
    dynamic Python control flow); causal masking prunes future K blocks via the
    loop bound, and the diagonal block via broadcasted_iota row/col ids.
- - backward: two kernels (dq; dk/dv) recomputing probabilities from the saved
-   logsumexp — O(seq) memory, the point of flash attention.
+ - backward: ONE fused kernel per (batch*heads) computing dk/dv blockwise and
+   accumulating dq in a VMEM scratch across the sequential K-block grid dim —
+   s/p are recomputed once per (q,k) block pair instead of twice (the classic
+   two-kernel split recomputes them in both the dq and dkv kernels).
+   O(seq) memory, the point of flash attention.
  - matmuls run on the MXU with preferred_element_type=float32; inputs can be
    bfloat16.
 
@@ -19,6 +22,7 @@ layer); this file exists because long-context is first-class in the TPU build
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -26,10 +30,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# 512-blocks measured ~2.4x faster than 128 on v5e (more MXU work per grid
-# step amortizes the online-softmax vector ops).
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# Swept through the full GPT-2 train step on v5e: 1024x1024 > 512x512 by ~2%
+# end-to-end (fewer grid steps and loop iterations; more MXU work per step
+# amortizes the online-softmax vector ops). Blocks are capped to seq_len at
+# call time, so short sequences still get valid (smaller) blocks.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
@@ -52,7 +58,11 @@ def xla_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
 # --------------------------------------------------------------------------- forward kernel
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_q, block_k, seq_len):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    # Matmul operands stay in their input dtype (bf16 in training): f32x f32
+    # dots run the MXU at a fraction of its bf16 rate; accumulation is f32 via
+    # preferred_element_type either way. sm_scale folds into q once (block_q x d)
+    # instead of rescaling every (block_q x block_k) score matrix.
+    q = (q_ref[0].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)  # (block_q, d)
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
     if causal:
@@ -66,27 +76,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
 
-    def body(j, carry):
-        m_prev, l_prev, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # (block_q, block_k)
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, NEG_INF)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc
+    def make_body(masked):
+        def body(j, carry):
+            m_prev, l_prev, acc = carry
+            k = k_ref[0, pl.ds(j * block_k, block_k), :]
+            v = v_ref[0, pl.ds(j * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # (block_q, block_k)
+            if masked:
+                row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(row >= col, s, NEG_INF)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc
 
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+        return body
+
+    if causal:
+        # K blocks strictly below the diagonal need no mask (row >= col always
+        # holds); only blocks intersecting the diagonal pay the iota/where.
+        lo_diag = jax.lax.div(qi * block_q, block_k)  # first block that may mask
+        carry = jax.lax.fori_loop(0, lo_diag, make_body(False), (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(lo_diag, hi, make_body(True), carry)
+    else:
+        m, l, acc = jax.lax.fori_loop(0, hi, make_body(False), (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
     lse_ref[0] = (m + jnp.log(l))[:, None]
@@ -123,6 +144,9 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         out_shape=out_shape,
         interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
         cost_estimate=pl.CostEstimate(
             flops=4 * seq * seq * d,
             bytes_accessed=3 * seq * d * q.dtype.itemsize + seq * d * q.dtype.itemsize,
@@ -132,88 +156,78 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return o, lse
 
 
-# --------------------------------------------------------------------------- backward kernels
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   sm_scale, causal, block_q, block_k, seq_len):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0]
-    delta = delta_ref[0, :, 0]
-
-    num_k_blocks = pl.cdiv(seq_len, block_k)
-    if causal:
-        hi = jnp.minimum(jax.lax.div((qi + 1) * block_q + block_k - 1, block_k), num_k_blocks)
-    else:
-        hi = num_k_blocks
-
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-                    sm_scale, causal, block_q, block_k, seq_len):
+# --------------------------------------------------------------------------- backward kernel
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dq_acc, *,
+                      sm_scale, causal, block_q, block_k, seq_len):
+    """Grid (bh, kj) with kj sequential: per K block, loop Q blocks computing
+    dk/dv directly; dq contributions accumulate in the f32 VMEM scratch
+    (seq, d) that lives across the kj steps of one (b,h) pair."""
     kj = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # (block_k, d)
-    v = v_ref[0].astype(jnp.float32)
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    k = k_ref[0]  # (block_k, d)
+    v = v_ref[0]
+
+    @pl.when(kj == 0)
+    def _zero():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
 
     num_q_blocks = pl.cdiv(seq_len, block_q)
-    if causal:
-        # Only Q blocks at or after this K block attend to it.
-        lo = jax.lax.div(kj * block_k, block_q)
-    else:
-        lo = 0
+    lo = jax.lax.div(kj * block_k, block_q) if causal else 0
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # (block_q, block_k)
-        if causal:
-            row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            col = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * sm_scale
-        dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dk, dv
+    def make_body(masked):
+        def body(i, carry):
+            dk, dv = carry
+            q = q_ref[0, pl.ds(i * block_q, block_q), :]
+            do = do_ref[0, pl.ds(i * block_q, block_q), :]
+            lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+            delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+            qs = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+            s = jax.lax.dot_general(
+                qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # (block_q, block_k)
+            if masked:
+                row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                col = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(row >= col, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            dv = dv + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = (p * (dp - delta[:, None]) * sm_scale).astype(q.dtype)
+            dk = dk + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            sl = pl.ds(i * block_q, block_q)
+            dq_acc[sl, :] = dq_acc[sl, :] + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            return dk, dv
+
+        return body
 
     dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
     dv0 = jnp.zeros((block_k, v.shape[-1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
+    if causal:
+        # Q blocks past the diagonal band see this K block in full (row >= col
+        # for every pair): no mask needed there.
+        hi_diag = jnp.minimum(
+            jax.lax.div((kj + 1) * block_k + block_q - 1, block_q), num_q_blocks
+        )
+        dk, dv = jax.lax.fori_loop(lo, hi_diag, make_body(True), (dk0, dv0))
+        dk, dv = jax.lax.fori_loop(hi_diag, num_q_blocks, make_body(False), (dk, dv))
+    else:
+        dk, dv = jax.lax.fori_loop(lo, num_q_blocks, make_body(False), (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(kj == num_k_blocks - 1)
+    def _flush_dq():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
@@ -222,28 +236,9 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     bh, seq, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[..., None]  # (bh, seq, 1)
 
-    dq = pl.pallas_call(
+    dq, dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, seq_len=seq,
-        ),
-        grid=(bh, pl.cdiv(seq, block_q)),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            _bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, seq_len=seq,
         ),
         grid=(bh, pl.cdiv(seq, block_k)),
@@ -256,14 +251,22 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, seq, 1), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
+            # dq is revisited every kj step (index map constant in j) and
+            # flushed once per (b,h) when the grid moves on.
+            pl.BlockSpec((1, seq, d), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
         ],
+        scratch_shapes=[pltpu.VMEM((seq, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
@@ -363,12 +366,13 @@ def flash_attention(
     if backend == "blockwise":
         return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     b, h, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        raise ValueError(
-            f"seq_len {s} must be divisible by block sizes ({block_q}, {block_k})"
-        )
+    # Cap blocks to seq_len, then shrink to a divisor (gcd keeps the largest
+    # power-of-two factor) so defaults work for any seq that has one — e.g.
+    # S=1536 uses 512-blocks. Odd/indivisible lengths fall back to XLA.
+    block_q = math.gcd(min(block_q, s), s)
+    block_k = math.gcd(min(block_k, s), s)
+    if min(block_q, block_k) < 128:
+        return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     flat = lambda x: x.reshape(b * h, s, d)
     o = _flash_bhsd(flat(q), flat(k), flat(v), causal, sm_scale, block_q, block_k, interpret)
     return o.reshape(b, h, s, d)

@@ -95,7 +95,7 @@ def make_dqn_loss(config: DQNConfig) -> Callable:
             tq = tq_all.max(axis=-1)
         y = batch["rewards"] + gamma * (1.0 - batch["terminateds"]) * tq
         y = jnp.asarray(y, jnp.float32)
-        td = q_sa - jnp.where(jnp.isfinite(y), y, 0.0)
+        td = q_sa - y
         # Truncated (time-limit) rows have a reset obs in next_obs: exclude
         # them rather than bootstrap through the wrong state.
         weight = batch["loss_weight"]

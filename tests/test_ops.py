@@ -46,10 +46,20 @@ def test_flash_backward_matches_reference(qkv):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 
-def test_flash_rejects_misaligned_seq():
-    q = jnp.zeros((1, 1, 100, 64))
-    with pytest.raises(ValueError):
-        flash_attention(q, q, q, backend="pallas", interpret=True, block_q=64, block_k=64)
+def test_flash_misaligned_seq_falls_back_to_xla():
+    """Seq lens with no usable power-of-two block divisor (e.g. 100) silently
+    use the XLA path instead of raising; seq lens divisible by 512 but not by
+    the 1024 default shrink the block via gcd and stay on pallas."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 2, 100, 64)), jnp.float32)
+    out = flash_attention(q, q, q, backend="pallas", interpret=True, block_q=64, block_k=64)
+    ref = xla_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    q2 = jnp.asarray(rng.standard_normal((1, 1, 1536, 64)), jnp.float32)
+    out2 = flash_attention(q2, q2, q2, backend="pallas", interpret=True)  # gcd -> 512
+    ref2 = xla_attention(q2, q2, q2, causal=True)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2), atol=2e-4)
 
 
 def test_bf16_inputs(qkv):
