@@ -35,6 +35,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # "save_attn" (default) remats the projections/MLP but keeps attention
+    # outside the remat region (no kernel recompute in backward, q/k/v/o/lse
+    # saved); "dots" saves matmul outputs across the block remat boundary;
+    # None recomputes the whole block.
+    remat_policy: Optional[str] = "save_attn"
     attention: str = "auto"  # auto | flash | xla
 
     @property
@@ -169,33 +174,48 @@ def _rope(x, cos, sin):
 
 
 
-def _block(x, layer, config: LlamaConfig, attention_fn, cos, sin):
-    """One Llama block. x: (B, S, D). Returns (x, aux=0)."""
+def _block(x, layer, config: LlamaConfig, attention_fn, cos, sin, sub_remat=False):
+    """One Llama block. x: (B, S, D). Returns (x, aux=0).
+
+    With sub_remat ("save_attn" policy), the qkv/rope and wo/MLP halves are
+    individually remat'ed while attention between them is not — same policy
+    as gpt._block."""
     cdt = config.dtype
     g = config.group_size
 
-    h = _rms_norm(x, layer["attn_norm"], config.norm_eps).astype(cdt)
-    q = jnp.einsum("bsd,dnh->bnsh", h, layer["wq"].astype(cdt))
-    k = jnp.einsum("bsd,dnh->bnsh", h, layer["wk"].astype(cdt))
-    v = jnp.einsum("bsd,dnh->bnsh", h, layer["wv"].astype(cdt))
-    q = _rope(q, cos, sin)
-    k = _rope(k, cos, sin)
-    if g > 1:
-        # GQA: each kv head serves `group_size` query heads.
-        k = jnp.repeat(k, g, axis=1)
-        v = jnp.repeat(v, g, axis=1)
+    def qkv_part(x, layer):
+        h = _rms_norm(x, layer["attn_norm"], config.norm_eps).astype(cdt)
+        q = jnp.einsum("bsd,dnh->bnsh", h, layer["wq"].astype(cdt))
+        k = jnp.einsum("bsd,dnh->bnsh", h, layer["wk"].astype(cdt))
+        v = jnp.einsum("bsd,dnh->bnsh", h, layer["wv"].astype(cdt))
+        q = _rope(q, cos, sin)
+        k = _rope(k, cos, sin)
+        if g > 1:
+            # GQA: each kv head serves `group_size` query heads.
+            k = jnp.repeat(k, g, axis=1)
+            v = jnp.repeat(v, g, axis=1)
+        return q, k, v
+
+    def out_mlp_part(x, o, layer):
+        o = jnp.einsum("bnsh,nhd->bsd", o.astype(cdt), layer["wo"].astype(cdt))
+        x = x + o
+
+        h = _rms_norm(x, layer["mlp_norm"], config.norm_eps).astype(cdt)
+        gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cdt))
+        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cdt))
+        h = jax.nn.silu(gate) * up
+        h = jnp.einsum("bsf,fd->bsd", h, layer["w_down"].astype(cdt))
+        return x + h, jnp.zeros((), jnp.float32)
+
+    if sub_remat:
+        qkv_part = jax.checkpoint(qkv_part, prevent_cse=False)
+        out_mlp_part = jax.checkpoint(out_mlp_part, prevent_cse=False)
+
+    q, k, v = qkv_part(x, layer)
     from ray_tpu.models.stack import resolve_attention
 
     o = resolve_attention(q, k, v, config.attention, attention_fn)  # (B, nh, S, hd)
-    o = jnp.einsum("bnsh,nhd->bsd", o.astype(cdt), layer["wo"].astype(cdt))
-    x = x + o
-
-    h = _rms_norm(x, layer["mlp_norm"], config.norm_eps).astype(cdt)
-    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cdt))
-    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cdt))
-    h = jax.nn.silu(gate) * up
-    h = jnp.einsum("bsf,fd->bsd", h, layer["w_down"].astype(cdt))
-    return x + h, jnp.zeros((), jnp.float32)
+    return out_mlp_part(x, o, layer)
 
 
 def forward(
@@ -217,6 +237,13 @@ def forward(
     cos, sin = rope_tables(S, config.head_dim, config.rope_theta)
 
     remat_cfg = config.remat
+    policy_name = getattr(config, "remat_policy", None)
+    save_attn = remat_cfg and policy_name == "save_attn"
+    remat_policy = (
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if policy_name == "dots"
+        else None
+    )
 
     def make_block_fn(first_layer, attn, mb_idx=None, seq_streams=()):
         del first_layer, mb_idx  # no per-layer RNG (no dropout)
@@ -224,10 +251,10 @@ def forward(
 
         def block_fn(x, xs):
             layer, _idx = xs
-            return _block(x, layer, config, attn, cos_s, sin_s)
+            return _block(x, layer, config, attn, cos_s, sin_s, sub_remat=save_attn)
 
-        if remat_cfg:
-            block_fn = jax.checkpoint(block_fn, prevent_cse=False)
+        if remat_cfg and not save_attn:
+            block_fn = jax.checkpoint(block_fn, prevent_cse=False, policy=remat_policy)
         return block_fn
 
     from ray_tpu.models.stack import apply_stack

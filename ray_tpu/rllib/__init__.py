@@ -12,13 +12,15 @@ function over a device mesh — grads sync via the mesh's data axis inside XLA
 (vectorized gymnasium envs); only the learner touches accelerator devices.
 """
 
-from ray_tpu.rllib.core.rl_module import MLPModule, RLModule
+from ray_tpu.rllib.core.rl_module import MLPModule, RLModule, SquashedGaussianModule
 from ray_tpu.rllib.core.learner import JaxLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.env.env_runner import EnvRunner
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, Impala, ImpalaConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm",
@@ -26,10 +28,17 @@ __all__ = [
     "DQN",
     "DQNConfig",
     "EnvRunner",
+    "IMPALA",
+    "IMPALAConfig",
+    "Impala",
+    "ImpalaConfig",
     "JaxLearner",
     "LearnerGroup",
     "MLPModule",
     "PPO",
     "PPOConfig",
     "RLModule",
+    "SAC",
+    "SACConfig",
+    "SquashedGaussianModule",
 ]

@@ -97,6 +97,11 @@ class AlgorithmConfig:
 class Algorithm:
     """Base driver; subclasses implement make_loss() + training_step()."""
 
+    # Whether runners record the obs-sized final_obs buffer at truncation
+    # boundaries (replay/V-trace algorithms bootstrap through it; PPO uses
+    # runner-side bootstrap VALUES instead and opts out of the payload).
+    _record_final_obs = True
+
     def __init__(self, config: AlgorithmConfig):
         import gymnasium as gym
 
@@ -110,11 +115,13 @@ class Algorithm:
         probe = creator()
         obs_space, act_space = probe.observation_space, probe.action_space
         probe.close()
-        if not isinstance(act_space, gym.spaces.Discrete):
-            raise NotImplementedError("only Discrete action spaces so far")
-        self.module = self.make_module(
-            int(np.prod(obs_space.shape)), int(act_space.n)
-        )
+        obs_dim = int(np.prod(obs_space.shape))
+        if isinstance(act_space, gym.spaces.Discrete):
+            self.module = self.make_module(obs_dim, int(act_space.n))
+        elif isinstance(act_space, gym.spaces.Box):
+            self.module = self.make_module_continuous(obs_dim, act_space)
+        else:
+            raise NotImplementedError(f"unsupported action space {act_space}")
         self.learner_group = LearnerGroup(
             self.module,
             self.make_loss(),
@@ -122,6 +129,7 @@ class Algorithm:
             learning_rate=config.lr,
             optimizer=self.make_optimizer(),
             seed=config.seed,
+            extra_update_fn=self.make_extra_update(),
         )
         runner_cls = ray_tpu.remote(EnvRunner)
         self.env_runners: List[Any] = [
@@ -132,6 +140,7 @@ class Algorithm:
                 rollout_length=config.rollout_fragment_length,
                 seed=config.seed + 1000 * (i + 1),
                 gamma=config.gamma,
+                record_final_obs=self._record_final_obs,
             )
             for i in range(config.num_env_runners)
         ]
@@ -146,11 +155,23 @@ class Algorithm:
             obs_dim, num_actions, hiddens=tuple(self.config.model.get("hiddens", (64, 64)))
         )
 
+    def make_module_continuous(self, obs_dim: int, act_space):
+        """RLModule for Box action spaces (continuous-control algorithms
+        override, e.g. SAC's squashed-Gaussian actor + twin critics)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support continuous action spaces"
+        )
+
     def make_loss(self) -> Callable:
         raise NotImplementedError
 
     def make_optimizer(self):
         """Optional optax transform; None -> LearnerGroup's default adam(lr)."""
+        return None
+
+    def make_extra_update(self) -> Optional[Callable]:
+        """Optional pure (new_params, extra) -> new_extra applied inside the
+        jitted learner step (e.g. SAC's polyak target blend)."""
         return None
 
     def training_step(self) -> Dict[str, Any]:

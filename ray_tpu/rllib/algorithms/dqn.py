@@ -96,8 +96,9 @@ def make_dqn_loss(config: DQNConfig) -> Callable:
         y = batch["rewards"] + gamma * (1.0 - batch["terminateds"]) * tq
         y = jnp.asarray(y, jnp.float32)
         td = q_sa - y
-        # Truncated (time-limit) rows have a reset obs in next_obs: exclude
-        # them rather than bootstrap through the wrong state.
+        # loss_weight is all-ones when the runner recorded true final
+        # observations (truncated rows bootstrap through them); the legacy
+        # fallback in _transitions zero-weights truncated rows instead.
         weight = batch["loss_weight"]
         huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td, jnp.abs(td) - 0.5)
         total = jnp.sum(weight * huber) / jnp.maximum(jnp.sum(weight), 1.0)
@@ -197,12 +198,23 @@ class DQN(Algorithm):
     def _transitions(ro: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """(T, N) rollout buffers -> flat (s, a, r, s', terminated, weight)."""
         obs, dones, terms = ro["obs"], ro["dones"], ro["terminateds"]
-        T = obs.shape[0]
         next_obs = np.concatenate([obs[1:], ro["last_obs"][None]], axis=0)
         # SAME_STEP autoreset: the row after a done holds the reset obs, which
         # is the CORRECT s' only for rows that didn't end; terminated rows
-        # never use s', truncated rows are excluded via weight.
-        truncated = dones - terms
+        # never use s'. Truncated (time-limit) rows substitute the true final
+        # observation the runner recorded and keep full weight — the TD target
+        # bootstraps through the real state, nothing is discarded.
+        truncated = ro.get("truncateds")
+        final_obs = ro.get("final_obs")
+        if truncated is None or final_obs is None:
+            truncated = dones - terms
+            weight = 1.0 - truncated  # no final obs recorded: exclude rows
+        else:
+            mask = truncated.reshape(
+                truncated.shape + (1,) * (final_obs.ndim - truncated.ndim)
+            )
+            next_obs = np.where(mask > 0, final_obs, next_obs)
+            weight = np.ones_like(dones)
         flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
         return {
             "obs": flat(obs).astype(np.float32),
@@ -210,7 +222,7 @@ class DQN(Algorithm):
             "rewards": flat(ro["rewards"]).astype(np.float32),
             "next_obs": flat(next_obs).astype(np.float32),
             "terminateds": flat(terms).astype(np.float32),
-            "loss_weight": flat(1.0 - truncated).astype(np.float32),
+            "loss_weight": flat(weight).astype(np.float32),
         }
 
     # -------------------------------------------------------------- checkpoint

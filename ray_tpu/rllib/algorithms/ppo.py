@@ -155,6 +155,10 @@ def make_ppo_loss(config: PPOConfig) -> Callable:
 
 
 class PPO(Algorithm):
+    # PPO bootstraps truncations through runner-side values (bootstrap_values)
+    # and never reads final_obs: skip shipping the obs-sized buffer.
+    _record_final_obs = False
+
     def __init__(self, config: PPOConfig):
         super().__init__(config)
         self.kl_coeff = float(config.kl_coeff)

@@ -25,6 +25,7 @@ class JaxLearner:
         learning_rate: float = 3e-4,
         mesh=None,
         seed: int = 0,
+        extra_update_fn: Optional[Callable] = None,
     ):
         import jax
         import optax
@@ -42,6 +43,10 @@ class JaxLearner:
         # a jit argument with replicated sharding — never through the batch,
         # which shards over data and slices per remote learner.
         self.extra: Any = None
+        # Optional pure (new_params, extra) -> new_extra, applied INSIDE the
+        # jitted step (e.g. SAC's polyak target-network blend) — extra never
+        # round-trips to the host between updates.
+        self._extra_update_fn = extra_update_fn
         self._loss_wants_extra = len(inspect.signature(loss_fn).parameters) >= 4
         self._update = self._build_update()
 
@@ -51,6 +56,7 @@ class JaxLearner:
 
         module, loss_fn, optimizer = self.module, self._loss_fn, self.optimizer
         wants_extra = self._loss_wants_extra
+        extra_update_fn = self._extra_update_fn
 
         def step(params, opt_state, extra, batch):
             def loss_of(p):
@@ -64,7 +70,9 @@ class JaxLearner:
             aux = dict(aux)
             aux["total_loss"] = loss
             aux["grad_norm"] = optax.global_norm(grads)
-            return new_params, new_opt, aux
+            if extra_update_fn is not None:
+                extra = extra_update_fn(new_params, extra)
+            return new_params, new_opt, extra, aux
 
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -74,10 +82,10 @@ class JaxLearner:
             return jax.jit(
                 step,
                 in_shardings=(repl, repl, repl, data),
-                out_shardings=(repl, repl, repl),
-                donate_argnums=(0, 1),
+                out_shardings=(repl, repl, repl, repl),
+                donate_argnums=(0, 1, 2),
             )
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """One SGD step on a host batch; returns scalar metrics."""
@@ -88,7 +96,7 @@ class JaxLearner:
 
             sharding = NamedSharding(self.mesh, P("data"))
             batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
-        self.params, self.opt_state, aux = self._update(
+        self.params, self.opt_state, self.extra, aux = self._update(
             self.params, self.opt_state, self.extra, batch
         )
         return {k: float(v) for k, v in aux.items()}

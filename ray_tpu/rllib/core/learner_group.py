@@ -21,10 +21,15 @@ from ray_tpu.rllib.core.rl_module import RLModule
 class _RemoteLearner:
     """Actor wrapping one JaxLearner (one host / one chip set)."""
 
-    def __init__(self, module, loss_fn, learning_rate: float, seed: int, optimizer=None):
+    def __init__(self, module, loss_fn, learning_rate: float, seed: int,
+                 optimizer=None, extra_update_fn=None):
         self.learner = JaxLearner(
-            module, loss_fn, learning_rate=learning_rate, seed=seed, optimizer=optimizer
+            module, loss_fn, learning_rate=learning_rate, seed=seed,
+            optimizer=optimizer, extra_update_fn=extra_update_fn,
         )
+
+    def get_extra(self):
+        return self.learner.extra
 
     def update(self, batch):
         return self.learner.update(batch)
@@ -56,8 +61,10 @@ class LearnerGroup:
         mesh=None,
         optimizer=None,
         seed: int = 0,
+        extra_update_fn=None,
     ):
         self._num = num_learners
+        self._has_extra_update = extra_update_fn is not None
         if num_learners == 0:
             self._local = JaxLearner(
                 module,
@@ -66,6 +73,7 @@ class LearnerGroup:
                 mesh=mesh,
                 optimizer=optimizer,
                 seed=seed,
+                extra_update_fn=extra_update_fn,
             )
             self._remote: List = []
         else:
@@ -75,7 +83,7 @@ class LearnerGroup:
             cls = ray_tpu.remote(_RemoteLearner)
             self._remote = [
                 cls.options(num_cpus=1).remote(
-                    module, loss_fn, learning_rate, seed, optimizer
+                    module, loss_fn, learning_rate, seed, optimizer, extra_update_fn
                 )
                 for _ in range(num_learners)
             ]
@@ -105,6 +113,18 @@ class LearnerGroup:
 
         avg = jax.tree.map(lambda *xs: np.mean(np.stack(xs), axis=0), *weights)
         ray_tpu.get([lr.set_weights.remote(avg) for lr in self._remote])
+        if self._has_extra_update:
+            # extra evolves INSIDE each learner's jitted step (e.g. SAC's
+            # polyak targets blending toward that learner's pre-average
+            # shard weights): resync it the same way as the weights, or the
+            # per-learner copies drift apart round over round.
+            extras = ray_tpu.get([lr.get_extra.remote() for lr in self._remote])
+            if extras[0] is not None:
+                avg_extra = jax.tree.map(
+                    lambda *xs: np.mean(np.stack([np.asarray(x) for x in xs]), axis=0),
+                    *extras,
+                )
+                ray_tpu.get([lr.set_extra.remote(avg_extra) for lr in self._remote])
         out: Dict[str, float] = {}
         for k in metrics[0]:
             out[k] = float(np.mean([m[k] for m in metrics]))
@@ -126,6 +146,14 @@ class LearnerGroup:
         import ray_tpu
 
         return ray_tpu.get(self._remote[0].get_weights.remote())
+
+    def get_extra(self):
+        """Current replicated auxiliary state (post extra_update_fn blends)."""
+        if self._local is not None:
+            return self._local.extra
+        import ray_tpu
+
+        return ray_tpu.get(self._remote[0].get_extra.remote())
 
     def set_weights(self, w) -> None:
         if self._local is not None:

@@ -14,6 +14,39 @@ from typing import Any, Callable, Dict, Sequence, Tuple
 import numpy as np
 
 
+def mlp_init(key, sizes, final_scale: float = 1.0):
+    """He-scaled MLP tower init shared by every module class: list of
+    {"w", "b"} layer dicts; the last layer's weights scale by final_scale
+    (e.g. 0.01 for a near-uniform initial policy)."""
+    import jax
+    import jax.numpy as jnp
+
+    layers = []
+    for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / m)
+        if i == len(sizes) - 2:
+            scale = scale * final_scale
+        layers.append(
+            {
+                "w": jax.random.normal(sub, (m, n), jnp.float32) * scale,
+                "b": jnp.zeros((n,), jnp.float32),
+            }
+        )
+    return layers
+
+
+def mlp_forward(layers, x):
+    """Run an mlp_init tower: tanh between layers, linear final layer."""
+    import jax.numpy as jnp
+
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
 class RLModule:
     """Interface: subclasses define init(key) -> params and pure forwards."""
 
@@ -52,38 +85,20 @@ class QMLPModule(RLModule):
     weight here is read on the Q path (checkpoints, target copies, and weight
     syncs stay half the size of the two-tower policy module)."""
 
+    # Replay-trained: the runner skips logp/value/dist buffers entirely.
+    off_policy = True
+
     def __init__(self, obs_dim: int, num_actions: int, hiddens: Sequence[int] = (64, 64)):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
         self.hiddens = tuple(hiddens)
 
     def init(self, key):
-        import jax
-        import jax.numpy as jnp
-
-        sizes = (self.obs_dim, *self.hiddens, self.num_actions)
-        layers = []
-        for m, n in zip(sizes[:-1], sizes[1:]):
-            key, sub = jax.random.split(key)
-            scale = jnp.sqrt(2.0 / m)
-            layers.append(
-                {
-                    "w": jax.random.normal(sub, (m, n), jnp.float32) * scale,
-                    "b": jnp.zeros((n,), jnp.float32),
-                }
-            )
-        return {"q": layers}
+        return {"q": mlp_init(key, (self.obs_dim, *self.hiddens, self.num_actions))}
 
     def forward(self, params, obs):
-        import jax.numpy as jnp
-
-        x = obs
-        layers = params["q"]
-        for i, lyr in enumerate(layers):
-            x = x @ lyr["w"] + lyr["b"]
-            if i < len(layers) - 1:
-                x = jnp.tanh(x)
-        return x, x.max(axis=-1)
+        q = mlp_forward(params["q"], obs)
+        return q, q.max(axis=-1)
 
     def epsilon_greedy(self, params, obs, key, explore: bool, epsilon):
         import jax
@@ -113,39 +128,112 @@ class MLPModule(RLModule):
 
     def init(self, key):
         import jax
-        import jax.numpy as jnp
-
-        def tower(key, sizes):
-            layers = []
-            for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
-                key, sub = jax.random.split(key)
-                scale = jnp.sqrt(2.0 / m)
-                layers.append(
-                    {
-                        "w": jax.random.normal(sub, (m, n), jnp.float32) * scale,
-                        "b": jnp.zeros((n,), jnp.float32),
-                    }
-                )
-            return layers
 
         kp, kv = jax.random.split(key)
-        pi_sizes = (self.obs_dim, *self.hiddens, self.num_actions)
-        vf_sizes = (self.obs_dim, *self.hiddens, 1)
-        params = {"pi": tower(kp, pi_sizes), "vf": tower(kv, vf_sizes)}
-        # Near-zero policy head -> near-uniform initial policy (PPO-friendly).
-        params["pi"][-1]["w"] = params["pi"][-1]["w"] * 0.01
-        return params
+        return {
+            # Near-zero policy head -> near-uniform initial policy.
+            "pi": mlp_init(kp, (self.obs_dim, *self.hiddens, self.num_actions), final_scale=0.01),
+            "vf": mlp_init(kv, (self.obs_dim, *self.hiddens, 1)),
+        }
 
     def forward(self, params, obs):
+        logits = mlp_forward(params["pi"], obs)
+        value = mlp_forward(params["vf"], obs)[..., 0]
+        return logits, value
+
+
+class SquashedGaussianModule(RLModule):
+    """Continuous-control actor-critic: tanh-squashed Gaussian policy + twin
+    Q towers (SAC's module). Actions map to the Box bounds via an affine of
+    tanh(u); log-probs carry the tanh + affine Jacobian corrections.
+
+    Reference: `rllib/algorithms/sac/sac_torch_model.py` (policy net emitting
+    (mean, log_std), twin Q-nets over concat(obs, act)); here the whole thing
+    is one pytree {"pi", "q1", "q2", "log_alpha"} so JaxLearner can jit/grad
+    the combined SAC objective in a single SPMD step."""
+
+    off_policy = True
+    LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+    def __init__(self, obs_dim: int, act_low, act_high,
+                 hiddens: Sequence[int] = (256, 256)):
+        self.obs_dim = obs_dim
+        self.act_low = np.asarray(act_low, np.float32)
+        self.act_high = np.asarray(act_high, np.float32)
+        self.act_dim = int(self.act_low.size)
+        self.center = (self.act_high + self.act_low) / 2.0
+        self.scale = (self.act_high - self.act_low) / 2.0
+        self.hiddens = tuple(hiddens)
+
+    def init(self, key):
+        import jax
         import jax.numpy as jnp
 
-        def run(layers, x, final_linear):
-            for i, lyr in enumerate(layers):
-                x = x @ lyr["w"] + lyr["b"]
-                if i < len(layers) - 1 or not final_linear:
-                    x = jnp.tanh(x)
-            return x
+        kp, k1, k2 = jax.random.split(key, 3)
+        return {
+            "pi": mlp_init(kp, (self.obs_dim, *self.hiddens, 2 * self.act_dim)),
+            "q1": mlp_init(k1, (self.obs_dim + self.act_dim, *self.hiddens, 1)),
+            "q2": mlp_init(k2, (self.obs_dim + self.act_dim, *self.hiddens, 1)),
+            "log_alpha": jnp.zeros((), jnp.float32),
+        }
 
-        logits = run(params["pi"], obs, final_linear=True)
-        value = run(params["vf"], obs, final_linear=True)[..., 0]
-        return logits, value
+    # ------------------------------------------------------------ policy math
+    def dist_params(self, params, obs):
+        import jax.numpy as jnp
+
+        out = mlp_forward(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mean, log_std
+
+    def sample(self, params, obs, noise):
+        """Reparameterized squashed sample from pre-drawn standard normals.
+        Returns (action_env_scale, logp)."""
+        import jax.numpy as jnp
+
+        mean, log_std = self.dist_params(params, obs)
+        std = jnp.exp(log_std)
+        u = mean + std * noise
+        a_raw = jnp.tanh(u)
+        # N(u; mean, std) log-density, then tanh + affine Jacobians.
+        logp = jnp.sum(
+            -0.5 * jnp.square(noise) - log_std - 0.5 * jnp.log(2.0 * jnp.pi),
+            axis=-1,
+        )
+        logp = logp - jnp.sum(jnp.log(1.0 - jnp.square(a_raw) + 1e-6), axis=-1)
+        logp = logp - float(np.sum(np.log(self.scale)))
+        return self.center + self.scale * a_raw, logp
+
+    def q_values(self, q_params, obs, action_env):
+        """Q(s, a) for one tower; actions normalize back to (-1, 1) so tower
+        inputs stay O(1) regardless of the env's bounds."""
+        import jax.numpy as jnp
+
+        a = (action_env - self.center) / self.scale
+        x = jnp.concatenate([obs, a], axis=-1)
+        return mlp_forward(q_params, x)[..., 0]
+
+    # ----------------------------------------------------------- runner hooks
+    def forward(self, params, obs):
+        """(dist params, Q(s, mean action)) — value slot for diagnostics."""
+        import jax.numpy as jnp
+
+        mean, log_std = self.dist_params(params, obs)
+        a_env = self.center + self.scale * jnp.tanh(mean)
+        return jnp.concatenate([mean, log_std], axis=-1), self.q_values(
+            params["q1"], obs, a_env
+        )
+
+    def action_dist(self, params, obs, key, explore: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        mean, log_std = self.dist_params(params, obs)
+        if explore:
+            noise = jax.random.normal(key, mean.shape)
+        else:
+            noise = jnp.zeros_like(mean)
+        action, logp = self.sample(params, obs, noise)
+        dist = jnp.concatenate([mean, log_std], axis=-1)
+        value = self.q_values(params["q1"], obs, action)
+        return action, logp, value, dist

@@ -25,6 +25,7 @@ class EnvRunner:
         rollout_length: int = 128,
         seed: int = 0,
         gamma: float = 0.99,
+        record_final_obs: bool = True,
     ):
         import gymnasium as gym
         import jax
@@ -56,12 +57,26 @@ class EnvRunner:
         self.num_envs = num_envs
         self.rollout_length = rollout_length
         self.gamma = gamma
+        # Algorithms that bootstrap truncations via runner-side values (PPO)
+        # skip the obs-sized final_obs buffer entirely.
+        self.record_final_obs = record_final_obs
         self._key = jax.random.PRNGKey(seed)
         self._params = module.init(jax.random.PRNGKey(seed))
         self._obs, _ = self._envs.reset(seed=seed)
         self._episode_returns = np.zeros(num_envs)
         self._episode_lengths = np.zeros(num_envs, dtype=np.int64)
         self._completed: list = []
+        # Box action spaces (continuous control) sample float vectors; the
+        # rollout buffers size/type themselves off the space.
+        space = self._envs.single_action_space
+        self._continuous = isinstance(space, gym.spaces.Box)
+        self._act_shape = space.shape if self._continuous else ()
+        self._act_dtype = np.float32 if self._continuous else np.int64
+        # Replay-trained modules (Q-nets, SAC) never consume logp/value/dist
+        # buffers: skip filling and shipping them (and bootstrap forwards).
+        self._value_based = getattr(module, "off_policy", False) or hasattr(
+            module, "epsilon_greedy"
+        )
         if hasattr(module, "epsilon_greedy"):
             # Value-based modules (DQN): epsilon rides as a traced scalar so
             # exploration decay never retriggers compilation.
@@ -90,17 +105,28 @@ class EnvRunner:
         import jax
 
         T, N = self.rollout_length, self.num_envs
+        value_based = self._value_based
         obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
-        act_buf = np.zeros((T, N), np.int64)
-        logp_buf = np.zeros((T, N), np.float32)
-        val_buf = np.zeros((T, N), np.float32)
+        act_buf = np.zeros((T, N) + self._act_shape, self._act_dtype)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
         term_buf = np.zeros((T, N), np.float32)
-        # V(final_obs) where an episode hit its time limit: GAE bootstraps
-        # truncated episodes through this value (reference: compute_advantages
-        # bootstraps with vf(last_obs) at time-limit boundaries).
-        boot_buf = np.zeros((T, N), np.float32)
+        if not value_based:
+            logp_buf = np.zeros((T, N), np.float32)
+            val_buf = np.zeros((T, N), np.float32)
+            # V(final_obs) where an episode hit its time limit: GAE bootstraps
+            # truncated episodes through this value (reference:
+            # compute_advantages bootstraps with vf(last_obs) at time limits).
+            boot_buf = np.zeros((T, N), np.float32)
+        # True final observation at truncation boundaries (SAME_STEP autoreset
+        # replaces next_obs with the reset obs there); value-based algorithms
+        # bootstrap their TD targets through these rows.
+        final_obs_buf = (
+            np.zeros((T, N) + self._obs.shape[1:], np.float32)
+            if self.record_final_obs
+            else None
+        )
+        trunc_buf = np.zeros((T, N), np.float32)
         logits_buf: Optional[np.ndarray] = None
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
@@ -108,13 +134,14 @@ class EnvRunner:
                 self._params, self._obs.astype(np.float32), sub, explore
             )
             action = np.asarray(action)
-            if logits_buf is None:
-                logits_buf = np.zeros((T, N) + np.shape(logits)[1:], np.float32)
-            logits_buf[t] = np.asarray(logits)
+            if not value_based:
+                if logits_buf is None:
+                    logits_buf = np.zeros((T, N) + np.shape(logits)[1:], np.float32)
+                logits_buf[t] = np.asarray(logits)
+                logp_buf[t] = np.asarray(logp)
+                val_buf[t] = np.asarray(value)
             obs_buf[t] = self._obs
             act_buf[t] = action
-            logp_buf[t] = np.asarray(logp)
-            val_buf[t] = np.asarray(value)
             nxt, rew, term, trunc, infos = self._envs.step(action)
             done = np.logical_or(term, trunc)
             rew_buf[t] = rew
@@ -123,12 +150,16 @@ class EnvRunner:
             trunc_only = np.logical_and(trunc, np.logical_not(term))
             if trunc_only.any():
                 final_obs = self._final_observations(infos, nxt)
-                self._key, sub = jax.random.split(self._key)
-                _, _, fvals, _ = self._act(
-                    self._params, final_obs.astype(np.float32), sub, False
-                )
                 idx = np.nonzero(trunc_only)[0]
-                boot_buf[t, idx] = np.asarray(fvals, np.float32)[idx]
+                trunc_buf[t, idx] = 1.0
+                if final_obs_buf is not None:
+                    final_obs_buf[t, idx] = final_obs[idx].astype(np.float32)
+                if not value_based:
+                    self._key, sub = jax.random.split(self._key)
+                    _, _, fvals, _ = self._act(
+                        self._params, final_obs.astype(np.float32), sub, False
+                    )
+                    boot_buf[t, idx] = np.asarray(fvals, np.float32)[idx]
             self._episode_returns += rew
             self._episode_lengths += 1
             for i in np.nonzero(done)[0]:
@@ -138,26 +169,33 @@ class EnvRunner:
                 self._episode_returns[i] = 0.0
                 self._episode_lengths[i] = 0
             self._obs = nxt
-        # Bootstrap value for the final observation of each env.
-        self._key, sub = jax.random.split(self._key)
-        _, _, last_val, _ = self._act(
-            self._params, self._obs.astype(np.float32), sub, explore
-        )
-        return {
+        out = {
             "obs": obs_buf,
             "actions": act_buf,
-            "logp": logp_buf,
-            "behavior_logits": logits_buf,
-            "values": val_buf,
             "rewards": rew_buf,
             "dones": done_buf,
             "terminateds": term_buf,
-            "bootstrap_values": boot_buf,
-            "last_values": np.asarray(last_val, np.float32),
+            "truncateds": trunc_buf,
             # Final observations (value-based algorithms build next_obs by
             # shifting obs and closing the tail with these).
             "last_obs": self._obs.astype(np.float32),
         }
+        if final_obs_buf is not None:
+            out["final_obs"] = final_obs_buf
+        if not value_based:
+            # Bootstrap value for the final observation of each env.
+            self._key, sub = jax.random.split(self._key)
+            _, _, last_val, _ = self._act(
+                self._params, self._obs.astype(np.float32), sub, explore
+            )
+            out.update(
+                logp=logp_buf,
+                behavior_logits=logits_buf,
+                values=val_buf,
+                bootstrap_values=boot_buf,
+                last_values=np.asarray(last_val, np.float32),
+            )
+        return out
 
     def _final_observations(self, infos, nxt: np.ndarray) -> np.ndarray:
         """Per-env final observations for done envs (SAME_STEP autoreset puts

@@ -356,3 +356,191 @@ def test_dqn_multi_learner(ray_start_regular):
         assert "td_error_mean" in m  # learner updates actually ran
     finally:
         algo.stop()
+
+
+# --------------------------------------------------------------------- IMPALA
+def _impala_config():
+    from ray_tpu.rllib import IMPALAConfig
+
+    return (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=2, num_envs_per_runner=8, rollout_fragment_length=64
+        )
+        .training(lr=5e-4, gamma=0.99, entropy_coeff=0.01)
+    )
+
+
+def test_impala_cartpole_improves(ray_start_regular):
+    """V-trace actor-critic learns CartPole from (N, T)-structured batches."""
+    _imports()
+    algo = _impala_config().build()
+    try:
+        best = 0.0
+        for _ in range(40):
+            m = algo.train()
+            best = max(best, m.get("episode_return_mean", 0.0))
+            if best >= 60.0:
+                break
+        assert best >= 60.0, f"best return {best}"
+        assert "mean_rho" in m  # importance weights flowing
+    finally:
+        algo.stop()
+
+
+def test_vtrace_on_policy_reduces_to_n_step_return():
+    """With behavior == target policy (rho = c = 1) and no dones, vs_t equals
+    the n-step TD(lambda=1) return: sum gamma^k r + gamma^n V(last)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.impala import ImpalaConfig, make_impala_loss
+
+    class _ConstValueModule:
+        """V(s) = s[...,0]; uniform logits so target logp == behavior logp."""
+
+        def forward(self, params, obs):
+            B = obs.shape[:-1]
+            return jnp.zeros(B + (2,)), obs[..., 0]
+
+    cfg = ImpalaConfig()
+    cfg.gamma = 0.9
+    cfg.entropy_coeff = 0.0
+    cfg.vf_loss_coeff = 1.0
+    loss_fn = make_impala_loss(cfg)
+
+    N, T = 2, 4
+    rng = np.random.default_rng(0)
+    values = rng.standard_normal((N, T)).astype(np.float32)
+    last_v = rng.standard_normal((N,)).astype(np.float32)
+    rewards = rng.standard_normal((N, T)).astype(np.float32)
+    batch = {
+        "obs": values[..., None],
+        "actions": np.zeros((N, T), np.int64),
+        "logp": np.full((N, T), np.log(0.5), np.float32),  # = uniform over 2
+        "rewards": rewards,
+        "terminateds": np.zeros((N, T), np.float32),
+        "dones": np.zeros((N, T), np.float32),
+        "truncateds": np.zeros((N, T), np.float32),
+        "final_obs": np.zeros((N, T, 1), np.float32),
+        "last_obs": last_v[..., None],
+    }
+    module = _ConstValueModule()
+    _, aux = loss_fn(module, {}, batch)
+    # vf_loss = 0.5 mean (vs - V)^2; recompute vs by the n-step formula.
+    g = cfg.gamma
+    vs_manual = np.zeros((N, T), np.float32)
+    for t in range(T):
+        acc = np.zeros(N, np.float32)
+        for k in range(t, T):
+            acc += g ** (k - t) * rewards[:, k]
+        vs_manual[:, t] = acc + g ** (T - t) * last_v
+    expected_vf = 0.5 * np.mean((vs_manual - values) ** 2)
+    np.testing.assert_allclose(float(aux["vf_loss"]), expected_vf, rtol=1e-4)
+
+
+# ------------------------------------------------------------------------ SAC
+def _sac_config():
+    from ray_tpu.rllib import SACConfig
+
+    # ~1 learner update per env step (512 steps, 256 updates of batch 128) —
+    # the reference's training-intensity default; at a 0.1 ratio SAC is
+    # undertrained and Pendulum never lifts off the random floor.
+    cfg = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(
+            num_env_runners=2, num_envs_per_runner=4, rollout_fragment_length=32
+        )
+        .training(
+            lr=7e-4,
+            learning_starts=400,
+            train_batch_size=128,
+            updates_per_iteration=256,
+        )
+    )
+    cfg.model = {"hiddens": (64, 64)}
+    return cfg
+
+
+def test_sac_pendulum_improves(ray_start_regular):
+    """Continuous control end-to-end: squashed-Gaussian actions reach the env,
+    twin-critic/temperature loss runs, and returns move off the random floor
+    (Pendulum random policy sits near -1200..-1600; SAC should lift it)."""
+    _imports()
+    algo = _sac_config().build()
+    try:
+        best = -np.inf
+        m = {}
+        for _ in range(25):
+            m = algo.train()
+            ret = m.get("episode_return_mean")
+            if ret is not None:
+                best = max(best, ret)
+            if best > -400.0:
+                break
+        # Random policy sits near -1200; a learning SAC clears -400 quickly.
+        assert best > -400.0, best
+        assert m["alpha"] > 0.0
+    finally:
+        algo.stop()
+
+
+def test_sac_checkpoint_save_restore(ray_start_regular, tmp_path):
+    _imports()
+    algo = _sac_config().build()
+    try:
+        for _ in range(2):
+            algo.train()
+        path = algo.save(str(tmp_path / "ck"))
+        steps = algo.env_steps
+    finally:
+        algo.stop()
+    algo2 = _sac_config().build()
+    try:
+        algo2.restore(path)
+        assert algo2.env_steps == steps
+        algo2.train()
+    finally:
+        algo2.stop()
+
+
+def test_squashed_gaussian_logp_matches_numeric():
+    """logp from SquashedGaussianModule integrates to ~1 over the action
+    interval (change-of-variables correctness for tanh + affine scaling)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import SquashedGaussianModule
+
+    mod = SquashedGaussianModule(obs_dim=3, act_low=[-2.0], act_high=[2.0], hiddens=(8,))
+    params = mod.init(jax.random.PRNGKey(0))
+    obs = jnp.ones((1, 3))
+    # Monte-Carlo check: E_noise[1] == 1 trivially; instead verify the density
+    # via importance identity E_noise[exp(-logp) * p_grid] on a fine grid.
+    mean, log_std = mod.dist_params(params, obs)
+    # Evaluate density on a grid by transforming grid points back through
+    # atanh and comparing with the analytic normal density.
+    a_grid = np.linspace(-1.999, 1.999, 20001, dtype=np.float64)
+    a_raw = a_grid / 2.0
+    u = np.arctanh(np.clip(a_raw, -1 + 1e-12, 1 - 1e-12))
+    m, s = float(mean[0, 0]), float(np.exp(log_std[0, 0]))
+    # p(a) = N(u; m, s) * |du/da_raw| * |da_raw/da|
+    pdf_u = np.exp(-0.5 * ((u - m) / s) ** 2) / (s * np.sqrt(2 * np.pi))
+    p_a = pdf_u / (1.0 - a_raw**2) / 2.0
+    # Grid stops short of the open interval ends, where the squashed density
+    # concentrates: a ~0.5% truncation deficit is expected.
+    integral = np.trapezoid(p_a, a_grid)
+    assert abs(integral - 1.0) < 1e-2, integral
+    # And the module's logp agrees with the analytic density at sampled points.
+    noise = jnp.asarray([[0.3]], jnp.float32)
+    act, logp = mod.sample(params, obs, noise)
+    u_s = m + s * 0.3
+    a_raw_s = np.tanh(u_s)
+    pdf = (
+        np.exp(-0.5 * 0.3**2) / (s * np.sqrt(2 * np.pi))
+        / (1.0 - a_raw_s**2 + 1e-6)
+        / 2.0
+    )
+    np.testing.assert_allclose(float(logp[0]), np.log(pdf), atol=1e-3)
