@@ -14,6 +14,7 @@ with a sliding prefetch window; `split` feeds per-host Train ingest
 
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.read_api import (
+    from_arrow,
     from_items,
     from_numpy,
     from_pandas,
@@ -30,6 +31,7 @@ Datastream = Dataset  # the reference's short-lived rename (`dataset.py:169`)
 __all__ = [
     "Dataset",
     "Datastream",
+    "from_arrow",
     "from_items",
     "from_numpy",
     "from_pandas",

@@ -180,6 +180,23 @@ def _groupby_agg(key: str, aggs: List[Tuple[str, str, str]], *pieces: Block) -> 
     return {k: np.asarray(v) for k, v in out.items()}
 
 
+def _write_block(block: Block, path: str, fmt: str, kwargs: dict) -> Optional[str]:
+    acc = BlockAccessor(block)
+    if not acc.num_rows():
+        return None
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(acc.to_arrow(), path, **kwargs)
+    elif fmt == "csv":
+        acc.to_pandas().to_csv(path, index=False, **kwargs)
+    elif fmt == "json":
+        acc.to_pandas().to_json(path, orient="records", lines=True, **kwargs)
+    else:
+        raise ValueError(f"unknown write format {fmt}")
+    return path
+
+
 def _zip_blocks(a: Block, b: Block) -> Block:
     out = dict(a)
     for k, v in b.items():
@@ -524,6 +541,112 @@ class Dataset:
             merged = BlockAccessor.concat(carry)
             if BlockAccessor(merged).num_rows():
                 yield BlockAccessor(merged).to_batch(batch_format)
+
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        dtypes=None,
+        device: Optional[str] = None,
+        prefetch_blocks: int = 2,
+        drop_last: bool = False,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (reference: `iterator.py iter_torch_batches`).
+        Numeric columns convert via torch.as_tensor (zero-copy from numpy where
+        possible); object columns pass through unconverted."""
+        import torch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size,
+            batch_format="numpy",
+            prefetch_blocks=prefetch_blocks,
+            drop_last=drop_last,
+        ):
+            out = {}
+            for k, v in batch.items():
+                if v.dtype == object:
+                    out[k] = v
+                    continue
+                t = torch.as_tensor(v)
+                if dtypes is not None:
+                    # A dict maps column -> dtype; unlisted columns keep the
+                    # inferred dtype.
+                    want = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+                    if want is not None:
+                        t = t.to(want)
+                if device is not None:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
+    def random_split(
+        self, fractions: List[float], *, seed: Optional[int] = None
+    ) -> List["Dataset"]:
+        """Split rows randomly by fractions (reference: `dataset.py
+        random_split`). Fractions must sum to <= 1; remainder rows go to the
+        last split when they sum to exactly 1."""
+        if not fractions or any(f <= 0 for f in fractions):
+            raise ValueError("fractions must be positive")
+        if sum(fractions) > 1.0 + 1e-9:
+            raise ValueError("fractions sum to > 1")
+        shuffled = self.random_shuffle(seed=seed)
+        total = shuffled.count()
+        counts = [int(total * f) for f in fractions]
+        if abs(sum(fractions) - 1.0) < 1e-9:
+            counts[-1] = total - sum(counts[:-1])
+        refs = shuffled._execute()
+        sizes = ray_tpu.get([_remote(_num_rows).remote(r) for r in refs])
+        slice_remote = _remote(_slice_block)
+        splits: List[Dataset] = []
+        ref_i, offset = 0, 0
+        for want in counts:
+            parts: List[Any] = []
+            while want > 0 and ref_i < len(refs):
+                avail = sizes[ref_i] - offset
+                take = min(avail, want)
+                if take == sizes[ref_i]:
+                    parts.append(refs[ref_i])
+                elif take > 0:
+                    parts.append(slice_remote.remote(refs[ref_i], offset, offset + take))
+                want -= take
+                offset += take
+                if offset >= sizes[ref_i]:
+                    ref_i += 1
+                    offset = 0
+            splits.append(Dataset(parts))
+        return splits
+
+    # ------------------------------------------------------------------ writes
+    def _write_files(self, path: str, fmt: str, **kwargs) -> List[str]:
+        """One output file per block: path/part-00000.<ext> ... (reference:
+        `write_parquet/write_csv/write_json` — task-parallel file writes)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        refs = self._execute()
+        w = _remote(_write_block)
+        outs = [
+            w.remote(r, os.path.join(path, f"part-{i:05d}.{fmt}"), fmt, kwargs)
+            for i, r in enumerate(refs)
+        ]
+        return [p for p in ray_tpu.get(outs) if p is not None]
+
+    def write_parquet(self, path: str, **kwargs) -> List[str]:
+        return self._write_files(path, "parquet", **kwargs)
+
+    def write_csv(self, path: str, **kwargs) -> List[str]:
+        return self._write_files(path, "csv", **kwargs)
+
+    def write_json(self, path: str, **kwargs) -> List[str]:
+        return self._write_files(path, "json", **kwargs)
+
+    def to_arrow(self) -> List[Any]:
+        """One pyarrow Table per block."""
+        return [
+            BlockAccessor(b).to_arrow()
+            for b in ray_tpu.get(self._execute())
+            if BlockAccessor(b).num_rows()
+        ]
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for batch in self.iter_batches(batch_size=None):

@@ -131,6 +131,13 @@ def from_pandas(dfs: Union[Any, List[Any]]) -> Dataset:
     return Dataset([ray_tpu.put(BlockAccessor.from_pandas(df)) for df in dfs])
 
 
+def from_arrow(tables: Union[Any, List[Any]]) -> Dataset:
+    """One block per pyarrow Table (reference: `read_api.py from_arrow`)."""
+    if not isinstance(tables, list):
+        tables = [tables]
+    return Dataset([ray_tpu.put(BlockAccessor.from_arrow(t)) for t in tables])
+
+
 def _file_reader(files, parallelism, task_fn, payload) -> Dataset:
     parallelism = min(_auto_parallelism(parallelism, len(files)), len(files))
     rd = _remote(task_fn)

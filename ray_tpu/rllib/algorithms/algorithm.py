@@ -101,6 +101,11 @@ class Algorithm:
     # boundaries (replay/V-trace algorithms bootstrap through it; PPO uses
     # runner-side bootstrap VALUES instead and opts out of the payload).
     _record_final_obs = True
+    # Whether runners record value/dist buffers (values, behavior_logits,
+    # bootstrap_values, last_values). IMPALA recomputes values under current
+    # params inside its loss and opts out; logp is always recorded for
+    # policy-gradient modules.
+    _record_value_extras = True
 
     def __init__(self, config: AlgorithmConfig):
         import gymnasium as gym
@@ -141,6 +146,7 @@ class Algorithm:
                 seed=config.seed + 1000 * (i + 1),
                 gamma=config.gamma,
                 record_final_obs=self._record_final_obs,
+                record_value_extras=self._record_value_extras,
             )
             for i in range(config.num_env_runners)
         ]
@@ -176,6 +182,25 @@ class Algorithm:
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
+
+    def collect_episode_metrics(self, out: Dict[str, Any]) -> Dict[str, Any]:
+        """Fetch per-runner episode stats and fold the episode-weighted means
+        into `out` (shared by every algorithm's training_step)."""
+        import ray_tpu
+
+        stats = ray_tpu.get([r.episode_stats.remote() for r in self.env_runners])
+        episodes = [s for s in stats if s.get("episodes", 0) > 0]
+        if episodes:
+            weights = [s["episodes"] for s in episodes]
+            out["episode_return_mean"] = float(
+                np.average([s["episode_return_mean"] for s in episodes], weights=weights)
+            )
+            if all("episode_len_mean" in s for s in episodes):
+                out["episode_len_mean"] = float(
+                    np.average([s["episode_len_mean"] for s in episodes], weights=weights)
+                )
+            out["episodes_this_iter"] = int(sum(weights))
+        return out
 
     def train(self) -> Dict[str, Any]:
         t0 = time.time()

@@ -127,6 +127,10 @@ def make_impala_loss(config: ImpalaConfig) -> Callable:
 
 
 class Impala(Algorithm):
+    # The loss recomputes values/bootstraps under CURRENT params (V-trace):
+    # runner-side value evaluations and dist buffers would be dead weight.
+    _record_value_extras = False
+
     def make_loss(self) -> Callable:
         return make_impala_loss(self.config)
 
@@ -142,7 +146,6 @@ class Impala(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         import ray_tpu
 
-        cfg = self.config
         weights = self.learner_group.get_weights()
         ray_tpu.get([r.set_weights.remote(weights) for r in self.env_runners])
         rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
@@ -164,18 +167,7 @@ class Impala(Algorithm):
         batch["last_obs"] = np.concatenate([ro["last_obs"] for ro in rollouts], axis=0)
         out = dict(self.learner_group.update(batch))
         out["num_env_steps_sampled"] = int(batch["rewards"].size)
-
-        stats = ray_tpu.get([r.episode_stats.remote() for r in self.env_runners])
-        episodes = [s for s in stats if s.get("episodes", 0) > 0]
-        if episodes:
-            out["episode_return_mean"] = float(
-                np.average(
-                    [s["episode_return_mean"] for s in episodes],
-                    weights=[s["episodes"] for s in episodes],
-                )
-            )
-            out["episodes_this_iter"] = int(sum(s["episodes"] for s in episodes))
-        return out
+        return self.collect_episode_metrics(out)
 
 
 IMPALA = Impala

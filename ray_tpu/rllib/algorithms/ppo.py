@@ -240,24 +240,7 @@ class PPO(Algorithm):
             self.kl_coeff *= 0.5
         out["kl_coeff"] = self.kl_coeff
         out["num_env_steps_sampled"] = B
-        # 6. Episode stats across runners.
-        stats = ray_tpu.get([r.episode_stats.remote() for r in self.env_runners])
-        episodes = [s for s in stats if s.get("episodes", 0) > 0]
-        if episodes:
-            out["episode_return_mean"] = float(
-                np.average(
-                    [s["episode_return_mean"] for s in episodes],
-                    weights=[s["episodes"] for s in episodes],
-                )
-            )
-            out["episode_len_mean"] = float(
-                np.average(
-                    [s["episode_len_mean"] for s in episodes],
-                    weights=[s["episodes"] for s in episodes],
-                )
-            )
-            out["episodes_this_iter"] = int(sum(s["episodes"] for s in episodes))
-        return out
+        return self.collect_episode_metrics(out)
 
     # -------------------------------------------------------------- checkpoint
     def _extra_state(self) -> Dict[str, Any]:

@@ -181,17 +181,7 @@ class SAC(Algorithm):
             out.update(
                 {k: float(np.mean([m[k] for m in metrics_acc])) for k in metrics_acc[0]}
             )
-        stats = ray_tpu.get([r.episode_stats.remote() for r in self.env_runners])
-        episodes = [s for s in stats if s.get("episodes", 0) > 0]
-        if episodes:
-            out["episode_return_mean"] = float(
-                np.average(
-                    [s["episode_return_mean"] for s in episodes],
-                    weights=[s["episodes"] for s in episodes],
-                )
-            )
-            out["episodes_this_iter"] = int(sum(s["episodes"] for s in episodes))
-        return out
+        return self.collect_episode_metrics(out)
 
     # -------------------------------------------------------------- checkpoint
     def _extra_state(self) -> Dict[str, Any]:

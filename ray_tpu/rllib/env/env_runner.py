@@ -26,6 +26,7 @@ class EnvRunner:
         seed: int = 0,
         gamma: float = 0.99,
         record_final_obs: bool = True,
+        record_value_extras: bool = True,
     ):
         import gymnasium as gym
         import jax
@@ -60,6 +61,9 @@ class EnvRunner:
         # Algorithms that bootstrap truncations via runner-side values (PPO)
         # skip the obs-sized final_obs buffer entirely.
         self.record_final_obs = record_final_obs
+        # Algorithms whose loss recomputes values under current params
+        # (IMPALA/V-trace) skip value/dist buffers and bootstrap forwards.
+        self.record_value_extras = record_value_extras
         self._key = jax.random.PRNGKey(seed)
         self._params = module.init(jax.random.PRNGKey(seed))
         self._obs, _ = self._envs.reset(seed=seed)
@@ -106,13 +110,16 @@ class EnvRunner:
 
         T, N = self.rollout_length, self.num_envs
         value_based = self._value_based
+        need_logp = not value_based
+        need_values = not value_based and self.record_value_extras
         obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
         act_buf = np.zeros((T, N) + self._act_shape, self._act_dtype)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
         term_buf = np.zeros((T, N), np.float32)
-        if not value_based:
+        if need_logp:
             logp_buf = np.zeros((T, N), np.float32)
+        if need_values:
             val_buf = np.zeros((T, N), np.float32)
             # V(final_obs) where an episode hit its time limit: GAE bootstraps
             # truncated episodes through this value (reference:
@@ -134,11 +141,12 @@ class EnvRunner:
                 self._params, self._obs.astype(np.float32), sub, explore
             )
             action = np.asarray(action)
-            if not value_based:
+            if need_logp:
+                logp_buf[t] = np.asarray(logp)
+            if need_values:
                 if logits_buf is None:
                     logits_buf = np.zeros((T, N) + np.shape(logits)[1:], np.float32)
                 logits_buf[t] = np.asarray(logits)
-                logp_buf[t] = np.asarray(logp)
                 val_buf[t] = np.asarray(value)
             obs_buf[t] = self._obs
             act_buf[t] = action
@@ -154,7 +162,7 @@ class EnvRunner:
                 trunc_buf[t, idx] = 1.0
                 if final_obs_buf is not None:
                     final_obs_buf[t, idx] = final_obs[idx].astype(np.float32)
-                if not value_based:
+                if need_values:
                     self._key, sub = jax.random.split(self._key)
                     _, _, fvals, _ = self._act(
                         self._params, final_obs.astype(np.float32), sub, False
@@ -182,14 +190,15 @@ class EnvRunner:
         }
         if final_obs_buf is not None:
             out["final_obs"] = final_obs_buf
-        if not value_based:
+        if need_logp:
+            out["logp"] = logp_buf
+        if need_values:
             # Bootstrap value for the final observation of each env.
             self._key, sub = jax.random.split(self._key)
             _, _, last_val, _ = self._act(
                 self._params, self._obs.astype(np.float32), sub, explore
             )
             out.update(
-                logp=logp_buf,
                 behavior_logits=logits_buf,
                 values=val_buf,
                 bootstrap_values=boot_buf,

@@ -11,6 +11,10 @@ SPMD program via `jax.distributed.initialize` (the seam where the reference
 calls `dist.init_process_group`, `train/torch/config.py:113`), and
 `ScalingConfig.mesh` becomes a global `jax.sharding.Mesh` whose collectives
 ride ICI inside the user's jitted step.
+
+`ray_tpu.train.torch` provides `TorchTrainer`/`TorchConfig` (gloo process
+group over the same gang) for the reference's torch-parity surface — CPU DDP
+workloads port over unchanged.
 """
 
 from ray_tpu.air.config import (  # re-exported for parity convenience

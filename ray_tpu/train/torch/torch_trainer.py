@@ -1,0 +1,61 @@
+"""TorchTrainer: DataParallelTrainer with the torch.distributed (gloo) backend.
+
+Reference: `python/ray/train/torch/torch_trainer.py` (`TorchTrainer`). The
+train loop uses `prepare_model` to wrap its model in DDP; gradients sync over
+gloo between the gang's worker actors.
+
+Example:
+
+    def train_loop(config):
+        model = prepare_model(Net())
+        opt = torch.optim.SGD(model.parameters(), lr=1e-2)
+        for epoch in range(config["epochs"]):
+            for x, y in loader:
+                opt.zero_grad(); loss = F.mse_loss(model(x), y)
+                loss.backward(); opt.step()
+            session.report({"loss": float(loss)})
+
+    TorchTrainer(train_loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.torch.config import TorchConfig
+
+
+class TorchTrainer(DataParallelTrainer):
+    _default_backend_config = TorchConfig
+
+
+def prepare_model(model):
+    """Wrap a torch.nn.Module for the gang: DDP when a process group is up
+    (reference: `train/torch/train_loop_utils.py prepare_model` — minus the
+    CUDA device moves, which do not exist on this build)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Give a DataLoader a DistributedSampler over the gang (reference:
+    `train_loop_utils.py prepare_data_loader`)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1):
+        return loader
+    if isinstance(loader.sampler, DistributedSampler):
+        return loader
+    return DataLoader(
+        loader.dataset,
+        batch_size=loader.batch_size,
+        sampler=DistributedSampler(loader.dataset),
+        num_workers=loader.num_workers,
+        collate_fn=loader.collate_fn,
+        drop_last=loader.drop_last,
+    )

@@ -38,9 +38,18 @@ class TrialRunner:
         resources_per_trial: Optional[Dict[str, float]] = None,
         stop: Optional[Dict[str, float]] = None,
         experiment_name: str = "",
+        searcher=None,
+        num_samples: int = 0,
+        trial_factory=None,
     ):
         self._train_fn = train_fn
         self.trials = trials
+        # Adaptive mode: `searcher.suggest()` creates trials as capacity
+        # frees (up to num_samples), so later configs condition on earlier
+        # results (the reference's SearchGenerator behavior).
+        self._searcher = searcher
+        self._num_samples = num_samples
+        self._trial_factory = trial_factory
         self._scheduler = scheduler or FIFOScheduler()
         self._max_concurrent = max_concurrent or 8
         self._resources = dict(resources_per_trial or {"CPU": 1.0})
@@ -95,11 +104,38 @@ class TrialRunner:
                 del self._refs[ref]
 
     # -------------------------------------------------------------------- run
+    def _suggest_more(self) -> None:
+        while (
+            self._searcher is not None
+            and len(self.trials) < self._num_samples
+            and len(self._actors) < self._max_concurrent
+        ):
+            index = len(self.trials)
+            trial = self._trial_factory(index)
+            cfg = self._searcher.suggest(trial.trial_id)
+            if cfg is None:
+                self._num_samples = len(self.trials)
+                return
+            trial.config = dict(cfg)
+            self.trials.append(trial)
+            self._scheduler.on_trial_add(self, trial)
+            self._launch(trial)
+
+    def _complete(self, trial: Trial, error: bool = False) -> None:
+        self._scheduler.on_trial_complete(self, trial)
+        if self._searcher is not None:
+            self._searcher.on_trial_complete(
+                trial.trial_id, trial.last_result, error=error
+            )
+
     def run(self) -> None:
         pending = [t for t in self.trials if t.status == trial_mod.PENDING]
-        while pending or self._refs:
+        while pending or self._refs or (
+            self._searcher is not None and len(self.trials) < self._num_samples
+        ):
             while pending and len(self._actors) < self._max_concurrent:
                 self._launch(pending.pop(0))
+            self._suggest_more()
             if not self._refs:
                 continue
             ready, _ = ray_tpu.wait(
@@ -113,17 +149,17 @@ class TrialRunner:
                     trial.status = trial_mod.ERROR
                     trial.error = str(e)
                     self._teardown(trial)
-                    self._scheduler.on_trial_complete(self, trial)
+                    self._complete(trial, error=True)
                     continue
                 if tr.type == ERROR:
                     trial.status = trial_mod.ERROR
                     trial.error = tr.error
                     self._teardown(trial)
-                    self._scheduler.on_trial_complete(self, trial)
+                    self._complete(trial, error=True)
                 elif tr.type == DONE:
                     trial.status = trial_mod.TERMINATED
                     self._teardown(trial)
-                    self._scheduler.on_trial_complete(self, trial)
+                    self._complete(trial)
                 else:  # REPORT
                     trial.num_results += 1
                     metrics = dict(tr.metrics or {})
@@ -137,10 +173,12 @@ class TrialRunner:
                         decision = STOP
                     else:
                         decision = self._scheduler.on_trial_result(self, trial, metrics)
+                    if self._searcher is not None:
+                        self._searcher.on_trial_result(trial.trial_id, metrics)
                     if decision == STOP:
                         trial.status = trial_mod.TERMINATED
                         self._teardown(trial)
-                        self._scheduler.on_trial_complete(self, trial)
+                        self._complete(trial)
                     elif decision == RESTART:
                         trial.restarts += 1
                         self._teardown(trial)

@@ -3,6 +3,7 @@ from ray_tpu.tune.schedulers.trial_scheduler import (
     TrialScheduler,
 )
 from ray_tpu.tune.schedulers.async_hyperband import AsyncHyperBandScheduler
+from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
 from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
 
 ASHAScheduler = AsyncHyperBandScheduler
@@ -11,6 +12,7 @@ __all__ = [
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
     "FIFOScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining",
     "TrialScheduler",
 ]

@@ -1,4 +1,6 @@
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import RandomSearcher, Searcher
+from ray_tpu.tune.search.tpe import TPESearcher
 from ray_tpu.tune.search.sample import (
     Categorical,
     Domain,
@@ -19,6 +21,9 @@ from ray_tpu.tune.search.sample import (
 
 __all__ = [
     "BasicVariantGenerator",
+    "RandomSearcher",
+    "Searcher",
+    "TPESearcher",
     "Categorical",
     "Domain",
     "Float",

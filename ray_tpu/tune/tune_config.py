@@ -17,6 +17,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[Any] = None  # TrialScheduler
+    # Adaptive search algorithm (Searcher, e.g. TPESearcher); None = the
+    # up-front BasicVariantGenerator expansion.
+    search_alg: Optional[Any] = None
     search_seed: int = 0
     resources_per_trial: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
 

@@ -57,16 +57,26 @@ class Tuner:
         experiment_dir = os.path.join(os.path.expanduser(base), name)
         os.makedirs(experiment_dir, exist_ok=True)
 
-        gen = BasicVariantGenerator(seed=self.tune_config.search_seed)
-        configs = list(
-            gen.generate(self._param_space, self.tune_config.num_samples)
-        )
-        if not configs:
-            configs = [{}]
-        trials = [
-            Trial(cfg, experiment_dir, i, experiment_name=name)
-            for i, cfg in enumerate(configs)
-        ]
+        searcher = self.tune_config.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(
+                self.tune_config.metric,
+                self.tune_config.mode,
+                self._param_space,
+                seed=self.tune_config.search_seed,
+            )
+            trials = []
+        else:
+            gen = BasicVariantGenerator(seed=self.tune_config.search_seed)
+            configs = list(
+                gen.generate(self._param_space, self.tune_config.num_samples)
+            )
+            if not configs:
+                configs = [{}]
+            trials = [
+                Trial(cfg, experiment_dir, i, experiment_name=name)
+                for i, cfg in enumerate(configs)
+            ]
 
         scheduler = self.tune_config.scheduler
         if scheduler is not None and hasattr(scheduler, "set_objective"):
@@ -87,6 +97,9 @@ class Tuner:
             resources_per_trial=self.tune_config.resources_per_trial,
             stop=self.run_config.stop,
             experiment_name=name,
+            searcher=searcher,
+            num_samples=self.tune_config.num_samples if searcher is not None else 0,
+            trial_factory=lambda i: Trial({}, experiment_dir, i, experiment_name=name),
         )
         runner.run()
         return ResultGrid(

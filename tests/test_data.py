@@ -224,3 +224,67 @@ def test_map_batches_actors_after_fused_ops(ray_ctx):
     )
     values = sorted(r["value"] for r in ds.take_all())
     assert values == sorted(v for v in ((i + 1) * 2 for i in range(20)) if v > 10)
+
+
+def test_write_read_roundtrip_all_formats(ray_ctx, tmp_path):
+    """write_parquet/csv/json produce per-block part files that read back to
+    the same rows (reference: task-parallel write_* + read_* pairing)."""
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_items(
+        [{"id": i, "name": f"row{i}"} for i in range(30)]
+    ).repartition(3)
+    for fmt, reader in (
+        ("parquet", rdata.read_parquet),
+        ("csv", rdata.read_csv),
+        ("json", rdata.read_json),
+    ):
+        out = str(tmp_path / fmt)
+        files = getattr(ds, f"write_{fmt}")(out)
+        assert len(files) == 3 and all(f.endswith(fmt) for f in files)
+        back = reader(out)
+        rows = sorted(back.take_all(), key=lambda r: r["id"])
+        assert [int(r["id"]) for r in rows] == list(range(30))
+        assert str(rows[7]["name"]) == "row7"
+
+
+def test_from_arrow_to_arrow(ray_ctx):
+    import pyarrow as pa
+
+    from ray_tpu import data as rdata
+
+    t = pa.table({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    ds = rdata.from_arrow(t)
+    assert ds.count() == 3
+    tables = ds.to_arrow()
+    assert sum(tb.num_rows for tb in tables) == 3
+    assert set(tables[0].column_names) == {"x", "y"}
+
+
+def test_random_split_fractions(ray_ctx):
+    from ray_tpu import data as rdata
+
+    ds = rdata.range(100)
+    a, b, c = ds.random_split([0.6, 0.2, 0.2], seed=7)
+    na, nb, nc = a.count(), b.count(), c.count()
+    assert na + nb + nc == 100
+    assert na == 60 and nb == 20 and nc == 20
+    # Disjoint and complete.
+    ids = sorted(
+        int(r["id"]) for split in (a, b, c) for r in split.take_all()
+    )
+    assert ids == list(range(100))
+
+
+def test_iter_torch_batches(ray_ctx):
+    import torch
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.range(10)
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    assert torch.cat([b["id"] for b in batches]).tolist() == list(range(10))
+    # dtype override applies
+    b0 = next(iter(ds.iter_torch_batches(batch_size=None, dtypes=torch.float32)))
+    assert b0["id"].dtype == torch.float32
