@@ -44,6 +44,14 @@ class Config:
     # Native arena size per node; 0 = same as object_store_memory. Objects
     # that don't fit the arena overflow to per-object file segments.
     object_arena_bytes: int = 0
+    # When a put would exceed object_store_memory, relocate the just-written
+    # (not yet visible) object to the disk spill directory instead of raising —
+    # the analogue of plasma's fallback allocations to /tmp
+    # (`object_manager/plasma/plasma_allocator.cc` fallback path). Disable to
+    # get hard ObjectStoreFullError behavior.
+    object_spilling: bool = True
+    # Disk directory for spilled objects; "" = <tmpdir>/<session>_spill.
+    object_spill_dir: str = ""
     # Testing hook: treat every segment sealed on another node as remote even if
     # its path happens to be readable (single-machine multi-daemon clusters share
     # a filesystem), so the inter-node pull path is exercised.

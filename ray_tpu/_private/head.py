@@ -133,7 +133,7 @@ def main() -> None:
             gcs.save_to(ns.persist)
         except OSError:
             pass
-    scheduler.stop()
+    scheduler.stop()  # also removes the spill dir
     shutil.rmtree(session_dir, ignore_errors=True)
     sys.exit(0)
 

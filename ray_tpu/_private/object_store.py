@@ -66,6 +66,9 @@ class ObjectMeta:
     # pinned while this object lives (reference: contained-object tracking,
     # `core_worker/reference_count.h`).
     contained_ids: Optional[List[bytes]] = None
+    # True when the bytes were relocated to the disk spill directory (plasma's
+    # fallback-allocation analogue): excluded from shm capacity accounting.
+    spilled: bool = False
 
 
 class SharedSegment:

@@ -503,6 +503,11 @@ class Scheduler:
         self._wake()
         if self._thread:
             self._thread.join(timeout=5)
+        # Spilled payloads live outside the session dir (possibly a
+        # user-configured path): remove them with the session.
+        import shutil
+
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
 
     def call(self, method: str, payload: Any) -> concurrent.futures.Future:
         """Thread-safe entry for driver API threads. Fails fast once the
@@ -1021,7 +1026,7 @@ class Scheduler:
             # accounting before the new one takes over.
             self._retire_meta_accounting(old)
         self.object_table[key] = meta
-        if meta.segment and meta.node_id and meta.owns_payload:
+        if meta.segment and meta.node_id and meta.owns_payload and not meta.spilled:
             nid = NodeID(meta.node_id)
             self.node_usage[nid] = self.node_usage.get(nid, 0) + meta.size
         if meta.contained_ids:
@@ -1087,7 +1092,7 @@ class Scheduler:
 
     def _retire_meta_accounting(self, meta: ObjectMeta):
         key = meta.object_id.binary()
-        if meta.segment and meta.node_id and meta.owns_payload:
+        if meta.segment and meta.node_id and meta.owns_payload and not meta.spilled:
             nid = NodeID(meta.node_id)
             self.node_usage[nid] = max(0, self.node_usage.get(nid, 0) - meta.size)
         for child in self.contained_pins.pop(key, []):
@@ -1151,6 +1156,61 @@ class Scheduler:
             )
         return None
 
+    @property
+    def _spill_dir(self) -> str:
+        d = self.config.object_spill_dir
+        if not d:
+            import tempfile
+
+            d = os.path.join(
+                tempfile.gettempdir(),
+                os.path.basename(self.session_dir.rstrip("/")) + "_spill",
+            )
+        return d
+
+    def _try_spill_new(self, meta: ObjectMeta) -> bool:
+        """Relocate a just-written object to the disk spill dir (plasma's
+        fallback-allocation analogue, `plasma_allocator.cc` fallback path).
+
+        ONLY safe pre-seal: the meta has not been published, so no reader can
+        hold the old location — readers always fetch current metas from the
+        object table (get_metas / dispatch-time arg resolution). Mutates the
+        meta in place to point at the spill file."""
+        if not self.config.object_spilling or not meta.segment:
+            return False
+        if not os.path.exists(meta.segment):
+            return False  # segment not on this filesystem: cannot relocate
+        spill_dir = self._spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        dst = os.path.join(spill_dir, meta.object_id.hex())
+        try:
+            if meta.arena_offset is not None:
+                from ray_tpu._private.object_store import get_node_arena
+
+                arena = get_node_arena(os.path.dirname(meta.segment))
+                if arena is None:
+                    return False
+                view = arena.view(meta.arena_offset, meta.size)
+                with open(dst, "wb") as f:
+                    f.write(view)
+                arena.free(meta.arena_offset)
+            else:
+                import shutil
+
+                # Cross-device (shm -> disk): copy + unlink, not rename.
+                shutil.copyfile(meta.segment, dst)
+                os.unlink(meta.segment)
+        except OSError:
+            try:
+                os.unlink(dst)
+            except OSError:
+                pass
+            return False
+        meta.segment = dst
+        meta.arena_offset = None
+        meta.spilled = True
+        return True
+
     def _alias_error_meta(self, oid: ObjectID, err: ObjectMeta) -> ObjectMeta:
         """A dependent's error result aliasing the failed dependency's payload.
         The alias copies the full location (segment/arena_offset/node_id) so
@@ -1202,7 +1262,7 @@ class Scheduler:
 
     def _cmd_put_meta(self, meta: ObjectMeta):
         err = self._check_capacity(meta)
-        if err is not None:
+        if err is not None and not self._try_spill_new(meta):
             raise err
         self._add_holder(meta.object_id.binary(), self._INPROC_DRIVER)
         self._seal_object(meta)
@@ -1487,7 +1547,7 @@ class Scheduler:
 
     def _req_put_meta(self, wh: WorkerHandle, req_id: int, meta: ObjectMeta):
         err = self._check_capacity(meta)
-        if err is not None:
+        if err is not None and not self._try_spill_new(meta):
             self._respond(wh, req_id, False, err)
             return
         self._add_holder(meta.object_id.binary(), self._holder_of(wh))

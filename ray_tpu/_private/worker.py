@@ -835,6 +835,7 @@ def shutdown():
             if global_worker.store is not None:
                 global_worker.store.detach_all()
             if global_worker.session_dir:
+                # scheduler.stop() above removed the spill dir.
                 shutil.rmtree(global_worker.session_dir, ignore_errors=True)
     global_worker.mode = None
     global_worker.context = None

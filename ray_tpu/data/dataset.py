@@ -590,12 +590,12 @@ class Dataset:
         if sum(fractions) > 1.0 + 1e-9:
             raise ValueError("fractions sum to > 1")
         shuffled = self.random_shuffle(seed=seed)
-        total = shuffled.count()
+        refs = shuffled._execute()
+        sizes = ray_tpu.get([_remote(_num_rows).remote(r) for r in refs])
+        total = sum(sizes)
         counts = [int(total * f) for f in fractions]
         if abs(sum(fractions) - 1.0) < 1e-9:
             counts[-1] = total - sum(counts[:-1])
-        refs = shuffled._execute()
-        sizes = ray_tpu.get([_remote(_num_rows).remote(r) for r in refs])
         slice_remote = _remote(_slice_block)
         splits: List[Dataset] = []
         ref_i, offset = 0, 0

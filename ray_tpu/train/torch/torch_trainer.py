@@ -49,12 +49,18 @@ def prepare_data_loader(loader):
 
     if not (dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1):
         return loader
+    from torch.utils.data import RandomSampler
+
     if isinstance(loader.sampler, DistributedSampler):
         return loader
+    # Preserve the loader's ordering intent: only shuffled loaders stay
+    # shuffled (reference: prepare_data_loader passes
+    # shuffle=isinstance(sampler, RandomSampler)).
+    shuffle = isinstance(loader.sampler, RandomSampler)
     return DataLoader(
         loader.dataset,
         batch_size=loader.batch_size,
-        sampler=DistributedSampler(loader.dataset),
+        sampler=DistributedSampler(loader.dataset, shuffle=shuffle),
         num_workers=loader.num_workers,
         collate_fn=loader.collate_fn,
         drop_last=loader.drop_last,
