@@ -1,0 +1,112 @@
+"""Collective microbenchmark: `ray_tpu.util.collective` allreduce across N
+actors — BASELINE config #2 ("ray.util.collective allreduce microbenchmark
+across N actors"; the reference's `util/collective` perf surface).
+
+Two planes measured:
+ - tcp backend: host-data allreduce across worker-actor processes (the
+   gloo-role backend) at several payload sizes -> algorithmic bus bandwidth
+   busbw = 2*(n-1)/n * payload / time.
+ - xla multidevice: one process driving all local accelerator devices,
+   compiled-shard_map psum (the ICI plane) — single dispatch after the
+   first-call compile.
+
+Prints one JSON line per metric. Runs anywhere (CPU devices if no TPU).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _tcp_group_bench(world: int, nbytes: int, iters: int) -> float:
+    """Average seconds per allreduce across `world` actors (tcp backend)."""
+    import ray_tpu
+    from ray_tpu.util import collective
+
+    name = f"bench_{nbytes}"
+
+    @ray_tpu.remote
+    class Member:
+        def setup(self, world, rank, name):
+            self.name = name
+            collective.init_collective_group(world, rank, backend="tcp", group_name=name)
+            return True
+
+        def run(self, n_floats, iters):
+            x = np.ones(n_floats, np.float32)
+            collective.allreduce(x, group_name=self.name)  # warmup
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                collective.allreduce(x, group_name=self.name)
+            return (time.perf_counter() - t0) / iters
+
+        def teardown(self):
+            collective.destroy_collective_group(self.name)
+
+    members = [Member.options(num_cpus=0.5).remote() for _ in range(world)]
+    ray_tpu.get([m.setup.remote(world, i, name) for i, m in enumerate(members)])
+    times = ray_tpu.get([m.run.remote(nbytes // 4, iters) for m in members])
+    try:
+        ray_tpu.get([m.teardown.remote() for m in members], timeout=10)
+    except Exception:
+        pass
+    for m in members:
+        ray_tpu.kill(m)
+    return float(np.mean(times))
+
+
+def main() -> None:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    results = []
+
+    world = 4
+    for label, nbytes, iters in (("1KB", 1024, 50), ("1MB", 1 << 20, 30), ("16MB", 16 << 20, 10)):
+        sec = _tcp_group_bench(world, nbytes, iters)
+        busbw = 2 * (world - 1) / world * nbytes / sec
+        results.append(
+            {
+                "metric": f"tcp_allreduce_{world}actors_{label}",
+                "value": round(busbw / 1e9, 3),
+                "unit": "GB/s busbw",
+                "sec_per_op": round(sec, 5),
+            }
+        )
+
+    # XLA plane: compiled psum over all local devices of this process.
+    import jax
+
+    from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
+
+    group = XLAGroup(world_size=1, rank=0, group_name="local")
+    ndev = len(group.devices)
+    if ndev > 1:
+        for label, nbytes, iters in (("1MB", 1 << 20, 50), ("64MB", 64 << 20, 20)):
+            tensors = [np.ones(nbytes // 4, np.float32) for _ in range(ndev)]
+            group.allreduce_multidevice(tensors)  # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = group.allreduce_multidevice(tensors)
+            jax.block_until_ready(out)
+            sec = (time.perf_counter() - t0) / iters
+            busbw = 2 * (ndev - 1) / ndev * nbytes / sec
+            results.append(
+                {
+                    "metric": f"xla_allreduce_{ndev}dev_{label}",
+                    "value": round(busbw / 1e9, 3),
+                    "unit": "GB/s busbw",
+                    "sec_per_op": round(sec, 5),
+                }
+            )
+
+    ray_tpu.shutdown()
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

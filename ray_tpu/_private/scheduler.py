@@ -1158,15 +1158,16 @@ class Scheduler:
 
     @property
     def _spill_dir(self) -> str:
-        d = self.config.object_spill_dir
-        if not d:
-            import tempfile
+        session = os.path.basename(self.session_dir.rstrip("/"))
+        base = self.config.object_spill_dir
+        if base:
+            # Always a per-session SUBDIR of the configured path: shutdown may
+            # rmtree it without touching the user's other files or another
+            # live session's spilled objects.
+            return os.path.join(base, session + "_spill")
+        import tempfile
 
-            d = os.path.join(
-                tempfile.gettempdir(),
-                os.path.basename(self.session_dir.rstrip("/")) + "_spill",
-            )
-        return d
+        return os.path.join(tempfile.gettempdir(), session + "_spill")
 
     def _try_spill_new(self, meta: ObjectMeta) -> bool:
         """Relocate a just-written object to the disk spill dir (plasma's
@@ -1180,10 +1181,15 @@ class Scheduler:
             return False
         if not os.path.exists(meta.segment):
             return False  # segment not on this filesystem: cannot relocate
+        # NOTE: the byte copy runs on the scheduler's dispatch thread — a
+        # multi-GB spill stalls other RPCs for its duration. Acceptable while
+        # spills are the at-capacity slow path; the next step if profiles
+        # disagree is relocating via the owning node's daemon (the channel
+        # deletes already use) and applying only the meta update here.
         spill_dir = self._spill_dir
-        os.makedirs(spill_dir, exist_ok=True)
         dst = os.path.join(spill_dir, meta.object_id.hex())
         try:
+            os.makedirs(spill_dir, exist_ok=True)
             if meta.arena_offset is not None:
                 from ray_tpu._private.object_store import get_node_arena
 
