@@ -77,21 +77,32 @@ def main() -> None:
             }
         )
 
-    # XLA plane: compiled psum over all local devices of this process.
+    # XLA plane: the compiled psum itself, on device-RESIDENT shards (host
+    # staging excluded — that is what the tcp numbers above measure).
     import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
-
-    group = XLAGroup(world_size=1, rank=0, group_name="local")
-    ndev = len(group.devices)
+    devices = jax.devices()
+    ndev = len(devices)
     if ndev > 1:
+        mesh = Mesh(np.array(devices), ("d",))
+        psum = jax.jit(
+            jax.shard_map(
+                lambda x: jax.lax.psum(x, "d"), mesh=mesh,
+                in_specs=P("d"), out_specs=P(), check_vma=False,
+            )
+        )
         for label, nbytes, iters in (("1MB", 1 << 20, 50), ("64MB", 64 << 20, 20)):
-            tensors = [np.ones(nbytes // 4, np.float32) for _ in range(ndev)]
-            group.allreduce_multidevice(tensors)  # compile + warmup
+            x = jax.device_put(
+                np.ones((ndev, nbytes // 4), np.float32),
+                NamedSharding(mesh, P("d")),
+            )
+            psum(x).block_until_ready()  # compile + warmup
             t0 = time.perf_counter()
+            out = None
             for _ in range(iters):
-                out = group.allreduce_multidevice(tensors)
-            jax.block_until_ready(out)
+                out = psum(x)
+            out.block_until_ready()
             sec = (time.perf_counter() - t0) / iters
             busbw = 2 * (ndev - 1) / ndev * nbytes / sec
             results.append(

@@ -8,6 +8,7 @@ from ray_tpu.models.gpt import (
     train_flops_per_token,
 )
 from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.models.resnet import ResNetConfig
 from ray_tpu.models.training import (
     TrainState,
     create_train_state,
@@ -20,6 +21,7 @@ from ray_tpu.models.training import (
 __all__ = [
     "GPTConfig",
     "LlamaConfig",
+    "ResNetConfig",
     "TrainState",
     "create_train_state",
     "default_optimizer",

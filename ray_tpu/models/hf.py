@@ -29,12 +29,23 @@ def _pad_vocab(n: int, multiple: int = 128) -> int:
 
 
 def config_from_hf(hf_config, **overrides) -> GPTConfig:
-    """GPTConfig matching a transformers GPT2Config (vocab padded for MXU)."""
+    """GPTConfig matching a transformers GPT2Config (vocab padded for MXU).
+
+    Raises on HF options this forward pass does not implement (non-gelu
+    activations, non-default layer-norm eps) rather than silently diverging
+    from the parity promise."""
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh", "gelu"):
+        raise ValueError(f"unsupported activation_function {act!r} (gelu family only)")
+    eps = float(getattr(hf_config, "layer_norm_epsilon", 1e-5))
+    if abs(eps - 1e-5) > 1e-9:
+        raise ValueError(f"layer_norm_epsilon {eps} != 1e-5 (models/gpt.py hardcodes 1e-5)")
     kw = dict(
         vocab_size=_pad_vocab(hf_config.vocab_size),
         n_layer=hf_config.n_layer,
         n_head=hf_config.n_head,
         d_model=hf_config.n_embd,
+        d_ff=getattr(hf_config, "n_inner", None) or 0,  # 0 -> 4*d_model
         max_seq_len=hf_config.n_positions,
     )
     kw.update(overrides)

@@ -1,0 +1,252 @@
+"""ResNet family, TPU-first: the vision member of the model zoo.
+
+Reference workload: Ray Train data-parallel ResNet-50 on ImageNet
+(`release/train_tests/` / BASELINE config #3 — the reference itself ships no
+model code). Design follows the zoo's rules (`models/gpt.py`):
+ - plain pytree params with per-leaf logical axes; DP/FSDP come from
+   `parallel.ShardingRules` at trainer level.
+ - NHWC layout (TPU-native conv layout; channels on the 128-lane minor dim).
+ - GroupNorm instead of BatchNorm: normalization is then a pure per-example
+   function — no mutable running statistics threading through the train
+   state, no cross-replica stat sync — and the train step stays a single
+   donated jit like every other model (ResNet+GN matches BN accuracy at
+   ImageNet scale; Wu & He, "Group Normalization").
+ - bf16 conv/matmul compute, f32 norms and logits.
+
+Supports the standard depths via bottleneck (50/101/152) and basic (18/34)
+blocks; `resnet50()` is the benchmark preset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    # Stage depths, e.g. (3, 4, 6, 3) for ResNet-50.
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
+    bottleneck: bool = True
+    width: int = 64
+    groupnorm_groups: int = 32
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def expansion(self) -> int:
+        return 4 if self.bottleneck else 1
+
+    # ---- presets ----
+    @classmethod
+    def resnet18(cls, **kw):
+        return cls(stage_sizes=(2, 2, 2, 2), bottleneck=False, **kw)
+
+    @classmethod
+    def resnet34(cls, **kw):
+        return cls(stage_sizes=(3, 4, 6, 3), bottleneck=False, **kw)
+
+    @classmethod
+    def resnet50(cls, **kw):
+        return cls(stage_sizes=(3, 4, 6, 3), bottleneck=True, **kw)
+
+    @classmethod
+    def resnet101(cls, **kw):
+        return cls(stage_sizes=(3, 4, 23, 3), bottleneck=True, **kw)
+
+    @classmethod
+    def nano(cls, **kw):
+        """Tiny config for CPU tests (CIFAR-shaped inputs train in seconds)."""
+        kw.setdefault("num_classes", 10)
+        kw.setdefault("width", 8)
+        kw.setdefault("groupnorm_groups", 4)
+        return cls(stage_sizes=(1, 1), bottleneck=False, **kw)
+
+
+def _conv_init(key, shape, pd):
+    """He-normal over fan_in (kh * kw * cin)."""
+    fan_in = shape[0] * shape[1] * shape[2]
+    return (jax.random.normal(key, shape) * math.sqrt(2.0 / fan_in)).astype(pd)
+
+
+def _stage_channels(config: ResNetConfig) -> List[int]:
+    return [config.width * (2**i) for i in range(len(config.stage_sizes))]
+
+
+def init_params(config: ResNetConfig, key) -> Dict[str, Any]:
+    pd = config.param_dtype
+    keys = iter(jax.random.split(key, 1024))
+    params: Dict[str, Any] = {
+        "stem": {
+            "conv": _conv_init(next(keys), (7, 7, 3, config.width), pd),
+            "gn_scale": jnp.ones((config.width,), pd),
+            "gn_bias": jnp.zeros((config.width,), pd),
+        }
+    }
+    cin = config.width
+    for si, (n_blocks, ch) in enumerate(zip(config.stage_sizes, _stage_channels(config))):
+        blocks = []
+        cout = ch * config.expansion
+        for bi in range(n_blocks):
+            b: Dict[str, Any] = {}
+            if config.bottleneck:
+                b["conv1"] = _conv_init(next(keys), (1, 1, cin, ch), pd)
+                b["conv2"] = _conv_init(next(keys), (3, 3, ch, ch), pd)
+                b["conv3"] = _conv_init(next(keys), (1, 1, ch, cout), pd)
+                norms = 3
+            else:
+                b["conv1"] = _conv_init(next(keys), (3, 3, cin, ch), pd)
+                b["conv2"] = _conv_init(next(keys), (3, 3, ch, cout), pd)
+                norms = 2
+            # Final-norm scale initialized to zero (the standard residual-
+            # friendly init: each block starts as identity).
+            sizes = [ch, ch, cout] if config.bottleneck else [ch, cout]
+            for ni, c in enumerate(sizes):
+                b[f"gn{ni + 1}_scale"] = (
+                    jnp.zeros((c,), pd) if ni == norms - 1 else jnp.ones((c,), pd)
+                )
+                b[f"gn{ni + 1}_bias"] = jnp.zeros((c,), pd)
+            if cin != cout:
+                # Covers every stride-2 block too: stage channels double, so
+                # the first block of each later stage always changes width.
+                b["proj"] = _conv_init(next(keys), (1, 1, cin, cout), pd)
+            blocks.append(b)
+            cin = cout
+        params[f"stage{si}"] = blocks
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (cin, config.num_classes)) * 0.01).astype(pd),
+        "b": jnp.zeros((config.num_classes,), pd),
+    }
+    return params
+
+
+def param_logical_axes(config: ResNetConfig) -> Dict[str, Any]:
+    """Conv kernels shard their output-channel dim over `mlp` (FSDP-style);
+    the classifier head shards embed -> vocab like an LM head. Derived from
+    the param tree itself so the structure always matches exactly (proj
+    kernels exist only on downsampling blocks)."""
+    shapes = init_shapes(config)
+
+    def ax(path, leaf):
+        names = [getattr(k, "key", getattr(k, "idx", None)) for k in path]
+        if names[-2:] == ["head", "w"]:
+            return ("embed", "vocab")
+        if leaf.ndim == 4:  # conv kernel (kh, kw, cin, cout)
+            return (None, None, None, "mlp")
+        return (None,) * leaf.ndim
+
+    return jax.tree_util.tree_map_with_path(ax, shapes)
+
+
+def num_params(config: ResNetConfig) -> int:
+    return sum(p.size for p in jax.tree.leaves(init_shapes(config)))
+
+
+def init_shapes(config: ResNetConfig):
+    return jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))
+
+
+# --------------------------------------------------------------------------- forward
+def _group_norm(x, scale, bias, groups, eps=1e-5):
+    N, H, W, C = x.shape
+    g = min(groups, C)
+    while C % g:
+        g -= 1
+    xf = x.astype(jnp.float32).reshape(N, H, W, g, C // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return xf.reshape(N, H, W, C) * scale + bias
+
+
+def _conv(x, w, stride=1, cdt=None):
+    return jax.lax.conv_general_dilated(
+        x.astype(cdt),
+        w.astype(cdt),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _block_fwd(x, b, config: ResNetConfig, stride: int):
+    cdt = config.dtype
+    g = config.groupnorm_groups
+    residual = x
+    if config.bottleneck:
+        h = _conv(x, b["conv1"], 1, cdt)
+        h = jax.nn.relu(_group_norm(h, b["gn1_scale"], b["gn1_bias"], g))
+        h = _conv(h, b["conv2"], stride, cdt)
+        h = jax.nn.relu(_group_norm(h, b["gn2_scale"], b["gn2_bias"], g))
+        h = _conv(h, b["conv3"], 1, cdt)
+        h = _group_norm(h, b["gn3_scale"], b["gn3_bias"], g)
+    else:
+        h = _conv(x, b["conv1"], stride, cdt)
+        h = jax.nn.relu(_group_norm(h, b["gn1_scale"], b["gn1_bias"], g))
+        h = _conv(h, b["conv2"], 1, cdt)
+        h = _group_norm(h, b["gn2_scale"], b["gn2_bias"], g)
+    if "proj" in b:
+        residual = _conv(x, b["proj"], stride, cdt)
+    else:
+        # Identity residual: init guarantees a proj whenever shape changes.
+        assert stride == 1, "stride-2 block without a projection kernel"
+    return jax.nn.relu(h + residual.astype(jnp.float32)).astype(cdt)
+
+
+def forward(
+    params: Dict[str, Any],
+    images,  # (B, H, W, 3) float
+    config: ResNetConfig,
+    attention_fn=None,  # API parity with the LM families (unused)
+    dropout_rng=None,
+    mesh=None,
+    num_microbatches=None,
+    return_aux: bool = False,
+):
+    """Class logits (B, num_classes) in float32."""
+    del attention_fn, dropout_rng, mesh, num_microbatches
+    cdt = config.dtype
+    x = _conv(images, params["stem"]["conv"], 2, cdt)
+    x = jax.nn.relu(
+        _group_norm(x, params["stem"]["gn_scale"], params["stem"]["gn_bias"],
+                    config.groupnorm_groups)
+    ).astype(cdt)
+    # 3x3 max-pool stride 2 (stem), as in the standard architecture.
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for si in range(len(config.stage_sizes)):
+        for bi, b in enumerate(params[f"stage{si}"]):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            x = _block_fwd(x, b, config, stride)
+    x = x.astype(jnp.float32).mean(axis=(1, 2))  # global average pool
+    logits = jnp.einsum(
+        "bc,cn->bn", x.astype(cdt), params["head"]["w"].astype(cdt),
+        preferred_element_type=jnp.float32,
+    ) + params["head"]["b"].astype(jnp.float32)
+    if return_aux:
+        return logits, jnp.zeros((), jnp.float32)
+    return logits
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, Any],  # {"images": (B,H,W,3), "labels": (B,)}
+    config: ResNetConfig,
+    attention_fn=None,
+    dropout_rng=None,
+    mesh=None,
+    num_microbatches=None,
+):
+    """Softmax cross entropy over classes (mean over the batch)."""
+    logits = forward(params, batch["images"], config, attention_fn, dropout_rng, mesh)
+    labels = batch["labels"]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    at = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return (lse - at).mean()

@@ -23,12 +23,14 @@ from ray_tpu.models import gpt
 
 
 def model_for(config):
-    """Dispatch a config dataclass to its model module (gpt, llama, ...), so
-    one TrainState/step factory serves the whole zoo."""
-    from ray_tpu.models import llama
+    """Dispatch a config dataclass to its model module (gpt, llama, resnet,
+    ...), so one TrainState/step factory serves the whole zoo."""
+    from ray_tpu.models import llama, resnet
 
     if isinstance(config, llama.LlamaConfig):
         return llama
+    if isinstance(config, resnet.ResNetConfig):
+        return resnet
     return gpt
 
 
@@ -117,7 +119,13 @@ def shard_batch(batch: Dict[str, Any], mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def put(x):
-        spec = batch_spec() if x.ndim >= 2 else P(("data", "fsdp"))
+        if x.ndim == 2:
+            spec = batch_spec()  # (batch over data/fsdp, sequence over context)
+        else:
+            # 1-D labels and N-D image tensors: only the batch dim shards
+            # (context parallelism is a sequence-axis concept; image H/W must
+            # not land on it).
+            spec = P(("data", "fsdp"))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, batch)
