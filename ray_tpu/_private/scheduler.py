@@ -336,6 +336,10 @@ class Scheduler:
         self.pins: Dict[bytes, int] = {}
         self.contained_pins: Dict[bytes, List[bytes]] = {}
         self.node_usage: Dict[NodeID, int] = {}
+        # How many RETAINED task records list each object id among their deps
+        # (lineage chains: reconstructing a record's output re-executes it,
+        # which needs its arg objects — whose own records must survive).
+        self.lineage_consumers: Dict[bytes, int] = {}
         self._reconstructing: Dict[bytes, List[Callable[[bool, Any], None]]] = {}
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -1089,6 +1093,61 @@ class Scheduler:
             return
         self._retire_meta_accounting(meta)
         self._delete_segment(meta)
+        self._maybe_gc_lineage(meta.object_id)
+
+    def _gc_eligible(self, oid: ObjectID):
+        """The record that produced `oid`, iff it can be evicted: terminal,
+        not an actor-creation replay source, every return fully freed, and no
+        retained record consumes a return as a dep."""
+        rec = self.tasks.get(oid.task_id)
+        if rec is None or rec.state not in ("FINISHED", "FAILED", "CANCELLED"):
+            return None
+        if rec.spec.is_actor_creation:
+            return None  # actor restarts replay the creation task while alive
+        for rid in rec.return_ids:
+            k = rid.binary()
+            if (
+                k in self.object_table
+                or k in self.holders
+                or self.pins.get(k, 0) > 0
+                or k in self._reconstructing
+                or k in self.object_waiters
+                or self.lineage_consumers.get(k, 0) > 0
+            ):
+                return None
+        return rec
+
+    def _maybe_gc_lineage(self, oid: ObjectID):
+        """Drop the creating task's record once (a) every return object is
+        fully freed — reconstruction of them can never be requested — AND
+        (b) no retained record lists a return among its deps — re-executing
+        such a consumer would need the return's value, which needs THIS
+        record. Dropping a record releases its own dep references, which may
+        cascade-free upstream records. The reference bounds lineage with
+        footprint accounting (`core_worker/task_manager.h:543-553`); without
+        eviction the task table grows forever on long-running drivers."""
+        rec = self._gc_eligible(oid)
+        if rec is None:
+            return
+        # Cascade via an explicit worklist (a sequential chain of thousands of
+        # records would blow Python recursion limits inside the event thread).
+        worklist = [rec]
+        self.tasks.pop(oid.task_id, None)
+        while worklist:
+            dropped = worklist.pop()
+            for d in dropped.dep_ids:
+                n = self.lineage_consumers.get(d, 0) - 1
+                if n <= 0:
+                    self.lineage_consumers.pop(d, None)
+                    # The dep may now be the last thing holding ITS record.
+                    if d in self.object_table or d in self.holders:
+                        continue
+                    upstream = self._gc_eligible(ObjectID(d))
+                    if upstream is not None:
+                        self.tasks.pop(upstream.spec.task_id, None)
+                        worklist.append(upstream)
+                else:
+                    self.lineage_consumers[d] = n
 
     def _retire_meta_accounting(self, meta: ObjectMeta):
         key = meta.object_id.binary()
@@ -1883,6 +1942,10 @@ class Scheduler:
 
     # ------------------------------------------------------------------ task registration & scheduling
     def _register_task(self, rec: TaskRecord):
+        # Re-registration (lineage reconstruction clones) replaces the record
+        # under the same task id: its lineage_consumers increments are already
+        # accounted (GC decrements exactly once per task id).
+        fresh = rec.spec.task_id not in self.tasks
         self.tasks[rec.spec.task_id] = rec
         if rec.func_blob is not None:
             self.gcs.function_table.setdefault(rec.spec.func.function_id, rec.func_blob)
@@ -1905,6 +1968,10 @@ class Scheduler:
                 rec.dep_ids.extend(m.contained_ids)
                 for child in m.contained_ids:
                     self._pin(child)
+        if fresh:
+            # AFTER all dep additions, so GC's per-dep decrement is symmetric.
+            for d in rec.dep_ids:
+                self.lineage_consumers[d] = self.lineage_consumers.get(d, 0) + 1
         self.pending.append(rec)
 
     def _submit_actor_task(self, req: ExecRequest):
@@ -1930,6 +1997,9 @@ class Scheduler:
                 rec.dep_ids.extend(v.contained_ids)
                 for child in v.contained_ids:
                     self._pin(child)
+        if spec.task_id not in self.tasks:
+            for d in rec.dep_ids:
+                self.lineage_consumers[d] = self.lineage_consumers.get(d, 0) + 1
         self.tasks[spec.task_id] = rec
         self._record_event(spec, "SUBMITTED")
         ar = self.actors.get(spec.actor_id)

@@ -35,8 +35,10 @@ def config_from_hf(hf_config, **overrides) -> GPTConfig:
     activations, non-default layer-norm eps) rather than silently diverging
     from the parity promise."""
     act = getattr(hf_config, "activation_function", "gelu_new")
-    if act not in ("gelu_new", "gelu_pytorch_tanh", "gelu"):
-        raise ValueError(f"unsupported activation_function {act!r} (gelu family only)")
+    # gpt.py computes jax.nn.gelu's tanh approximation; HF "gelu" is the
+    # exact erf variant and would silently diverge from parity.
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported activation_function {act!r} (tanh-gelu only)")
     eps = float(getattr(hf_config, "layer_norm_epsilon", 1e-5))
     if abs(eps - 1e-5) > 1e-9:
         raise ValueError(f"layer_norm_epsilon {eps} != 1e-5 (models/gpt.py hardcodes 1e-5)")

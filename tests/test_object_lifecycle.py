@@ -272,3 +272,55 @@ def test_actor_restart_keeps_creation_args_alive(ray_start_regular):
         pass
     # Restarted actor re-ran __init__(big): the arg was still alive.
     assert ray_tpu.get(a.total_.remote(), timeout=60) == expect
+
+
+def test_lineage_gc_bounds_task_table(ray_start_regular):
+    """Completed task records whose returns are fully freed are evicted, so
+    the task table stays bounded on long-running drivers; records whose
+    returns feed retained lineage survive until the chain is released."""
+    from ray_tpu._private.worker import global_worker
+
+    sched = global_worker.context.scheduler
+
+    @ray_tpu.remote
+    def make():
+        return np.arange(1000)
+
+    @ray_tpu.remote
+    def consume(x):
+        return int(x.sum())
+
+    before = len(sched.tasks)
+    for _ in range(50):
+        r = ray_tpu.get(make.remote())
+        del r
+    gc.collect()
+    flush_ref_ops()
+    # One more round-trip so the scheduler processes the queued releases.
+    ray_tpu.get(make.remote())
+    gc.collect()
+    flush_ref_ops()
+    time.sleep(0.2)
+    ray_tpu.get(make.remote())
+    assert len(sched.tasks) - before < 20, len(sched.tasks) - before
+
+    # Lineage chain: mid's record must survive while tail is alive.
+    mid = make.remote()
+    tail = consume.remote(mid)
+    ray_tpu.get(tail)
+    mid_task = mid.task_id
+    del mid
+    gc.collect()
+    flush_ref_ops()
+    ray_tpu.get(make.remote())  # nudge
+    # tail is still referenced -> consume's record retained -> make's record
+    # (its dep producer) retained even though our mid handle is gone.
+    assert mid_task in sched.tasks
+    del tail
+    gc.collect()
+    flush_ref_ops()
+    deadline = time.time() + 5
+    while mid_task in sched.tasks and time.time() < deadline:
+        ray_tpu.get(make.remote())
+        time.sleep(0.05)
+    assert mid_task not in sched.tasks
