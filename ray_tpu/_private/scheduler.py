@@ -340,6 +340,12 @@ class Scheduler:
         # (lineage chains: reconstructing a record's output re-executes it,
         # which needs its arg objects — whose own records must survive).
         self.lineage_consumers: Dict[bytes, int] = {}
+        # Bounded summaries of lineage-GC'd records so the state/dashboard
+        # task listing still shows completed history (the reference keeps a
+        # separate bounded GcsTaskManager store for the same reason).
+        from collections import deque
+
+        self._gc_task_summaries: "deque" = deque(maxlen=1000)
         self._reconstructing: Dict[bytes, List[Callable[[bool, Any], None]]] = {}
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -1082,6 +1088,10 @@ class Scheduler:
         rec = self.tasks.get(ar.creation_req.spec.task_id)
         if rec is not None:
             self._release_task_pins(rec)
+        if ar.state == "DEAD":
+            # A dead actor's creation record has no return objects to trigger
+            # lineage GC from: try directly (no-op if restarts remain).
+            self._maybe_gc_lineage_task(ar.creation_req.spec.task_id)
 
     def _maybe_free(self, key: bytes):
         if key in self.holders or self.pins.get(key, 0) > 0:
@@ -1090,20 +1100,33 @@ class Scheduler:
             return
         meta = self.object_table.pop(key, None)
         if meta is None:
+            # Bytes may already be gone (e.g. a failed reconstruction popped
+            # the stale meta): the creating record can still become GC-able
+            # now that the last holder dropped.
+            self._maybe_gc_lineage(ObjectID(key))
             return
         self._retire_meta_accounting(meta)
         self._delete_segment(meta)
         self._maybe_gc_lineage(meta.object_id)
 
     def _gc_eligible(self, oid: ObjectID):
-        """The record that produced `oid`, iff it can be evicted: terminal,
-        not an actor-creation replay source, every return fully freed, and no
-        retained record consumes a return as a dep."""
-        rec = self.tasks.get(oid.task_id)
+        return self._gc_eligible_task(oid.task_id)
+
+    def _gc_eligible_task(self, task_id):
+        """The record for `task_id`, iff it can be evicted: terminal, not an
+        actor-creation replay source (while the actor can restart), every
+        return fully freed, and no retained record consumes a return as a
+        dep."""
+        rec = self.tasks.get(task_id)
         if rec is None or rec.state not in ("FINISHED", "FAILED", "CANCELLED"):
             return None
         if rec.spec.is_actor_creation:
-            return None  # actor restarts replay the creation task while alive
+            # Restarts replay the creation task while the actor can come
+            # back; once it is DEAD (or unknown) the record is GC-able like
+            # any other — otherwise actor churn leaks records forever.
+            ar = self.actors.get(rec.spec.actor_id)
+            if ar is not None and ar.state != "DEAD":
+                return None
         for rid in rec.return_ids:
             k = rid.binary()
             if (
@@ -1126,15 +1149,19 @@ class Scheduler:
         cascade-free upstream records. The reference bounds lineage with
         footprint accounting (`core_worker/task_manager.h:543-553`); without
         eviction the task table grows forever on long-running drivers."""
-        rec = self._gc_eligible(oid)
+        self._maybe_gc_lineage_task(oid.task_id)
+
+    def _maybe_gc_lineage_task(self, task_id):
+        rec = self._gc_eligible_task(task_id)
         if rec is None:
             return
         # Cascade via an explicit worklist (a sequential chain of thousands of
         # records would blow Python recursion limits inside the event thread).
         worklist = [rec]
-        self.tasks.pop(oid.task_id, None)
+        self.tasks.pop(rec.spec.task_id, None)
         while worklist:
             dropped = worklist.pop()
+            self._gc_task_summaries.append(self._task_summary(dropped))
             for d in dropped.dep_ids:
                 n = self.lineage_consumers.get(d, 0) - 1
                 if n <= 0:
@@ -1514,21 +1541,28 @@ class Scheduler:
     def _cmd_task_events(self, _):
         return list(self.gcs.task_events)
 
+    @staticmethod
+    def _task_summary(rec: TaskRecord) -> dict:
+        return {
+            "task_id": rec.spec.task_id.hex(),
+            "name": rec.spec.name or rec.spec.func.name,
+            "state": rec.state,
+            "actor_id": rec.spec.actor_id.hex() if rec.spec.actor_id else None,
+            "node_id": rec.node.hex() if rec.node else None,
+            "retries_left": rec.retries_left,
+            "submitted_at": rec.submitted_at,
+        }
+
     def _cmd_list_tasks(self, payload):
         limit = int(payload or 1000)
-        out = []
-        for rec in list(self.tasks.values())[-limit:]:
-            out.append(
-                {
-                    "task_id": rec.spec.task_id.hex(),
-                    "name": rec.spec.name or rec.spec.func.name,
-                    "state": rec.state,
-                    "actor_id": rec.spec.actor_id.hex() if rec.spec.actor_id else None,
-                    "node_id": rec.node.hex() if rec.node else None,
-                    "retries_left": rec.retries_left,
-                    "submitted_at": rec.submitted_at,
-                }
-            )
+        # Live records keep dict insertion (submission) order; only the tail
+        # slices materialize. GC'd history (older by construction) fills any
+        # remaining budget in front.
+        live = list(self.tasks.values())[-limit:]
+        out = [self._task_summary(rec) for rec in live]
+        if len(out) < limit:
+            need = limit - len(out)
+            out = [dict(s) for s in list(self._gc_task_summaries)[-need:]] + out
         return out
 
     def _cmd_autoscaler_state(self, _):

@@ -324,3 +324,38 @@ def test_lineage_gc_bounds_task_table(ray_start_regular):
         ray_tpu.get(make.remote())
         time.sleep(0.05)
     assert mid_task not in sched.tasks
+
+
+def test_lineage_gc_after_actor_death(ray_start_regular):
+    """Actor churn does not leak creation records: once the actor is DEAD,
+    its creation record (and its constructor-arg lineage) is evicted."""
+    from ray_tpu._private.worker import global_worker
+
+    sched = global_worker.context.scheduler
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange(1000)
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self, x):
+            self.n = int(x.sum())
+
+        def get(self):
+            return self.n
+
+    before = len(sched.tasks)
+    for _ in range(10):
+        x = produce.remote()
+        a = A.remote(x)
+        assert ray_tpu.get(a.get.remote()) == 499500
+        ray_tpu.kill(a)
+        del x, a
+    gc.collect()
+    flush_ref_ops()
+    deadline = time.time() + 5
+    while len(sched.tasks) - before > 5 and time.time() < deadline:
+        ray_tpu.get(produce.remote())  # nudge release processing
+        time.sleep(0.05)
+    assert len(sched.tasks) - before <= 5, len(sched.tasks) - before
