@@ -10,7 +10,9 @@ Public API parity anchor: `/root/reference/python/ray/__init__.py`.
 
 from ray_tpu import exceptions
 from ray_tpu._private.worker import (
+    DynamicObjectRefGenerator,
     ObjectRef,
+    ObjectRefGenerator,
     available_resources,
     cancel,
     cluster_resources,
@@ -51,7 +53,9 @@ def remote(*args, **kwargs):
 
 
 __all__ = [
+    "DynamicObjectRefGenerator",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
     "RemoteFunction",

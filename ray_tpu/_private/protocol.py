@@ -45,6 +45,17 @@ class TaskSpec:
     task_id: TaskID
     func: FunctionDescriptor
     num_returns: int = 1
+    # Generator tasks (reference: `num_returns="dynamic"` / streaming generators,
+    # `/root/reference/python/ray/_raylet.pyx:174 ObjectRefGenerator`):
+    #   None        — fixed num_returns
+    #   "dynamic"   — task returns an iterable; each yielded value becomes an
+    #                 object at return index 2+i, and index 1 holds a picklable
+    #                 DynamicObjectRefGenerator listing the refs (resolved when
+    #                 the task finishes).
+    #   "streaming" — the caller gets an ObjectRefGenerator immediately; items
+    #                 become consumable as the worker seals them, before the
+    #                 task finishes.
+    returns_mode: Optional[str] = None
     resources: Dict[str, float] = field(default_factory=dict)
     max_retries: int = 0
     # Actor fields

@@ -238,6 +238,16 @@ class TaskRecord:
     # lifetime, released exactly once when it reaches a terminal state.
     dep_ids: List[bytes] = field(default_factory=list)
     pins_released: bool = False
+    # Generator tasks (spec.returns_mode set): items sealed so far, parked
+    # stream_next callers, final item count (set at terminal state), the
+    # holder string of the consumer (interim "gen:<task>" holders are swept
+    # when this holder's process dies), and whether the consumer released the
+    # stream early.
+    stream_metas: List[ObjectMeta] = field(default_factory=list)
+    stream_waiters: List[Tuple[int, concurrent.futures.Future]] = field(default_factory=list)
+    stream_total: Optional[int] = None
+    stream_owner: Optional[str] = None
+    stream_released: bool = False
 
 
 @dataclass
@@ -940,6 +950,9 @@ class Scheduler:
         if kind == "done":
             _, task_id_bytes, ok, metas = msg
             self._on_task_done(wh, TaskID(task_id_bytes), ok, metas)
+        elif kind == "stream":
+            _, task_id_bytes, index, meta = msg
+            self._on_stream_item(TaskID(task_id_bytes), index, meta)
         elif kind == "req":
             _, req_id, method, payload = msg
             self._on_worker_request(wh, req_id, method, payload)
@@ -971,6 +984,8 @@ class Scheduler:
             self._release_task_pins(rec)
         for meta in metas:
             self._seal_object(meta)
+        if rec.spec.returns_mode is not None:
+            self._finalize_stream(rec)
         if rec.spec.actor_id is not None:
             ar = self.actors.get(rec.spec.actor_id)
             if ar is not None:
@@ -1026,6 +1041,132 @@ class Scheduler:
             ar.backlog.clear()
             self._release_actor_resources(ar)
             self._release_actor_creation_pins(ar)
+
+    # ------------------------------------------------------------------ generator streams
+    # Reference semantics: `num_returns="dynamic"` / streaming generator tasks
+    # (`/root/reference/python/ray/_raylet.pyx:174 ObjectRefGenerator`,
+    # `core_worker/task_manager.cc HandleReportGeneratorItemReturns`). The worker
+    # seals each yielded value as it is produced; consumers pull items through
+    # `stream_next` before the task finishes.
+    @staticmethod
+    def _gen_holder(task_id: TaskID) -> str:
+        return "gen:" + task_id.hex()
+
+    def _on_stream_item(self, task_id: TaskID, index: int, meta: ObjectMeta):
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            # Cancelled + GC'd while the item was in flight: nothing holds it.
+            self._seal_object(meta)
+            return
+        if index == len(rec.stream_metas):
+            # Interim holder keeps the item alive between seal and consumption
+            # (dropped when the consumer takes its own reference, when the
+            # dynamic handle's contained_ids pin it, or at stream release).
+            if not rec.stream_released:
+                self._add_holder(meta.object_id.binary(), self._gen_holder(task_id))
+            self._seal_object(meta)
+            rec.stream_metas.append(meta)
+            rec.return_ids.append(meta.object_id)
+        elif index < len(rec.stream_metas):
+            # Replay after a retry / lineage re-execution: reseal fresh bytes.
+            rec.stream_metas[index] = meta
+            self._seal_object(meta)
+        else:
+            # Out-of-order index (should not happen on a FIFO pipe): seal so the
+            # bytes are tracked, but don't corrupt the stream order.
+            self._seal_object(meta)
+            return
+        if rec.stream_waiters:
+            n = len(rec.stream_metas)
+            still = []
+            for want, fut in rec.stream_waiters:
+                if want < n:
+                    if not fut.done():
+                        fut.set_result(("item", rec.stream_metas[want]))
+                else:
+                    still.append((want, fut))
+            rec.stream_waiters = still
+
+    def _finalize_stream(self, rec: TaskRecord):
+        """Terminal transition of a generator task: fix the item count and
+        answer parked consumers with EOF."""
+        if rec.spec.returns_mode == "dynamic":
+            # The handle object (sealed just before this) pins every item via
+            # contained_ids; the interim gen holders can go.
+            gh = self._gen_holder(rec.spec.task_id)
+            for m in rec.stream_metas:
+                self._rel_holder(m.object_id.binary(), gh)
+        if rec.stream_total is None:
+            rec.stream_total = len(rec.stream_metas)
+        n = len(rec.stream_metas)
+        waiters, rec.stream_waiters = rec.stream_waiters, []
+        for want, fut in waiters:
+            if fut.done():
+                continue
+            if want < n:
+                fut.set_result(("item", rec.stream_metas[want]))
+            else:
+                fut.set_result(("eof", n))
+
+    def _seal_stream_error(self, rec: TaskRecord, make_meta) -> None:
+        """Seal an error as the NEXT stream item of a streaming-mode record, so
+        the consumer raises exactly where the producer stopped. `make_meta`
+        builds the ObjectMeta for the chosen ObjectID."""
+        idx = len(rec.stream_metas)
+        oid = ObjectID.for_return(rec.spec.task_id, 1 + idx)
+        m = make_meta(oid)
+        if not rec.stream_released:
+            self._add_holder(oid.binary(), self._gen_holder(rec.spec.task_id))
+        self._seal_object(m)
+        rec.stream_metas.append(m)
+        rec.return_ids.append(oid)
+
+    def _async_stream_next(self, task_id_bytes: bytes, index: int, fut):
+        rec = self.tasks.get(TaskID(task_id_bytes))
+        if rec is None:
+            # Record evicted (cancelled or fully GC'd): the stream is over.
+            fut.set_result(("eof", index))
+            return
+        if index < len(rec.stream_metas):
+            fut.set_result(("item", rec.stream_metas[index]))
+            return
+        if rec.stream_total is not None or rec.state in ("FINISHED", "FAILED", "CANCELLED"):
+            fut.set_result(("eof", len(rec.stream_metas)))
+            return
+        rec.stream_waiters.append((index, fut))
+
+    def _cmd_stream_next(self, payload):
+        task_id_bytes, index, fut = payload
+        self._async_stream_next(task_id_bytes, index, fut)
+        return _ASYNC
+
+    def _req_stream_next(self, wh, req_id: int, payload):
+        task_id_bytes, index = payload
+        self._mark_blocked(wh)
+
+        def done(result):
+            self._unmark_blocked(wh)
+            self._respond(wh, req_id, True, result)
+
+        fut = concurrent.futures.Future()
+        fut.add_done_callback(lambda f: done(f.result()))
+        self._async_stream_next(task_id_bytes, index, fut)
+
+    def _release_stream(self, task_id_bytes: bytes):
+        """Consumer dropped its generator handle: release interim holders on
+        unconsumed items and cancel the producer if it is still running
+        (reference: streaming-generator deletion cancels the task)."""
+        tid = TaskID(task_id_bytes)
+        rec = self.tasks.get(tid)
+        if rec is None:
+            return False
+        rec.stream_released = True
+        gh = self._gen_holder(tid)
+        for m in list(rec.stream_metas):
+            self._rel_holder(m.object_id.binary(), gh)
+        if rec.state in ("PENDING", "RUNNING") and rec.spec.actor_id is None:
+            self._cmd_cancel((tid, True))
+        return True
 
     # ------------------------------------------------------------------ objects
     def _seal_object(self, meta: ObjectMeta):
@@ -1215,11 +1356,25 @@ class Scheduler:
         """A process died or disconnected: release every ref it held."""
         for key in [k for k, hs in self.holders.items() if holder in hs]:
             self._rel_holder(key, holder)
+        # Streams whose consumer was this process: release interim gen holders
+        # (the consumer can never ask for the items now).
+        for rec in [r for r in self.tasks.values() if r.stream_owner == holder]:
+            if rec.spec.returns_mode is not None and not rec.stream_released:
+                self._release_stream(rec.spec.task_id.binary())
 
     def _apply_ref_ops(self, ops: List[Tuple[str, bytes]], holder: str):
         for op, key in ops:
             if op == "add":
                 self._add_holder(key, holder)
+            elif op == "genrel":
+                # Consumer took its own reference to a streamed item (the "add"
+                # precedes this op in the same FIFO batch): drop the interim
+                # generator holder.
+                self._rel_holder(key, self._gen_holder(ObjectID(key).task_id))
+            elif op == "srel":
+                # Consumer dropped its ObjectRefGenerator handle (key is the
+                # producer TASK id): release unconsumed items, cancel if live.
+                self._release_stream(key)
             else:
                 self._rel_holder(key, holder)
 
@@ -1325,18 +1480,31 @@ class Scheduler:
 
     def _store_error_results(self, rec: TaskRecord, err: Exception):
         sv = serialization.serialize(err)
-        for oid in rec.return_ids:
-            meta = ObjectMeta(
+
+        def err_meta(oid: ObjectID) -> ObjectMeta:
+            return ObjectMeta(
                 object_id=oid,
                 size=sv.total_size,
                 inband=sv.inband,
                 inline_buffers=[bytes(b) for b in sv.buffers],
                 is_error=True,
             )
-            self._seal_object(meta)
+
+        if rec.spec.returns_mode == "streaming":
+            # Don't clobber already-streamed items (reference streaming-
+            # generator error semantics).
+            self._seal_stream_error(rec, err_meta)
+        elif rec.spec.returns_mode == "dynamic":
+            # The outer handle ref carries the error; partial items are dropped.
+            self._seal_object(err_meta(rec.return_ids[0]))
+        else:
+            for oid in rec.return_ids:
+                self._seal_object(err_meta(oid))
         rec.state = "FAILED"
         self._release_task_pins(rec)
         self._record_event(rec.spec, "FAILED")
+        if rec.spec.returns_mode is not None:
+            self._finalize_stream(rec)
 
     # The in-process driver's holder identity for refcounting.
     _INPROC_DRIVER = "driver0"
@@ -1349,6 +1517,8 @@ class Scheduler:
     def _cmd_submit(self, payload):
         rec: TaskRecord = payload
         self._register_return_holders(rec.return_ids, self._INPROC_DRIVER)
+        if rec.spec.returns_mode is not None:
+            rec.stream_owner = self._INPROC_DRIVER
         self._register_task(rec)
         return [oid for oid in rec.return_ids]
 
@@ -1635,13 +1805,15 @@ class Scheduler:
         if rec.func_blob is not None:
             self.gcs.function_table.setdefault(rec.spec.func.function_id, rec.func_blob)
         self._register_return_holders(rec.return_ids, self._holder_of(wh))
+        if rec.spec.returns_mode is not None:
+            rec.stream_owner = self._holder_of(wh)
         self._register_task(rec)
         self._respond(wh, req_id, True, True)
 
     def _req_submit_actor_task(self, wh: WorkerHandle, req_id: int, payload):
         req: ExecRequest = payload
         self._register_return_holders(req.return_ids, self._holder_of(wh))
-        self._submit_actor_task(req)
+        self._submit_actor_task(req, owner=self._holder_of(wh))
         self._respond(wh, req_id, True, True)
 
     def _req_put_meta(self, wh: WorkerHandle, req_id: int, meta: ObjectMeta):
@@ -1889,6 +2061,13 @@ class Scheduler:
             func_blob=rec.func_blob,
             retries_left=self.config.task_max_retries,
         )
+        # Generator tasks: carry the stream state over, so the replayed items
+        # take the reseal branch of _on_stream_item (no duplicate return-id
+        # appends, no fresh gen holders on an already-consumed stream).
+        clone.stream_metas = rec.stream_metas
+        clone.stream_total = rec.stream_total
+        clone.stream_owner = rec.stream_owner
+        clone.stream_released = rec.stream_released
         # Recursively restore lost dependencies first (lineage chain). A dep
         # that cannot be reconstructed fails THIS object's waiters immediately
         # instead of leaving them to hit the pull timeout. Deps whose
@@ -2008,7 +2187,7 @@ class Scheduler:
                 self.lineage_consumers[d] = self.lineage_consumers.get(d, 0) + 1
         self.pending.append(rec)
 
-    def _submit_actor_task(self, req: ExecRequest):
+    def _submit_actor_task(self, req: ExecRequest, owner: Optional[str] = None):
         from ray_tpu.exceptions import RayActorError
 
         spec = req.spec
@@ -2016,9 +2195,11 @@ class Scheduler:
             spec=spec,
             arg_entries=[],
             kwarg_entries={},
-            return_ids=req.return_ids,
+            return_ids=list(req.return_ids),
             func_blob=None,
         )
+        if spec.returns_mode is not None:
+            rec.stream_owner = owner or self._INPROC_DRIVER
         # Pin dependencies (and refs nested in by-value args) until terminal.
         entries = list(getattr(req, "_arg_entries", None) or []) + list(
             (getattr(req, "_kwarg_entries", None) or {}).values()
@@ -2354,10 +2535,18 @@ class Scheduler:
         kw = {key: (self.object_table[v] if k == "id" else v) for key, (k, v) in rec.kwarg_entries.items()}
         err = next((m for m in list(metas) + list(kw.values()) if m.is_error), None)
         if err is not None:
-            for oid in rec.return_ids:
-                self._seal_object(self._alias_error_meta(oid, err))
+            if rec.spec.returns_mode == "streaming":
+                # Dependency error surfaces as the first (and only) stream item.
+                self._seal_stream_error(rec, lambda oid: self._alias_error_meta(oid, err))
+            elif rec.spec.returns_mode == "dynamic":
+                self._seal_object(self._alias_error_meta(rec.return_ids[0], err))
+            else:
+                for oid in rec.return_ids:
+                    self._seal_object(self._alias_error_meta(oid, err))
             rec.state = "FAILED"
             self._release_task_pins(rec)
+            if rec.spec.returns_mode is not None:
+                self._finalize_stream(rec)
             return True
         # 2) actor creation: dedicated worker + resources
         if rec.spec.is_actor_creation:

@@ -55,6 +55,11 @@ class _RefTracker:
         # holds self._lock. So __del__ only does a lock-free deque append
         # (atomic in CPython); the bookkeeping happens later in drain().
         self._dead: "collections.deque[bytes]" = collections.deque()
+        # Same GC-safety constraint for ObjectRefGenerator.__del__: stream
+        # releases queue lock-free and ride the next ref-ops flush instead of
+        # making a blocking RPC from GC context (which could deadlock on the
+        # connection's non-reentrant locks or the scheduler event thread).
+        self._dead_streams: "collections.deque[bytes]" = collections.deque()
 
     def incref(self, key: bytes) -> None:
         with self._lock:
@@ -80,9 +85,25 @@ class _RefTracker:
             else:
                 self._counts[key] = n
 
+    def gen_release(self, key: bytes) -> None:
+        """Queue a release of the scheduler's interim generator holder for a
+        streamed item, AFTER this process's own incref in the same FIFO batch
+        (so the object is never holderless in between)."""
+        with self._lock:
+            self._ops.append(("genrel", key))
+
+    def stream_release(self, task_id_bytes: bytes) -> None:
+        # GC-safe: no lock (see _dead_streams in __init__).
+        self._dead_streams.append(task_id_bytes)
+
     def drain(self) -> List[Tuple[str, bytes]]:
         with self._lock:
             self._apply_dead_locked()
+            while True:
+                try:
+                    self._ops.append(("srel", self._dead_streams.popleft()))
+                except IndexError:
+                    break
             ops, self._ops = self._ops, []
             return ops
 
@@ -91,6 +112,7 @@ class _RefTracker:
             self._counts.clear()
             self._ops.clear()
             self._dead.clear()
+            self._dead_streams.clear()
 
 
 _ref_tracker = _RefTracker()
@@ -188,6 +210,105 @@ class ObjectRef:
 
         loop = asyncio.get_event_loop()
         return loop.run_in_executor(None, lambda: get(self)).__await__()
+
+
+class DynamicObjectRefGenerator:
+    """The value a `num_returns="dynamic"` task resolves to: a picklable,
+    re-iterable sequence of the refs the task yielded (reference:
+    `python/ray/_raylet.pyx:174 DynamicObjectRefGenerator`)."""
+
+    def __init__(self, refs: List["ObjectRef"]):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self) -> int:
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self):
+        return f"DynamicObjectRefGenerator({len(self._refs)} refs)"
+
+
+class ObjectRefGenerator:
+    """Caller-side handle for a `num_returns="streaming"` generator task:
+    `next()` blocks until the worker seals the next yielded item, before the
+    task finishes (reference: `_raylet.pyx ObjectRefGenerator` /
+    `StreamingObjectRefGenerator`). Owner-only: not serializable."""
+
+    def __init__(self, task_id: TaskID):
+        self._task_id = task_id
+        self._index = 0
+        self._total: Optional[int] = None
+        self._released = False
+
+    @property
+    def task_id(self) -> TaskID:
+        return self._task_id
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        return self._next_internal(timeout=None)
+
+    def _next_internal(self, timeout: Optional[float]) -> "ObjectRef":
+        if self._total is not None and self._index >= self._total:
+            raise StopIteration
+        ctx = global_worker.context
+        if ctx is None:
+            raise RuntimeError("ray_tpu is not initialized")
+        kind, payload = ctx.stream_next(self._task_id.binary(), self._index, timeout)
+        if kind == "eof":
+            self._total = payload
+            if self._index >= self._total:
+                raise StopIteration
+            # Items exist but we were answered eof (record raced away): re-ask.
+            kind, payload = ctx.stream_next(self._task_id.binary(), self._index, timeout)
+            if kind == "eof":
+                raise StopIteration
+        meta: ObjectMeta = payload
+        ref = ObjectRef(meta.object_id)
+        # Take over from the scheduler's interim holder (ordered after our add).
+        _ref_tracker.gen_release(meta.object_id.binary())
+        self._index += 1
+        return ref
+
+    def next_ready(self, timeout: Optional[float] = None) -> "ObjectRef":
+        """`__next__` with a timeout; raises GetTimeoutError on expiry."""
+        return self._next_internal(timeout)
+
+    def completed(self) -> bool:
+        return self._total is not None and self._index >= self._total
+
+    def close(self) -> None:
+        """Release unconsumed items and cancel the producer if still running.
+        The release rides the ref-ops queue (flushed within ~0.1s); an explicit
+        close() also flushes immediately."""
+        if self._released:
+            return
+        self._released = True
+        _ref_tracker.stream_release(self._task_id.binary())
+        flush_ref_ops()
+
+    def __del__(self):
+        # GC context: queue only — a blocking RPC here can deadlock on the
+        # connection locks or the scheduler event thread (see _RefTracker).
+        if not self._released:
+            self._released = True
+            try:
+                _ref_tracker.stream_release(self._task_id.binary())
+            except Exception:
+                pass  # interpreter teardown
+
+    def __reduce__(self):
+        raise TypeError(
+            "ObjectRefGenerator is owner-only and cannot be serialized; pass "
+            "the individual ObjectRefs it yields instead."
+        )
 
 
 class _WorkerState:
@@ -336,6 +457,16 @@ class DriverContext:
 
     def ref_ops(self, ops):
         self.scheduler.call("ref_ops", (ops, None)).result()
+
+    def stream_next(self, task_id_bytes: bytes, index: int, timeout: Optional[float] = None):
+        inner: concurrent.futures.Future = concurrent.futures.Future()
+        self.scheduler.call("stream_next", (task_id_bytes, index, inner)).result()
+        try:
+            return inner.result(timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            raise exceptions.GetTimeoutError(
+                f"stream_next timed out after {timeout}s"
+            ) from None
 
     def reconstruct_object(self, key: bytes) -> ObjectMeta:
         inner: concurrent.futures.Future = concurrent.futures.Future()
@@ -496,6 +627,14 @@ class RemoteDriverContext:
     def ref_ops(self, ops):
         self.wc.send(("ref_ops", ops))
 
+    def stream_next(self, task_id_bytes: bytes, index: int, timeout=None):
+        try:
+            return self.wc.request("stream_next", (task_id_bytes, index), timeout=timeout)
+        except TimeoutError:
+            raise exceptions.GetTimeoutError(
+                f"stream_next timed out after {timeout}s"
+            ) from None
+
     def reconstruct_object(self, key: bytes) -> ObjectMeta:
         return self.wc.request(
             "reconstruct_object", key, timeout=get_config().object_pull_timeout_s
@@ -608,6 +747,14 @@ class WorkerProcContext:
 
     def ref_ops(self, ops):
         self.rt.wc.send(("ref_ops", ops))
+
+    def stream_next(self, task_id_bytes: bytes, index: int, timeout=None):
+        try:
+            return self.rt.wc.request("stream_next", (task_id_bytes, index), timeout=timeout)
+        except TimeoutError:
+            raise exceptions.GetTimeoutError(
+                f"stream_next timed out after {timeout}s"
+            ) from None
 
     def reconstruct_object(self, key: bytes) -> ObjectMeta:
         return self.rt.wc.request(

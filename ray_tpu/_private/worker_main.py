@@ -22,7 +22,7 @@ from typing import Any, Dict, Optional
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import Config, set_config
-from ray_tpu._private.ids import ActorID, TaskID, WorkerID
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import LocalObjectStore, ObjectMeta
 from ray_tpu._private.protocol import ExecRequest
 
@@ -144,6 +144,10 @@ class WorkerRuntime:
         self._aio_lock = threading.Lock()
         # Set when runtime_env provisioning failed: every task errors with it.
         self.setup_error: Optional[BaseException] = None
+        # Per-task streamed-item count (generator tasks), keyed by task id
+        # bytes: the error path seals the failure at the right stream index.
+        # A dict (not a scalar) because threaded actors execute concurrently.
+        self.stream_progress: Dict[bytes, int] = {}
 
     def next_put_index(self) -> int:
         self._put_counter += 1
@@ -219,6 +223,51 @@ class WorkerRuntime:
         return fn
 
 
+def _run_generator(rt: WorkerRuntime, req: ExecRequest, out, progress: Dict[bytes, int]):
+    """Drive a generator task: seal each yielded value as its own object and
+    report it to the control plane immediately, so consumers can read items
+    before the task finishes (reference: streaming generator returns,
+    `core_worker/task_manager.cc HandleReportGeneratorItemReturns`).
+
+    Returns the ObjectIDs of the yielded items. Exceptions from the user
+    generator propagate to the caller with `progress` holding the failing
+    index."""
+    import inspect
+
+    spec = req.spec
+    cfg = rt.args.config
+    if inspect.isasyncgen(out):
+        agen = out
+
+        def _drive(ag):
+            while True:
+                try:
+                    yield rt.run_coroutine(ag.__anext__())
+                except StopAsyncIteration:
+                    return
+
+        out = _drive(agen)
+    if not hasattr(out, "__iter__") and not hasattr(out, "__next__"):
+        raise TypeError(
+            f"Task {spec.name or spec.func.name} declared "
+            f"num_returns={spec.returns_mode!r} but returned a non-iterable "
+            f"{type(out).__name__}"
+        )
+    # Item object ids start at index 2 for "dynamic" (index 1 is the handle
+    # the outer ObjectRef resolves to) and at 1 for "streaming".
+    base = 2 if spec.returns_mode == "dynamic" else 1
+    key = spec.task_id.binary()
+    item_oids = []
+    for v in out:
+        oid = ObjectID.for_return(spec.task_id, base + len(item_oids))
+        sv = serialization.serialize(v)
+        meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
+        rt.wc.send(("stream", key, len(item_oids), meta))
+        item_oids.append(oid)
+        progress[key] = len(item_oids)
+    return item_oids
+
+
 def _execute(rt: WorkerRuntime, req: ExecRequest):
     from ray_tpu import exceptions
     from ray_tpu._private import worker as worker_mod
@@ -277,6 +326,17 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
         n = spec.num_returns
         if spec.is_actor_creation:
             values = []
+        elif spec.returns_mode is not None:
+            item_oids = _run_generator(rt, req, out, rt.stream_progress)
+            if spec.returns_mode == "dynamic":
+                # The outer ref resolves to a picklable generator of the item
+                # refs; pickling notes them as contained ids, which pins the
+                # items to the handle's lifetime.
+                values = [worker_mod.DynamicObjectRefGenerator(
+                    [worker_mod.ObjectRef(oid) for oid in item_oids]
+                )]
+            else:
+                values = []
         elif n == 1:
             values = [out]
         elif n == 0:
@@ -318,10 +378,22 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
             sv = serialization.serialize(
                 exceptions.RayTaskError(spec.func.name, tb, None, os.getpid())
             )
-        for oid in req.return_ids:
+        if spec.returns_mode == "streaming":
+            # Error becomes the NEXT stream item, so the consumer raises at
+            # exactly the point the producer stopped.
+            idx = rt.stream_progress.get(spec.task_id.binary(), 0)
+            oid = ObjectID.for_return(spec.task_id, 1 + idx)
             meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
             meta.is_error = True
-            metas.append(meta)
+            rt.wc.send(("stream", spec.task_id.binary(), idx, meta))
+        else:
+            # For "dynamic", return_ids[0] is the outer handle: the error
+            # surfaces on the caller's single ObjectRef.
+            targets = req.return_ids[:1] if spec.returns_mode else req.return_ids
+            for oid in targets:
+                meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
+                meta.is_error = True
+                metas.append(meta)
         worker_mod.flush_ref_ops()
         rt.wc.send(("done", spec.task_id.binary(), False, metas))
     finally:
@@ -329,6 +401,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
             from ray_tpu.util import tracing
 
             tracing.end_span(exec_span)
+        rt.stream_progress.pop(spec.task_id.binary(), None)
         rt.current_task_id = None
 
 

@@ -84,12 +84,21 @@ class ActorHandle:
     def __eq__(self, other):
         return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
 
-    def _actor_method_call(self, method_name: str, args, kwargs, num_returns: int):
+    def _actor_method_call(self, method_name: str, args, kwargs, num_returns):
+        returns_mode = None
+        if num_returns in ("dynamic", "streaming"):
+            # Generator actor method (sync generators, or `async def` methods
+            # yielding via an async generator — the basis of Serve streaming
+            # responses; reference: `_raylet.pyx` streaming generator actor
+            # tasks).
+            returns_mode = num_returns
+            num_returns = 1 if returns_mode == "dynamic" else 0
         task_id = global_worker.next_task_id()
         spec = TaskSpec(
             task_id=task_id,
             func=FunctionDescriptor("", method_name),
             num_returns=num_returns,
+            returns_mode=returns_mode,
             actor_id=self._actor_id,
             method_name=method_name,
             name=f"{self._class_name}.{method_name}",
@@ -116,6 +125,8 @@ class ActorHandle:
         finally:
             if submit_span is not None:
                 tracing.end_span(submit_span)
+        if returns_mode == "streaming":
+            return worker_mod.ObjectRefGenerator(task_id)
         refs = [ObjectRef(oid) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 

@@ -108,13 +108,25 @@ class RemoteFunction:
     def _remote(self, args, kwargs, opts):
         worker_mod._auto_init()
         self._ensure_pickled()
-        num_returns = int(opts.get("num_returns", 1))
+        nr = opts.get("num_returns", 1)
+        returns_mode = None
+        if nr in ("dynamic", "streaming"):
+            # Generator task (reference: `num_returns="dynamic"` in
+            # `python/ray/remote_function.py`, streaming generators in
+            # `_raylet.pyx`): "dynamic" returns one ref resolving to a
+            # DynamicObjectRefGenerator; "streaming" returns an
+            # ObjectRefGenerator whose items arrive incrementally.
+            returns_mode = nr
+            num_returns = 1 if nr == "dynamic" else 0
+        else:
+            num_returns = int(nr)
         task_id = global_worker.next_task_id()
         renv = dict(opts.get("runtime_env") or {})
         spec = TaskSpec(
             task_id=task_id,
             func=FunctionDescriptor(self._function_id, self.__name__),
             num_returns=num_returns,
+            returns_mode=returns_mode,
             resources=_resources_from_options(opts, default_cpus=1.0),
             max_retries=int(opts.get("max_retries", 0)),
             name=opts.get("name") or self.__name__,
@@ -158,6 +170,8 @@ class RemoteFunction:
             # later span on this thread (and never flush this one).
             if submit_span is not None:
                 tracing.end_span(submit_span)
+        if returns_mode == "streaming":
+            return worker_mod.ObjectRefGenerator(task_id)
         refs = [ObjectRef(oid) for oid in return_ids]
         if num_returns == 1:
             return refs[0]

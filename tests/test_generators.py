@@ -1,0 +1,265 @@
+"""Generator tasks: `num_returns="dynamic"` and `num_returns="streaming"`.
+
+Modeled on the reference's `python/ray/tests/test_generators.py` and
+`test_streaming_generator.py` (semantics: `_raylet.pyx:174 ObjectRefGenerator`).
+Runs against both the in-process control plane and a head-server process.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from conftest import head_process_runtime
+
+
+@pytest.fixture(params=["inproc", "head_process"])
+def ray_start_regular(request):
+    if request.param == "inproc":
+        ctx = ray_tpu.init(num_cpus=4)
+        yield ctx
+        ray_tpu.shutdown()
+    else:
+        with head_process_runtime(num_cpus=4) as ctx:
+            yield ctx
+
+
+@pytest.fixture
+def ray_inproc():
+    ctx = ray_tpu.init(num_cpus=4)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------------- dynamic
+def test_dynamic_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    def f(n):
+        for i in range(n):
+            yield i * i
+
+    ref = f.options(num_returns="dynamic").remote(5)
+    gen = ray_tpu.get(ref)
+    assert isinstance(gen, ray_tpu.DynamicObjectRefGenerator)
+    assert len(gen) == 5
+    assert [ray_tpu.get(r) for r in gen] == [0, 1, 4, 9, 16]
+    # Re-iterable (unlike a streaming generator).
+    assert [ray_tpu.get(r) for r in gen] == [0, 1, 4, 9, 16]
+
+
+def test_dynamic_zero_items(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return iter(())
+
+    gen = ray_tpu.get(f.options(num_returns="dynamic").remote())
+    assert len(gen) == 0
+
+
+def test_dynamic_error_fails_outer_ref(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        yield 1
+        raise ValueError("boom mid-generator")
+
+    ref = f.options(num_returns="dynamic").remote()
+    with pytest.raises(ray_tpu.exceptions.RayTaskError, match="boom mid-generator"):
+        ray_tpu.get(ref)
+
+
+def test_dynamic_generator_passed_to_task(ray_start_regular):
+    @ray_tpu.remote
+    def produce():
+        yield np.arange(4)
+        yield np.arange(4) * 2
+
+    @ray_tpu.remote
+    def consume(gen):
+        return sum(int(ray_tpu.get(r).sum()) for r in gen)
+
+    gen_ref = produce.options(num_returns="dynamic").remote()
+    gen = ray_tpu.get(gen_ref)
+    assert ray_tpu.get(consume.remote(gen)) == 6 + 12
+
+
+# ------------------------------------------------------------------- streaming
+def test_streaming_basic(ray_start_regular):
+    @ray_tpu.remote
+    def f(n):
+        for i in range(n):
+            yield i + 100
+
+    gen = f.options(num_returns="streaming").remote(4)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    out = [ray_tpu.get(ref) for ref in gen]
+    assert out == [100, 101, 102, 103]
+    assert gen.completed()
+
+
+def test_streaming_items_arrive_before_task_finishes(ray_start_regular):
+    @ray_tpu.remote
+    def slow(n):
+        for i in range(n):
+            yield i
+            time.sleep(0.4)
+
+    gen = slow.options(num_returns="streaming").remote(5)
+    t0 = time.time()
+    first = ray_tpu.get(next(gen))
+    first_latency = time.time() - t0
+    assert first == 0
+    # The task takes ~2s total; the first item must arrive far earlier.
+    assert first_latency < 1.2, f"first item took {first_latency:.2f}s"
+    rest = [ray_tpu.get(r) for r in gen]
+    assert rest == [1, 2, 3, 4]
+
+
+def test_streaming_error_surfaces_at_failing_index(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        yield "a"
+        yield "b"
+        raise RuntimeError("producer exploded")
+
+    gen = f.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(gen)) == "a"
+    assert ray_tpu.get(next(gen)) == "b"
+    with pytest.raises(ray_tpu.exceptions.RayTaskError, match="producer exploded"):
+        ray_tpu.get(next(gen))
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_streaming_immediate_error(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        raise RuntimeError("no items at all")
+        yield  # noqa — makes it a generator function
+
+    gen = f.options(num_returns="streaming").remote()
+    with pytest.raises(ray_tpu.exceptions.RayTaskError, match="no items at all"):
+        ray_tpu.get(next(gen))
+
+
+def test_streaming_non_generator_return_errors(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 42  # not iterable
+
+    gen = f.options(num_returns="streaming").remote()
+    with pytest.raises(ray_tpu.exceptions.RayTaskError, match="non-iterable"):
+        ray_tpu.get(next(gen))
+
+
+def test_streaming_not_picklable(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        yield 1
+
+    gen = f.options(num_returns="streaming").remote()
+    with pytest.raises(TypeError, match="owner-only"):
+        import pickle
+
+        pickle.dumps(gen)
+    list(gen)
+
+
+def test_streaming_actor_method(ray_start_regular):
+    @ray_tpu.remote
+    class Producer:
+        def __init__(self):
+            self.calls = 0
+
+        def stream(self, n):
+            self.calls += 1
+            for i in range(n):
+                yield {"i": i, "call": self.calls}
+
+        def ping(self):
+            return "pong"
+
+    a = Producer.remote()
+    gen = a.stream.options(num_returns="streaming").remote(3)
+    items = [ray_tpu.get(r) for r in gen]
+    assert [it["i"] for it in items] == [0, 1, 2]
+    # Actor still serves normal calls afterwards.
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+
+def test_streaming_async_actor_generator(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncProducer:
+        async def stream(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * 10
+
+    a = AsyncProducer.remote()
+    gen = a.stream.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r) for r in gen] == [0, 10, 20]
+
+
+def test_streaming_large_arrays_zero_copy(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        for i in range(3):
+            yield np.full((256, 1024), i, dtype=np.float32)
+
+    gen = f.options(num_returns="streaming").remote()
+    for i, ref in enumerate(gen):
+        arr = ray_tpu.get(ref)
+        assert arr.shape == (256, 1024)
+        assert float(arr[0, 0]) == float(i)
+        del arr
+    del ref
+
+
+# --------------------------------------------------- lifecycle (inproc only)
+def test_streaming_release_frees_unconsumed(ray_inproc):
+    @ray_tpu.remote
+    def f():
+        for i in range(4):
+            yield np.zeros(200_000, dtype=np.float64)  # 1.6MB each
+
+    gen = f.options(num_returns="streaming").remote()
+    first = next(gen)
+    _ = ray_tpu.get(first)
+    # Let the producer finish sealing all items.
+    time.sleep(1.0)
+    sched = ray_tpu._private.worker.global_worker.node
+    task_key = gen.task_id.binary()
+    rec = sched.tasks.get(ray_tpu._private.ids.TaskID(task_key))
+    assert rec is not None and len(rec.stream_metas) == 4
+    # Drop the generator without consuming items 1-3: interim holders release
+    # and the unconsumed objects free; the consumed one survives via `first`.
+    gen.close()
+    del gen
+    time.sleep(0.5)
+    fut = sched.call("list_objects", 100)
+    objs = fut.result()
+    live_keys = {o["object_id"] for o in objs}
+    assert first.hex() in live_keys
+    # Unconsumed items are gone.
+    streamed_hex = [m.object_id.hex() for m in rec.stream_metas]
+    for h in streamed_hex[1:]:
+        assert h not in live_keys
+    del first
+
+
+def test_streaming_worker_consumes_stream(ray_start_regular):
+    """A task can consume another task's stream (worker-side stream_next)."""
+
+    @ray_tpu.remote
+    def produce(n):
+        for i in range(n):
+            yield i + 1
+
+    @ray_tpu.remote
+    def fan_in():
+        gen = produce.options(num_returns="streaming").remote(4)
+        return sum(ray_tpu.get(r) for r in gen)
+
+    assert ray_tpu.get(fan_in.remote()) == 10
