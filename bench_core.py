@@ -105,6 +105,29 @@ def main():
 
     results.append(timeit("actor_call_throughput_async", actor_async, 3000))
 
+    # ------------------------------------------------------------ data ingest
+    # Streaming-executor ingest (the reference's bulk-ingest benchmark,
+    # BASELINE.md "data ingest"): read -> map -> consume through iter_batches
+    # with production overlapping consumption under the memory budget.
+    from ray_tpu import data as rd
+
+    block_rows, n_blocks = 20_000, 24
+    bytes_per_row = 100 * 8
+    total_gb = block_rows * n_blocks * bytes_per_row / 1e9
+
+    def ingest(_n):
+        ds = rd.range_tensor(
+            block_rows * n_blocks, shape=(100,), parallelism=n_blocks
+        ).map_batches(lambda b: {"data": b["data"] * 2.0})
+        rows = 0
+        for batch in ds.iter_batches(batch_size=None, prefetch_blocks=4):
+            rows += len(batch["data"])
+        assert rows == block_rows * n_blocks
+
+    results.append(
+        timeit("data_ingest_streaming", ingest, 1, unit="GB/s", scale=total_gb)
+    )
+
     ray_tpu.shutdown()
 
     width = max(len(r["metric"]) for r in results) + 2

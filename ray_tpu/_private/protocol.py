@@ -56,6 +56,10 @@ class TaskSpec:
     #                 become consumable as the worker seals them, before the
     #                 task finishes.
     returns_mode: Optional[str] = None
+    # For streaming tasks: the producer pauses when it is more than this many
+    # items ahead of the consumer (reference:
+    # `_generator_backpressure_num_objects` in `_raylet.pyx`). None = unbounded.
+    generator_backpressure: Optional[int] = None
     resources: Dict[str, float] = field(default_factory=dict)
     max_retries: int = 0
     # Actor fields

@@ -248,6 +248,10 @@ class TaskRecord:
     stream_total: Optional[int] = None
     stream_owner: Optional[str] = None
     stream_released: bool = False
+    # Generator backpressure: highest item index the consumer has asked for,
+    # and producers parked until the consumer catches up (threshold, respond).
+    stream_requested: int = -1
+    throttle_waiters: List[Tuple[int, Callable]] = field(default_factory=list)
 
 
 @dataclass
@@ -1098,6 +1102,7 @@ class Scheduler:
                 self._rel_holder(m.object_id.binary(), gh)
         if rec.stream_total is None:
             rec.stream_total = len(rec.stream_metas)
+        self._wake_throttled(rec, flush_all=True)
         n = len(rec.stream_metas)
         waiters, rec.stream_waiters = rec.stream_waiters, []
         for want, fut in waiters:
@@ -1121,27 +1126,72 @@ class Scheduler:
         rec.stream_metas.append(m)
         rec.return_ids.append(oid)
 
-    def _async_stream_next(self, task_id_bytes: bytes, index: int, fut):
+    def _async_stream_next(self, task_id_bytes: bytes, index: int, fut, blocking: bool = True):
         rec = self.tasks.get(TaskID(task_id_bytes))
         if rec is None:
             # Record evicted (cancelled or fully GC'd): the stream is over.
             fut.set_result(("eof", index))
             return
+        if index > rec.stream_requested:
+            rec.stream_requested = index
+            self._wake_throttled(rec)
         if index < len(rec.stream_metas):
             fut.set_result(("item", rec.stream_metas[index]))
             return
         if rec.stream_total is not None or rec.state in ("FINISHED", "FAILED", "CANCELLED"):
             fut.set_result(("eof", len(rec.stream_metas)))
             return
+        if not blocking:
+            # Poller (e.g. the Data streaming executor): answer immediately
+            # instead of parking a waiter per poll.
+            fut.set_result(("pending", None))
+            return
         rec.stream_waiters.append((index, fut))
 
+    def _wake_throttled(self, rec: TaskRecord, flush_all: bool = False):
+        """Un-park producers waiting for the consumer to catch up. A released
+        stream answers "stop": the producer abandons the generator gracefully
+        (no worker kill, the process returns to the idle pool)."""
+        if not rec.throttle_waiters:
+            return
+        verdict = "stop" if rec.stream_released else "go"
+        still = []
+        for threshold, respond in rec.throttle_waiters:
+            if flush_all or rec.stream_requested >= threshold:
+                respond(verdict)
+            else:
+                still.append((threshold, respond))
+        rec.throttle_waiters = still
+
+    def _req_stream_throttle(self, wh, req_id: int, payload):
+        """Producer-side backpressure: block until the consumer has requested
+        item `threshold` (i.e. the producer is within its window again), the
+        stream is released ("stop"), or the record is gone."""
+        task_id_bytes, threshold = payload
+        rec = self.tasks.get(TaskID(task_id_bytes))
+        if rec is None or rec.stream_released:
+            self._respond(wh, req_id, True, "stop")
+            return
+        if rec.stream_requested >= threshold:
+            self._respond(wh, req_id, True, "go")
+            return
+        self._mark_blocked(wh)
+
+        def respond(verdict):
+            self._unmark_blocked(wh)
+            self._respond(wh, req_id, True, verdict)
+
+        rec.throttle_waiters.append((threshold, respond))
+
     def _cmd_stream_next(self, payload):
-        task_id_bytes, index, fut = payload
-        self._async_stream_next(task_id_bytes, index, fut)
+        task_id_bytes, index, fut = payload[:3]
+        blocking = payload[3] if len(payload) > 3 else True
+        self._async_stream_next(task_id_bytes, index, fut, blocking)
         return _ASYNC
 
     def _req_stream_next(self, wh, req_id: int, payload):
-        task_id_bytes, index = payload
+        task_id_bytes, index = payload[:2]
+        blocking = payload[2] if len(payload) > 2 else True
         self._mark_blocked(wh)
 
         def done(result):
@@ -1150,22 +1200,27 @@ class Scheduler:
 
         fut = concurrent.futures.Future()
         fut.add_done_callback(lambda f: done(f.result()))
-        self._async_stream_next(task_id_bytes, index, fut)
+        self._async_stream_next(task_id_bytes, index, fut, blocking)
 
     def _release_stream(self, task_id_bytes: bytes):
         """Consumer dropped its generator handle: release interim holders on
-        unconsumed items and cancel the producer if it is still running
-        (reference: streaming-generator deletion cancels the task)."""
+        unconsumed items and stop the producer. A PENDING producer is
+        cancelled outright; a RUNNING one is stopped COOPERATIVELY — its next
+        throttle checkpoint answers "stop" and the worker abandons the
+        generator and returns to the idle pool (the reference cancels
+        generator tasks similarly without killing the worker; a SIGKILL here
+        would pay a process respawn on every `take()`/early loop exit)."""
         tid = TaskID(task_id_bytes)
         rec = self.tasks.get(tid)
         if rec is None:
             return False
         rec.stream_released = True
+        self._wake_throttled(rec, flush_all=True)
         gh = self._gen_holder(tid)
         for m in list(rec.stream_metas):
             self._rel_holder(m.object_id.binary(), gh)
-        if rec.state in ("PENDING", "RUNNING") and rec.spec.actor_id is None:
-            self._cmd_cancel((tid, True))
+        if rec.state == "PENDING" and rec.spec.actor_id is None:
+            self._cmd_cancel((tid, False))
         return True
 
     # ------------------------------------------------------------------ objects

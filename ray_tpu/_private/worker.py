@@ -255,13 +255,17 @@ class ObjectRefGenerator:
     def __next__(self) -> "ObjectRef":
         return self._next_internal(timeout=None)
 
-    def _next_internal(self, timeout: Optional[float]) -> "ObjectRef":
+    def _next_internal(self, timeout: Optional[float], blocking: bool = True) -> "ObjectRef":
         if self._total is not None and self._index >= self._total:
             raise StopIteration
         ctx = global_worker.context
         if ctx is None:
             raise RuntimeError("ray_tpu is not initialized")
-        kind, payload = ctx.stream_next(self._task_id.binary(), self._index, timeout)
+        kind, payload = ctx.stream_next(
+            self._task_id.binary(), self._index, timeout, blocking
+        )
+        if kind == "pending":
+            raise exceptions.GetTimeoutError("stream item not produced yet")
         if kind == "eof":
             self._total = payload
             if self._index >= self._total:
@@ -278,7 +282,11 @@ class ObjectRefGenerator:
         return ref
 
     def next_ready(self, timeout: Optional[float] = None) -> "ObjectRef":
-        """`__next__` with a timeout; raises GetTimeoutError on expiry."""
+        """`__next__` with a timeout; raises GetTimeoutError if no item is
+        available in time. timeout=0 is a pure non-blocking probe (one control
+        round-trip, no waiter parked)."""
+        if timeout is not None and timeout <= 0:
+            return self._next_internal(timeout=5.0, blocking=False)
         return self._next_internal(timeout)
 
     def completed(self) -> bool:
@@ -458,9 +466,12 @@ class DriverContext:
     def ref_ops(self, ops):
         self.scheduler.call("ref_ops", (ops, None)).result()
 
-    def stream_next(self, task_id_bytes: bytes, index: int, timeout: Optional[float] = None):
+    def stream_next(self, task_id_bytes: bytes, index: int,
+                    timeout: Optional[float] = None, blocking: bool = True):
         inner: concurrent.futures.Future = concurrent.futures.Future()
-        self.scheduler.call("stream_next", (task_id_bytes, index, inner)).result()
+        self.scheduler.call(
+            "stream_next", (task_id_bytes, index, inner, blocking)
+        ).result()
         try:
             return inner.result(timeout=timeout)
         except concurrent.futures.TimeoutError:
@@ -627,9 +638,12 @@ class RemoteDriverContext:
     def ref_ops(self, ops):
         self.wc.send(("ref_ops", ops))
 
-    def stream_next(self, task_id_bytes: bytes, index: int, timeout=None):
+    def stream_next(self, task_id_bytes: bytes, index: int,
+                    timeout=None, blocking: bool = True):
         try:
-            return self.wc.request("stream_next", (task_id_bytes, index), timeout=timeout)
+            return self.wc.request(
+                "stream_next", (task_id_bytes, index, blocking), timeout=timeout
+            )
         except TimeoutError:
             raise exceptions.GetTimeoutError(
                 f"stream_next timed out after {timeout}s"
@@ -748,9 +762,12 @@ class WorkerProcContext:
     def ref_ops(self, ops):
         self.rt.wc.send(("ref_ops", ops))
 
-    def stream_next(self, task_id_bytes: bytes, index: int, timeout=None):
+    def stream_next(self, task_id_bytes: bytes, index: int,
+                    timeout=None, blocking: bool = True):
         try:
-            return self.rt.wc.request("stream_next", (task_id_bytes, index), timeout=timeout)
+            return self.rt.wc.request(
+                "stream_next", (task_id_bytes, index, blocking), timeout=timeout
+            )
         except TimeoutError:
             raise exceptions.GetTimeoutError(
                 f"stream_next timed out after {timeout}s"

@@ -257,6 +257,7 @@ def _run_generator(rt: WorkerRuntime, req: ExecRequest, out, progress: Dict[byte
     # the outer ObjectRef resolves to) and at 1 for "streaming".
     base = 2 if spec.returns_mode == "dynamic" else 1
     key = spec.task_id.binary()
+    window = spec.generator_backpressure
     item_oids = []
     for v in out:
         oid = ObjectID.for_return(spec.task_id, base + len(item_oids))
@@ -265,6 +266,14 @@ def _run_generator(rt: WorkerRuntime, req: ExecRequest, out, progress: Dict[byte
         rt.wc.send(("stream", key, len(item_oids), meta))
         item_oids.append(oid)
         progress[key] = len(item_oids)
+        if window is not None and len(item_oids) >= window:
+            # Producer-side backpressure: pause until the consumer has asked
+            # for the item `window` positions back (bounds store growth for
+            # fast producers / slow consumers). "stop" means the consumer
+            # dropped the stream: abandon the generator gracefully.
+            verdict = rt.wc.request("stream_throttle", (key, len(item_oids) - window))
+            if verdict == "stop":
+                break
     return item_oids
 
 

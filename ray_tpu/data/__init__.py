@@ -12,6 +12,7 @@ with a sliding prefetch window; `split` feeds per-host Train ingest
 (`ray_tpu.air.session.get_dataset_shard`).
 """
 
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.read_api import (
     from_arrow,
@@ -29,6 +30,7 @@ from ray_tpu.data.read_api import (
 Datastream = Dataset  # the reference's short-lived rename (`dataset.py:169`)
 
 __all__ = [
+    "DataContext",
     "Dataset",
     "Datastream",
     "from_arrow",

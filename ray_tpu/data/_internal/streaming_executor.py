@@ -1,0 +1,641 @@
+"""Streaming execution engine: physical operators + a backpressured executor.
+
+The redesign of the reference's operator-graph executor
+(`/root/reference/python/ray/data/_internal/execution/streaming_executor.py:45`,
+`interfaces.py:246 PhysicalOperator`, `backpressure_policy/`): a Dataset's
+logical op chain compiles to a pipeline of physical operators
+
+    source (InputOperator | ReadOperator) -> MapOperator | ActorPoolMapOperator ...
+
+and a scheduling thread moves block bundles downstream, dispatching tasks
+under three budgets:
+
+  1. per-operator in-flight task cap (DataContext.max_tasks_per_operator),
+  2. per-operator output-queue cap (max_output_queue_blocks),
+  3. a GLOBAL bytes cap over produced-but-unconsumed blocks
+     (max_bytes_in_flight) — upstream dispatch pauses while the pipeline is
+     over budget, and streaming read generators additionally self-throttle
+     through the core's producer-side stream window.
+
+Unlike the reference (torch/Arrow blocks, gRPC actors), blocks here are
+dict-of-numpy destined for `jax.device_put`, tasks are ray_tpu generator /
+2-return tasks, and completion is detected through `ray_tpu.wait` on the
+small meta objects so block bytes are never fetched by the driver.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from queue import Empty, Full, Queue
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import _apply_chain, _remote
+
+
+@dataclass
+class BlockMeta:
+    """Small sidecar describing a block (reference: `BlockMetadata`)."""
+
+    num_rows: int
+    size_bytes: int
+
+
+@dataclass
+class RefBundle:
+    """A block ref + its (possibly unknown) metadata moving through the
+    pipeline (reference: `execution/interfaces.py RefBundle`)."""
+
+    block_ref: Any
+    meta: Optional[BlockMeta]
+
+    @property
+    def size_bytes(self) -> int:
+        return self.meta.size_bytes if self.meta else 0
+
+
+def _meta_of(block) -> BlockMeta:
+    acc = BlockAccessor(block)
+    return BlockMeta(acc.num_rows(), acc.size_bytes())
+
+
+# Remote task bodies — module-level so they pickle by value once per session.
+def _chain_task(block, chain):
+    out = _apply_chain(block, chain)
+    return out, _meta_of(out)
+
+
+def _read_stream(entries):
+    """Streaming read task: one (block, meta) pair of yields per entry.
+    Runs with a producer-side backpressure window, so a fast reader cannot
+    flood the object store ahead of consumption."""
+    for fn, args in entries:
+        block = fn(*args)
+        yield block
+        yield _meta_of(block)
+
+
+class _PoolWorker:
+    """Actor-pool map worker: constructs the UDF once (expensive state like
+    model weights), applies the fused chain per block."""
+
+    def __init__(self, fn, ctor_args, chain_tail):
+        self._fn = fn(*ctor_args) if isinstance(fn, type) else fn
+        self._tail = chain_tail
+
+    def apply(self, block, batch_size, batch_format):
+        out = _apply_chain(
+            block, [("map_batches", (self._fn, batch_size, batch_format))] + self._tail
+        )
+        return out, _meta_of(out)
+
+
+# ---------------------------------------------------------------------- operators
+class PhysicalOperator:
+    """One stage of the physical pipeline (reference:
+    `execution/interfaces.py:246 PhysicalOperator`). The executor feeds
+    bundles with `add_input`, polls completions with `poll`, and drains
+    `out_queue`."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.in_queue: deque = deque()
+        self.out_queue: deque = deque()
+        self.inputs_done = False
+        # Set by the executor: called with each emitted bundle so the global
+        # bytes budget updates IMMEDIATELY (a poll that pulls several blocks
+        # must see its own growth, or the budget overshoots by a poll's worth).
+        self.account: Optional[Callable[["RefBundle"], None]] = None
+        # Set by the executor: dispatch-time reservation of an in-flight
+        # task's expected output (≈ its input size), released at completion.
+        # Without it, N admitted tasks later emit N blocks ABOVE the budget.
+        self.reserve: Callable[[int], None] = lambda n: None
+        self.unreserve: Callable[[int], None] = lambda n: None
+        # Stats the backpressure tests and repr read.
+        self.tasks_submitted = 0
+        self.blocks_emitted = 0
+        self.max_tasks_in_flight_seen = 0
+
+    def _emit(self, bundle: RefBundle) -> None:
+        self.out_queue.append(bundle)
+        self.blocks_emitted += 1
+        if self.account is not None:
+            self.account(bundle)
+
+    def start(self, ctx: DataContext) -> None:
+        pass
+
+    def add_input(self, bundle: RefBundle) -> None:
+        self.in_queue.append(bundle)
+
+    def mark_inputs_done(self) -> None:
+        self.inputs_done = True
+
+    def num_active_tasks(self) -> int:
+        return 0
+
+    def poll(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
+        """Harvest finished work into out_queue; returns True on progress."""
+        return False
+
+    def dispatch(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
+        """Submit at most one unit of work; returns True on progress."""
+        return False
+
+    def completed(self) -> bool:
+        return (
+            self.inputs_done
+            and not self.in_queue
+            and self.num_active_tasks() == 0
+        )
+
+    def shutdown(self) -> None:
+        pass
+
+
+class InputOperator(PhysicalOperator):
+    """Source over pre-existing block refs (materialized/from_* datasets)."""
+
+    def __init__(self, bundles: List[RefBundle]):
+        super().__init__("Input")
+        self._pending = deque(bundles)
+        self.inputs_done = True
+
+    def poll(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
+        # Pre-existing refs: already materialized, so no budget GATE — but
+        # they must still be ACCOUNTED (via _emit): downstream moves and the
+        # consumer subtract unconditionally, and an unaccounted emission
+        # would drive the global counter negative, silently widening the
+        # budget for the rest of the pipeline.
+        progressed = False
+        while self._pending and len(self.out_queue) < ctx.max_output_queue_blocks:
+            self._emit(self._pending.popleft())
+            progressed = True
+        return progressed
+
+    def completed(self) -> bool:
+        return not self._pending
+
+
+class ReadOperator(PhysicalOperator):
+    """Source that runs streaming read tasks: each task is a generator
+    yielding (block, meta) pairs under a producer-side backpressure window
+    (the reference's read tasks + `_generator_backpressure_num_objects`)."""
+
+    def __init__(self, entries: List[Tuple[Callable, tuple]], name: str = "Read"):
+        super().__init__(name)
+        self._entries = list(entries)
+        self._gens: List[Optional[Any]] = []  # ObjectRefGenerator per group
+        self._next_seq = 0  # next entry index to emit (input order preserved)
+        # Block pulled but its meta sidecar not yet (transient stall): retried
+        # next poll so the block/meta alternation never desynchronizes.
+        self._pending_block: Optional[Any] = None
+        self._started = False
+        self.inputs_done = True
+
+    def start(self, ctx: DataContext) -> None:
+        if self._started:
+            return
+        self._started = True
+        if not self._entries:
+            return
+        n_tasks = max(1, min(len(self._entries), _default_task_cap(ctx)))
+        # Entry i goes to group i % n_tasks, so group g's j-th yield is entry
+        # g + j*n_tasks — emission below walks entries in order.
+        groups: List[List] = [[] for _ in range(n_tasks)]
+        for i, e in enumerate(self._entries):
+            groups[i % n_tasks].append(e)
+        window = max(1, ctx.read_generator_backpressure_blocks) * 2
+        read = _remote(_read_stream)
+        for g in groups:
+            self._gens.append(
+                read.options(
+                    num_returns="streaming", generator_backpressure=window
+                ).remote(g)
+            )
+            self.tasks_submitted += 1
+
+    def num_active_tasks(self) -> int:
+        return sum(1 for g in self._gens if g is not None)
+
+    def poll(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
+        progressed = False
+        while self._next_seq < len(self._entries):
+            # Pulling an item advances the producer's throttle window, so the
+            # queue cap + bytes budget gate the pull itself: a paused pull
+            # keeps the read task parked inside the core's stream throttle.
+            # Only the generator owning the NEXT entry is pulled (ordered
+            # emission); the others keep producing ahead inside their windows.
+            if len(self.out_queue) >= ctx.max_output_queue_blocks or not budget_ok():
+                break
+            gen = self._gens[self._next_seq % len(self._gens)]
+            if self._pending_block is None:
+                try:
+                    self._pending_block = gen.next_ready(timeout=0)
+                except ray_tpu.exceptions.GetTimeoutError:
+                    break
+                except StopIteration:
+                    # The read task ended short of its entry count: blocks are
+                    # LOST, not skippable — silent truncation would feed a
+                    # training run partial data with no signal.
+                    raise ray_tpu.exceptions.ObjectLostError(
+                        f"{self.name}: read stream ended after "
+                        f"{self._next_seq} of {len(self._entries)} blocks "
+                        "(producer died with retries exhausted?)"
+                    )
+            # The meta yield follows its block immediately; fetching it is a
+            # small inline read (never the block bytes). On a transient stall
+            # the pulled block is kept and the meta retried next poll.
+            try:
+                meta = ray_tpu.get(gen.next_ready(timeout=2.0))
+            except ray_tpu.exceptions.GetTimeoutError:
+                break
+            except StopIteration:
+                # Producer errored between block and meta: the block ref holds
+                # the sealed error item — surface it on consume.
+                meta = None
+            self._emit(RefBundle(self._pending_block, meta))
+            self._pending_block = None
+            self._next_seq += 1
+            progressed = True
+        return progressed
+
+    def completed(self) -> bool:
+        return self._started and self._next_seq >= len(self._entries)
+
+    def shutdown(self) -> None:
+        for gen in self._gens:
+            try:
+                gen.close()
+            except Exception:
+                pass
+        self._gens.clear()
+
+
+class MapOperator(PhysicalOperator):
+    """Fused per-block transform chain run as stateless tasks."""
+
+    def __init__(self, chain: List, name: str = "Map"):
+        super().__init__(name)
+        self._chain = list(chain)
+        # Dispatch-ordered: completions emit from the FRONT only, preserving
+        # block order end-to-end (tasks still run concurrently behind it).
+        self._inflight: deque = deque()  # (block_ref, meta_ref)
+
+    def num_active_tasks(self) -> int:
+        return len(self._inflight)
+
+    def dispatch(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
+        if not self.in_queue:
+            return False
+        if len(self._inflight) >= _default_task_cap(ctx):
+            return False
+        if not budget_ok():
+            return False
+        bundle = self.in_queue.popleft()
+        block_ref, meta_ref = _remote(_chain_task, num_returns=2).remote(
+            bundle.block_ref, self._chain
+        )
+        self.reserve(bundle.size_bytes)
+        self._inflight.append((block_ref, meta_ref, bundle.size_bytes))
+        self.tasks_submitted += 1
+        self.max_tasks_in_flight_seen = max(
+            self.max_tasks_in_flight_seen, len(self._inflight)
+        )
+        return True
+
+    def poll(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
+        if not self._inflight:
+            return False
+        ready = {
+            r.binary()
+            for r in ray_tpu.wait(
+                [p[1] for p in self._inflight],
+                num_returns=len(self._inflight),
+                timeout=0,
+            )[0]
+        }
+        progressed = False
+        while self._inflight and self._inflight[0][1].binary() in ready:
+            block_ref, meta_ref, reserved = self._inflight.popleft()
+            self.unreserve(reserved)
+            meta = ray_tpu.get(meta_ref)  # small; raises task errors eagerly
+            self._emit(RefBundle(block_ref, meta))
+            progressed = True
+        return progressed
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    """map_batches(compute="actors"): blocks run through a pool of actors that
+    construct the UDF once each (reference: `ActorPoolStrategy` +
+    `ActorPoolMapOperator`). `chain_tail` carries fusable per-block ops that
+    follow the actor stage, fused into the actor call."""
+
+    def __init__(self, fn, ctor_args, batch_size, batch_format, num_actors,
+                 chain_tail: Optional[List] = None):
+        super().__init__(f"ActorPoolMap({getattr(fn, '__name__', 'fn')})")
+        self._fn = fn
+        self._ctor_args = tuple(ctor_args)
+        self._batch = (batch_size, batch_format)
+        self._num_actors = max(1, num_actors)
+        self._tail = list(chain_tail or [])
+        self._pool: List[Any] = []
+        self._load: Dict[int, int] = {}
+        self._inflight: deque = deque()  # (block_ref, meta_ref, actor_idx)
+
+    def start(self, ctx: DataContext) -> None:
+        if self._pool:
+            return
+        worker_cls = ray_tpu.remote(_PoolWorker)
+        self._pool = [
+            worker_cls.remote(self._fn, self._ctor_args, self._tail)
+            for _ in range(self._num_actors)
+        ]
+        self._load = {i: 0 for i in range(len(self._pool))}
+
+    def num_active_tasks(self) -> int:
+        return len(self._inflight)
+
+    def dispatch(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
+        if not self.in_queue or not self._pool:
+            return False
+        # Least-loaded actor, bounded to 2 queued calls each (the reference's
+        # per-actor max_tasks_in_flight).
+        idx = min(self._load, key=self._load.get)
+        if self._load[idx] >= 2 or not budget_ok():
+            return False
+        bundle = self.in_queue.popleft()
+        bs, fmt = self._batch
+        block_ref, meta_ref = self._pool[idx].apply.options(num_returns=2).remote(
+            bundle.block_ref, bs, fmt
+        )
+        self.reserve(bundle.size_bytes)
+        self._inflight.append((block_ref, meta_ref, idx, bundle.size_bytes))
+        self._load[idx] += 1
+        self.tasks_submitted += 1
+        self.max_tasks_in_flight_seen = max(
+            self.max_tasks_in_flight_seen, len(self._inflight)
+        )
+        return True
+
+    def poll(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
+        if not self._inflight:
+            return False
+        ready = {
+            r.binary()
+            for r in ray_tpu.wait(
+                [t[1] for t in self._inflight],
+                num_returns=len(self._inflight),
+                timeout=0,
+            )[0]
+        }
+        progressed = False
+        while self._inflight and self._inflight[0][1].binary() in ready:
+            block_ref, meta_ref, idx, reserved = self._inflight.popleft()
+            self._load[idx] -= 1
+            self.unreserve(reserved)
+            meta = ray_tpu.get(meta_ref)
+            self._emit(RefBundle(block_ref, meta))
+            progressed = True
+        return progressed
+
+    def shutdown(self) -> None:
+        for a in self._pool:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._pool.clear()
+
+
+def _default_task_cap(ctx: DataContext) -> int:
+    if ctx.max_tasks_per_operator:
+        return ctx.max_tasks_per_operator
+    try:
+        return max(2, int(ray_tpu.cluster_resources().get("CPU", 4)))
+    except Exception:
+        return 4
+
+
+# ---------------------------------------------------------------------- executor
+class _Done:
+    pass
+
+
+class StreamingExecutor:
+    """Drives a pipeline of physical operators on a scheduling thread; the
+    consumer iterates `execute()` while production continues in the
+    background under the DataContext budgets."""
+
+    def __init__(self, operators: List[PhysicalOperator],
+                 ctx: Optional[DataContext] = None,
+                 output_buffer_blocks: int = 2):
+        self.ops = operators
+        self.ctx = ctx or DataContext.get_current()
+        self._out: Queue = Queue(maxsize=max(1, output_buffer_blocks))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Bytes of produced-but-unconsumed blocks (operator out-queues +
+        # executor output queue); the global backpressure signal.
+        self._outstanding_bytes = 0
+        self._lock = threading.Lock()
+        self.max_outstanding_bytes_seen = 0
+        self.max_outstanding_blocks_seen = 0
+
+    # --- budget -----------------------------------------------------------
+    def _budget_ok(self) -> bool:
+        with self._lock:
+            return self._outstanding_bytes < self.ctx.max_bytes_in_flight
+
+    def _add_bytes(self, n: int, blocks_now: int):
+        with self._lock:
+            self._outstanding_bytes += n
+            self.max_outstanding_bytes_seen = max(
+                self.max_outstanding_bytes_seen, self._outstanding_bytes
+            )
+            self.max_outstanding_blocks_seen = max(
+                self.max_outstanding_blocks_seen, blocks_now
+            )
+
+    def _sub_bytes(self, n: int):
+        with self._lock:
+            self._outstanding_bytes -= n
+
+    # --- lifecycle --------------------------------------------------------
+    def execute(self) -> Iterator[RefBundle]:
+        for op in self.ops:
+            op.account = lambda b: self._add_bytes(
+                b.size_bytes, self._queued_blocks()
+            )
+            op.reserve = lambda n: self._add_bytes(n, self._queued_blocks())
+            op.unreserve = self._sub_bytes
+            op.start(self.ctx)
+        self._thread = threading.Thread(
+            target=self._run_loop, daemon=True, name="data-streaming-executor"
+        )
+        self._thread.start()
+        try:
+            while True:
+                item = self._out.get()
+                if isinstance(item, _Done):
+                    break
+                if isinstance(item, tuple) and item and item[0] == "error":
+                    raise item[1]
+                self._sub_bytes(item.size_bytes)
+                yield item
+        finally:
+            # Covers normal completion, consumer errors, AND early abandonment
+            # (e.g. take(3) closing the generator): stop the scheduling thread
+            # and reap actor pools / read streams.
+            self.shutdown()
+
+    def shutdown(self):
+        self._stop.set()
+        for op in self.ops:
+            try:
+                op.shutdown()
+            except Exception:
+                pass
+
+    # --- scheduling loop --------------------------------------------------
+    def _queued_blocks(self) -> int:
+        return sum(len(op.out_queue) for op in self.ops) + self._out.qsize()
+
+    def _run_loop(self):
+        ctx = self.ctx
+        try:
+            while not self._stop.is_set():
+                progressed = False
+                # Downstream-first: draining consumers frees budget producers
+                # are waiting on.
+                for i in range(len(self.ops) - 1, -1, -1):
+                    op = self.ops[i]
+                    # Emissions account bytes inline via op.account, so a
+                    # multi-block poll sees its own growth against the budget.
+                    if op.poll(ctx, self._budget_ok):
+                        progressed = True
+                    # Move completed bundles downstream.
+                    if i + 1 < len(self.ops):
+                        nxt = self.ops[i + 1]
+                        while (
+                            op.out_queue
+                            and len(nxt.in_queue) < ctx.max_output_queue_blocks
+                        ):
+                            bundle = op.out_queue.popleft()
+                            self._sub_bytes(bundle.size_bytes)
+                            nxt.add_input(bundle)
+                            progressed = True
+                        if op.completed() and not op.out_queue and not nxt.inputs_done:
+                            nxt.mark_inputs_done()
+                            progressed = True
+                    else:
+                        # Final operator: feed the consumer-facing queue
+                        # (bounded; a slow consumer backpressures the chain).
+                        while op.out_queue:
+                            try:
+                                self._out.put(op.out_queue[0], timeout=0.05)
+                                op.out_queue.popleft()
+                                progressed = True
+                            except Full:
+                                break
+                    # Dispatch under the caps; output-queue cap counts queued
+                    # results so a stalled downstream stops submission.
+                    while (
+                        len(op.out_queue) < ctx.max_output_queue_blocks
+                        and op.dispatch(ctx, self._budget_ok)
+                    ):
+                        progressed = True
+                if all(op.completed() for op in self.ops) and not any(
+                    op.out_queue for op in self.ops
+                ):
+                    break
+                if not progressed:
+                    time.sleep(ctx.scheduling_poll_s)
+            # Drain sentinel.
+            while not self._stop.is_set():
+                try:
+                    self._out.put(_Done(), timeout=0.5)
+                    break
+                except Full:
+                    continue
+        except Exception as e:  # noqa: BLE001 — surfaced to the consumer
+            try:
+                self._out.put(("error", e), timeout=5)
+            except Full:
+                pass
+        finally:
+            for op in self.ops:
+                try:
+                    op.shutdown()
+                except Exception:
+                    pass
+
+    # --- stats ------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "operators": [
+                {
+                    "name": op.name,
+                    "tasks_submitted": op.tasks_submitted,
+                    "blocks_emitted": op.blocks_emitted,
+                    "max_tasks_in_flight": op.max_tasks_in_flight_seen,
+                }
+                for op in self.ops
+            ],
+            "max_outstanding_bytes": self.max_outstanding_bytes_seen,
+            "max_outstanding_blocks": self.max_outstanding_blocks_seen,
+        }
+
+
+@dataclass
+class ReadSource:
+    """Lazy source description: entries are (read_fn, args) pairs, each
+    producing one block inside a streaming read task."""
+
+    entries: List[Tuple[Callable, tuple]]
+    name: str = "Read"
+
+
+# ------------------------------------------------------------------- planning
+def build_pipeline(source_op: PhysicalOperator, logical_ops: List) -> List[PhysicalOperator]:
+    """Compile a Dataset's logical op chain into physical operators, fusing
+    consecutive per-block ops into single MapOperators (the reference's
+    OperatorFusionRule, `_internal/logical/rules/operator_fusion.py`)."""
+    ops: List[PhysicalOperator] = [source_op]
+    segment: List = []
+
+    def flush():
+        nonlocal segment
+        if segment:
+            names = ",".join(k for k, _ in segment)
+            ops.append(MapOperator(segment, name=f"Map[{names}]"))
+            segment = []
+
+    i = 0
+    while i < len(logical_ops):
+        kind, payload = logical_ops[i]
+        if kind == "map_batches_actors":
+            flush()
+            fn, ctor_args, batch_size, batch_format, num_actors = payload
+            # Fuse any fusable per-block tail into the actor call.
+            tail: List = []
+            j = i + 1
+            while j < len(logical_ops) and logical_ops[j][0] != "map_batches_actors":
+                tail.append(logical_ops[j])
+                j += 1
+            ops.append(
+                ActorPoolMapOperator(
+                    fn, ctor_args, batch_size, batch_format, num_actors, tail
+                )
+            )
+            i = j
+        else:
+            segment.append(logical_ops[i])
+            i += 1
+    flush()
+    return ops

@@ -3,14 +3,14 @@
 Reference: `python/ray/data/dataset.py:169` (`Datastream`) with the lazy
 logical plan + operator fusion of `_internal/logical/` and
 `_internal/planner/`: consecutive per-block transforms (map/map_batches/
-filter/flat_map/limit) FUSE into one task per block (one task graph stage),
-while global ops (repartition/random_shuffle/sort/zip) are barriers built
-from scatter/gather tasks — `random_shuffle` is the 2-stage push-based
-pattern of `push_based_shuffle.py`.
-
-Consumption streams: `iter_batches` submits per-block task chains inside a
-sliding prefetch window, so transform execution overlaps consumption (the
-streaming-executor behavior of `_internal/execution/streaming_executor.py:45`).
+filter/flat_map) FUSE into one MapOperator stage, actor stages become
+ActorPoolMapOperators, and consumption runs the whole plan on the
+backpressured streaming executor (`_internal/streaming_executor.py` here;
+`_internal/execution/streaming_executor.py:45` in the reference) — reads and
+transforms overlap consumption under a global memory budget. Global ops
+(repartition/random_shuffle/sort/zip/groupby) are barriers built from
+scatter/gather tasks — `random_shuffle` is the 2-stage push-based pattern of
+`push_based_shuffle.py`.
 """
 
 from __future__ import annotations
@@ -204,51 +204,64 @@ def _zip_blocks(a: Block, b: Block) -> Block:
     return out
 
 
-_remote_cache: Dict[str, Any] = {}
+_remote_cache: Dict[Any, Any] = {}
 
 
-def _remote(fn, num_returns: int = 1):
-    key = f"{fn.__name__}:{num_returns}"
+def _remote(fn, **opts):
+    """Memoized `ray_tpu.remote` wrapper: one RemoteFunction (one pickled
+    blob / function-table entry) per (fn, options) across the data layer."""
+    key = (fn.__name__, tuple(sorted(opts.items())))
     if key not in _remote_cache:
-        _remote_cache[key] = ray_tpu.remote(num_returns=num_returns)(fn) if num_returns > 1 else ray_tpu.remote(fn)
+        _remote_cache[key] = ray_tpu.remote(**opts)(fn) if opts else ray_tpu.remote(fn)
     return _remote_cache[key]
-
-
-class _MapWorker:
-    """Actor-pool map worker: constructs the UDF once, applies it per block."""
-
-    def __init__(self, fn, ctor_args):
-        self._fn = fn(*ctor_args) if isinstance(fn, type) else fn
-
-    def apply(self, block: Block, batch_size, batch_format) -> Block:
-        return _apply_chain(block, [("map_batches", (self._fn, batch_size, batch_format))])
-
-
-def _reap_pool(refs, handles):
-    """Kill a stage's actors once every block result is sealed (results live
-    in the object store independently of the producing actors). Runs as a
-    task so fire-and-forget datasets still release their pool processes."""
-    if refs:
-        ray_tpu.wait(refs, num_returns=len(refs))
-    for h in handles:
-        try:
-            ray_tpu.kill(h)
-        except Exception:
-            pass
 
 
 # ------------------------------------------------------------------------ Dataset
 class Dataset:
-    """A lazy sequence of blocks + pending per-block op chain."""
+    """A lazy logical plan: a source (pre-existing block refs, or streaming
+    read tasks) + a chain of per-block ops, compiled to physical operators
+    and run by the streaming executor on consumption."""
 
-    def __init__(self, block_refs: List[Any], ops: Optional[List[PerBlockOp]] = None):
-        self._input_refs = list(block_refs)
+    def __init__(self, source, ops: Optional[List[PerBlockOp]] = None):
+        from ray_tpu.data._internal.streaming_executor import ReadSource, RefBundle
+
+        if isinstance(source, ReadSource):
+            self._source = source
+        else:
+            self._source = [
+                b if isinstance(b, RefBundle) else RefBundle(b, None)
+                for b in source
+            ]
         self._ops = list(ops or [])
-        self._materialized: Optional[List[Any]] = None if self._ops else list(block_refs)
+        self._materialized: Optional[List[Any]] = (
+            None
+            if self._ops or isinstance(self._source, ReadSource)
+            else [b.block_ref for b in self._source]
+        )
 
     # ------------------------------------------------------------- construction
     def _derive(self, op: PerBlockOp) -> "Dataset":
-        return Dataset(self._input_refs, self._ops + [op])
+        return Dataset(self._source, self._ops + [op])
+
+    def _build_pipeline(self):
+        """Compile source + logical ops to physical operators."""
+        from ray_tpu.data._internal.streaming_executor import (
+            InputOperator,
+            ReadOperator,
+            ReadSource,
+            build_pipeline,
+        )
+
+        if self._materialized is not None:
+            from ray_tpu.data._internal.streaming_executor import RefBundle
+
+            src = InputOperator([RefBundle(r, None) for r in self._materialized])
+            return build_pipeline(src, [])
+        if isinstance(self._source, ReadSource):
+            src = ReadOperator(self._source.entries, name=self._source.name)
+        else:
+            src = InputOperator(list(self._source))
+        return build_pipeline(src, self._ops)
 
     # ------------------------------------------------------------ transformations
     def map_batches(
@@ -306,41 +319,25 @@ class Dataset:
         return self._derive(("select_columns", cols))
 
     # ------------------------------------------------------------- execution
+    def _stream_bundles(self, output_buffer_blocks: int = 2):
+        """Run the plan on the streaming executor, yielding RefBundles as
+        blocks complete (production overlaps consumption under the
+        DataContext budgets). Sets `self._last_executor` for stats."""
+        from ray_tpu.data._internal.streaming_executor import StreamingExecutor
+
+        executor = StreamingExecutor(
+            self._build_pipeline(), output_buffer_blocks=output_buffer_blocks
+        )
+        self._last_executor = executor
+        return executor.execute()
+
     def _execute(self) -> List[Any]:
+        """Materialize: run the streaming executor to completion."""
         if self._materialized is not None:
             return self._materialized
-        refs = list(self._input_refs)
-        segment: List[PerBlockOp] = []
-
-        def flush():
-            nonlocal refs
-            if segment:
-                apply_remote = _remote(_apply_chain)
-                chain = list(segment)
-                refs = [apply_remote.remote(r, chain) for r in refs]
-                segment.clear()
-
-        for op in self._ops:
-            if op[0] == "map_batches_actors":
-                # Actor stages break task fusion: run the fused prefix, then
-                # round-robin blocks over a fresh actor pool.
-                flush()
-                fn, ctor_args, batch_size, batch_format, num_actors = op[1]
-                pool = [
-                    _remote(_MapWorker).remote(fn, ctor_args)
-                    for _ in range(max(1, num_actors))
-                ]
-                refs = [
-                    pool[i % len(pool)].apply.remote(r, batch_size, batch_format)
-                    for i, r in enumerate(refs)
-                ]
-                # Release the pool once all block results seal (list-wrapped:
-                # waits inside rather than becoming a dependency).
-                _remote(_reap_pool).remote(list(refs), pool)
-            else:
-                segment.append(op)
-        flush()
-        self._materialized = refs
+        self._materialized = [b.block_ref for b in self._stream_bundles(
+            output_buffer_blocks=1_000_000  # collecting all: no output pacing
+        )]
         return self._materialized
 
     def materialize(self) -> "Dataset":
@@ -348,7 +345,13 @@ class Dataset:
         return Dataset(refs)
 
     def num_blocks(self) -> int:
-        return len(self._input_refs)
+        from ray_tpu.data._internal.streaming_executor import ReadSource
+
+        if self._materialized is not None:
+            return len(self._materialized)
+        if isinstance(self._source, ReadSource):
+            return len(self._source.entries)
+        return len(self._source)
 
     # ------------------------------------------------------------- global ops
     def repartition(self, num_blocks: int, *, _sizes: Optional[List[int]] = None) -> "Dataset":
@@ -499,34 +502,15 @@ class Dataset:
         prefetch_blocks: int = 2,
         drop_last: bool = False,
     ) -> Iterator[Any]:
-        """Streaming iteration: per-block task chains are submitted a window
-        ahead of consumption; leftover rows carry across block boundaries."""
-        if any(op[0] == "map_batches_actors" for op in self._ops):
-            # Actor stages need pool construction: run the staged executor
-            # first; the prefetch window then streams the materialized refs.
-            self._execute()
-        chain = self._ops
-        apply_remote = _remote(_apply_chain)
-        pending = list(
-            self._materialized if self._materialized is not None else self._input_refs
-        )
-        window: List[Any] = []
+        """Streaming iteration through the executor: block production (reads,
+        map tasks, actor pools) overlaps consumption under the DataContext
+        memory budgets; leftover rows carry across block boundaries."""
         carry: List[Block] = []
         carry_rows = 0
-
-        def submit_next():
-            if pending:
-                ref = pending.pop(0)
-                window.append(
-                    ref if self._materialized is not None
-                    else apply_remote.remote(ref, chain)
-                )
-
-        for _ in range(max(prefetch_blocks, 1)):
-            submit_next()
-        while window:
-            block = ray_tpu.get(window.pop(0))
-            submit_next()
+        for bundle in self._stream_bundles(
+            output_buffer_blocks=max(prefetch_blocks, 1)
+        ):
+            block = ray_tpu.get(bundle.block_ref)
             carry.append(block)
             carry_rows += BlockAccessor(block).num_rows()
             step = batch_size or carry_rows
@@ -702,7 +686,7 @@ class Dataset:
 
     def __repr__(self):
         ops = " -> ".join(k for k, _ in self._ops) or "materialized"
-        return f"Dataset(blocks={len(self._input_refs)}, plan={ops})"
+        return f"Dataset(blocks={self.num_blocks()}, plan={ops})"
 
 
 class GroupedData:

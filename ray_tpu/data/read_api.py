@@ -16,8 +16,9 @@ from typing import Any, Dict, List, Optional, Union
 import numpy as np
 
 import ray_tpu
+from ray_tpu.data._internal.streaming_executor import BlockMeta, ReadSource, RefBundle
 from ray_tpu.data.block import BlockAccessor
-from ray_tpu.data.dataset import Dataset, _remote
+from ray_tpu.data.dataset import Dataset
 
 
 # ------------------------------------------------------------------ helpers
@@ -93,18 +94,30 @@ def _read_text_files(files: List[str], encoding: str) -> Dict[str, np.ndarray]:
 
 
 # ----------------------------------------------------------------- public API
+def _put_block(block) -> RefBundle:
+    acc = BlockAccessor(block)
+    return RefBundle(
+        ray_tpu.put(block), BlockMeta(acc.num_rows(), acc.size_bytes())
+    )
+
+
 def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
     parallelism = _auto_parallelism(parallelism, n)
-    mk = _remote(_make_range_block)
-    refs = [mk.remote(r.start, r.stop) for r in _split_even(n, parallelism)]
-    return Dataset(refs)
+    return Dataset(ReadSource(
+        [(_make_range_block, (r.start, r.stop)) for r in _split_even(n, parallelism)],
+        name="ReadRange",
+    ))
 
 
 def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
     parallelism = _auto_parallelism(parallelism, n)
-    mk = _remote(_make_tensor_block)
-    refs = [mk.remote(r.start, r.stop, tuple(shape)) for r in _split_even(n, parallelism)]
-    return Dataset(refs)
+    return Dataset(ReadSource(
+        [
+            (_make_tensor_block, (r.start, r.stop, tuple(shape)))
+            for r in _split_even(n, parallelism)
+        ],
+        name="ReadRangeTensor",
+    ))
 
 
 def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
@@ -112,40 +125,40 @@ def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
 
     usage.record_library_usage("data")
     parallelism = _auto_parallelism(parallelism, len(items))
-    refs = [
-        ray_tpu.put(BlockAccessor.from_rows([items[i] for i in rng]))
+    return Dataset([
+        _put_block(BlockAccessor.from_rows([items[i] for i in rng]))
         for rng in _split_even(len(items), parallelism)
-    ]
-    return Dataset(refs)
+    ])
 
 
 def from_numpy(arrays: Union[np.ndarray, Dict[str, np.ndarray]]) -> Dataset:
     if isinstance(arrays, np.ndarray):
         arrays = {"data": arrays}
-    return Dataset([ray_tpu.put({k: np.asarray(v) for k, v in arrays.items()})])
+    return Dataset([_put_block({k: np.asarray(v) for k, v in arrays.items()})])
 
 
 def from_pandas(dfs: Union[Any, List[Any]]) -> Dataset:
     if not isinstance(dfs, list):
         dfs = [dfs]
-    return Dataset([ray_tpu.put(BlockAccessor.from_pandas(df)) for df in dfs])
+    return Dataset([_put_block(BlockAccessor.from_pandas(df)) for df in dfs])
 
 
 def from_arrow(tables: Union[Any, List[Any]]) -> Dataset:
     """One block per pyarrow Table (reference: `read_api.py from_arrow`)."""
     if not isinstance(tables, list):
         tables = [tables]
-    return Dataset([ray_tpu.put(BlockAccessor.from_arrow(t)) for t in tables])
+    return Dataset([_put_block(BlockAccessor.from_arrow(t)) for t in tables])
 
 
 def _file_reader(files, parallelism, task_fn, payload) -> Dataset:
     parallelism = min(_auto_parallelism(parallelism, len(files)), len(files))
-    rd = _remote(task_fn)
-    refs = [
-        rd.remote([files[i] for i in rng], payload)
-        for rng in _split_even(len(files), parallelism)
-    ]
-    return Dataset(refs)
+    return Dataset(ReadSource(
+        [
+            (task_fn, ([files[i] for i in rng], payload))
+            for rng in _split_even(len(files), parallelism)
+        ],
+        name=f"Read[{task_fn.__name__.strip('_')}]",
+    ))
 
 
 def read_csv(paths: Union[str, List[str]], *, parallelism: int = -1, **kwargs) -> Dataset:
@@ -164,14 +177,7 @@ def read_parquet(paths: Union[str, List[str]], *, parallelism: int = -1, **kwarg
 
 def read_text(paths: Union[str, List[str]], *, parallelism: int = -1,
               encoding: str = "utf-8") -> Dataset:
-    files = _expand_paths(paths)
-    parallelism = min(_auto_parallelism(parallelism, len(files)), len(files))
-    rd = _remote(_read_text_files)
-    refs = [
-        rd.remote([files[i] for i in rng], encoding)
-        for rng in _split_even(len(files), parallelism)
-    ]
-    return Dataset(refs)
+    return _file_reader(_expand_paths(paths), parallelism, _read_text_files, encoding)
 
 
 def _auto_parallelism(parallelism: int, n: int) -> int:

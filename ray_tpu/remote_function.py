@@ -19,6 +19,7 @@ _VALID_OPTIONS = {
     "num_gpus",  # accepted for API familiarity; maps to a custom "GPU" resource
     "resources",
     "num_returns",
+    "generator_backpressure",
     "max_retries",
     "name",
     "scheduling_strategy",
@@ -127,6 +128,11 @@ class RemoteFunction:
             func=FunctionDescriptor(self._function_id, self.__name__),
             num_returns=num_returns,
             returns_mode=returns_mode,
+            generator_backpressure=(
+                int(opts["generator_backpressure"])
+                if returns_mode == "streaming" and opts.get("generator_backpressure")
+                else None
+            ),
             resources=_resources_from_options(opts, default_cpus=1.0),
             max_retries=int(opts.get("max_retries", 0)),
             name=opts.get("name") or self.__name__,
