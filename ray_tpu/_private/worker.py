@@ -293,9 +293,11 @@ class ObjectRefGenerator:
         return self._total is not None and self._index >= self._total
 
     def close(self) -> None:
-        """Release unconsumed items and cancel the producer if still running.
-        The release rides the ref-ops queue (flushed within ~0.1s); an explicit
-        close() also flushes immediately."""
+        """Release unconsumed items and stop the producer: a queued task is
+        cancelled, a running one stops cooperatively at its next backpressure
+        checkpoint (every streaming task has a window by default). The release
+        rides the ref-ops queue (flushed within ~0.1s); an explicit close()
+        also flushes immediately."""
         if self._released:
             return
         self._released = True

@@ -39,17 +39,25 @@ _VALID_ACTOR_OPTIONS = {
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 generator_backpressure: Optional[int] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._generator_backpressure = generator_backpressure
 
     def options(self, **opts) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, opts.get("num_returns", 1))
+        return ActorMethod(
+            self._handle,
+            self._name,
+            opts.get("num_returns", 1),
+            opts.get("generator_backpressure"),
+        )
 
     def remote(self, *args, **kwargs):
         return self._handle._actor_method_call(
-            self._name, args, kwargs, self._num_returns
+            self._name, args, kwargs, self._num_returns,
+            self._generator_backpressure,
         )
 
     def __call__(self, *args, **kwargs):
@@ -84,8 +92,14 @@ class ActorHandle:
     def __eq__(self, other):
         return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
 
-    def _actor_method_call(self, method_name: str, args, kwargs, num_returns):
+    def _actor_method_call(self, method_name: str, args, kwargs, num_returns,
+                           generator_backpressure: Optional[int] = None):
+        from ray_tpu.remote_function import _resolve_backpressure
+
         returns_mode = None
+        backpressure = _resolve_backpressure(
+            {"generator_backpressure": generator_backpressure}, num_returns
+        )
         if num_returns in ("dynamic", "streaming"):
             # Generator actor method (sync generators, or `async def` methods
             # yielding via an async generator — the basis of Serve streaming
@@ -99,6 +113,7 @@ class ActorHandle:
             func=FunctionDescriptor("", method_name),
             num_returns=num_returns,
             returns_mode=returns_mode,
+            generator_backpressure=backpressure,
             actor_id=self._actor_id,
             method_name=method_name,
             name=f"{self._class_name}.{method_name}",

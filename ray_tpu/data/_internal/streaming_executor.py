@@ -444,6 +444,7 @@ class StreamingExecutor:
         # executor output queue); the global backpressure signal.
         self._outstanding_bytes = 0
         self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
         self.max_outstanding_bytes_seen = 0
         self.max_outstanding_blocks_seen = 0
 
@@ -481,7 +482,17 @@ class StreamingExecutor:
         self._thread.start()
         try:
             while True:
-                item = self._out.get()
+                try:
+                    item = self._out.get(timeout=0.5)
+                except Empty:
+                    # Scheduling thread died without delivering a sentinel
+                    # (e.g. its error put raced a full queue): surface the
+                    # stored error instead of blocking forever.
+                    if self._thread is not None and not self._thread.is_alive():
+                        if self._error is not None:
+                            raise self._error
+                        break
+                    continue
                 if isinstance(item, _Done):
                     break
                 if isinstance(item, tuple) and item and item[0] == "error":
@@ -564,8 +575,11 @@ class StreamingExecutor:
                 except Full:
                     continue
         except Exception as e:  # noqa: BLE001 — surfaced to the consumer
+            # Stored FIRST: if the bounded queue stays full (slow consumer),
+            # the consumer detects this thread's death and raises _error.
+            self._error = e
             try:
-                self._out.put(("error", e), timeout=5)
+                self._out.put(("error", e), timeout=1)
             except Full:
                 pass
         finally:

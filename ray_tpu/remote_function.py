@@ -33,6 +33,28 @@ _VALID_OPTIONS = {
 _sent_functions: set = set()
 _sent_lock = threading.Lock()
 
+# Default producer-side window for streaming tasks (reference:
+# `_generator_backpressure_num_objects`): bounds how far a producer runs
+# ahead of its consumer, and doubles as the cooperative-stop checkpoint when
+# the consumer drops the generator — without it an unconsumed infinite
+# generator would occupy a worker forever.
+DEFAULT_GENERATOR_BACKPRESSURE = 64
+
+
+def _resolve_backpressure(opts, num_returns):
+    """Validate/resolve the generator_backpressure option (streaming only)."""
+    raw = opts.get("generator_backpressure")
+    if raw is None:
+        return DEFAULT_GENERATOR_BACKPRESSURE if num_returns == "streaming" else None
+    if num_returns != "streaming":
+        raise ValueError(
+            'generator_backpressure requires num_returns="streaming"'
+        )
+    val = int(raw)
+    if val <= 0:
+        raise ValueError(f"generator_backpressure must be positive, got {raw!r}")
+    return val
+
 
 def _resources_from_options(opts: Dict[str, Any], default_cpus: float) -> Dict[str, float]:
     res: Dict[str, float] = {}
@@ -111,6 +133,7 @@ class RemoteFunction:
         self._ensure_pickled()
         nr = opts.get("num_returns", 1)
         returns_mode = None
+        backpressure = _resolve_backpressure(opts, nr)
         if nr in ("dynamic", "streaming"):
             # Generator task (reference: `num_returns="dynamic"` in
             # `python/ray/remote_function.py`, streaming generators in
@@ -128,11 +151,7 @@ class RemoteFunction:
             func=FunctionDescriptor(self._function_id, self.__name__),
             num_returns=num_returns,
             returns_mode=returns_mode,
-            generator_backpressure=(
-                int(opts["generator_backpressure"])
-                if returns_mode == "streaming" and opts.get("generator_backpressure")
-                else None
-            ),
+            generator_backpressure=backpressure,
             resources=_resources_from_options(opts, default_cpus=1.0),
             max_retries=int(opts.get("max_retries", 0)),
             name=opts.get("name") or self.__name__,

@@ -1,0 +1,5 @@
+"""Distributed histogram-GBDT engine (see `_engine.py`)."""
+
+from ray_tpu.train.gbdt._engine import GBDTModel, Tree
+
+__all__ = ["GBDTModel", "Tree"]
