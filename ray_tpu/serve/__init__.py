@@ -19,11 +19,18 @@ from ray_tpu.serve.api import (
     deployment,
     get_deployment_handle,
     http_port,
+    ingress,
+    proxy_ports,
     run,
     shutdown,
+    start,
     status,
 )
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 from ray_tpu.serve._private.common import AutoscalingConfig
 from ray_tpu.serve._private.http_proxy import ProxyRequest
 
@@ -33,12 +40,16 @@ __all__ = [
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "ProxyRequest",
     "delete",
     "deployment",
     "get_deployment_handle",
     "http_port",
+    "ingress",
+    "proxy_ports",
     "run",
     "shutdown",
+    "start",
     "status",
 ]

@@ -38,6 +38,9 @@ class DeploymentInfo:
     autoscaling_config: Optional[AutoscalingConfig] = None
     route_prefix: Optional[str] = None
     is_ingress: bool = False
+    # True when the target class carries an ASGI app (@serve.ingress): the
+    # proxy speaks ASGI to its replicas instead of the ProxyRequest protocol.
+    is_asgi: bool = False
     version: int = 0
 
 

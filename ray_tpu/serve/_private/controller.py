@@ -33,7 +33,8 @@ class ServeController:
         self._deployments: Dict[str, DeploymentInfo] = {}
         self._replicas: Dict[str, List[ReplicaInfo]] = {}
         self._replica_counter = 0
-        self._routes: Dict[str, str] = {}  # route_prefix -> deployment name
+        # route_prefix -> (deployment name, is_asgi)
+        self._routes: Dict[str, tuple] = {}
         # deployment -> {router_id -> (inflight, timestamp)}
         self._load: Dict[str, Dict[str, Any]] = {}
         self._downscale_since: Dict[str, Optional[float]] = {}
@@ -88,7 +89,7 @@ class ServeController:
                 info.version = existing.version + 1
             self._deployments[info.name] = info
             if info.route_prefix:
-                self._routes[info.route_prefix] = info.name
+                self._routes[info.route_prefix] = (info.name, info.is_asgi)
                 self._bump(ROUTES_KEY)
             if info.autoscaling_config:
                 target = max(
@@ -107,7 +108,7 @@ class ServeController:
             self._scale_to(name, 0)
             self._deployments.pop(name, None)
             self._replicas.pop(name, None)
-            self._routes = {p: d for p, d in self._routes.items() if d != name}
+            self._routes = {p: d for p, d in self._routes.items() if d[0] != name}
             self._bump(ROUTES_KEY)
             self._bump(f"replicas::{name}")
 
@@ -151,7 +152,8 @@ class ServeController:
         with self._lock:
             return list(self._replicas.get(name, []))
 
-    def get_routes(self) -> Dict[str, str]:
+    def get_routes(self) -> Dict[str, tuple]:
+        """route_prefix -> (deployment_name, is_asgi)."""
         with self._lock:
             return dict(self._routes)
 

@@ -120,18 +120,45 @@ class HTTPProxy:
             self._routes_fetched = time.time()
         return self._routes
 
-    def _match(self, path: str) -> Optional[Tuple[str, str]]:
-        routes = self._route_table()
+    def _match(self, path: str) -> Optional[Tuple[str, bool, str]]:
+        match = self._match_in(path, self._route_table())
+        if match is None:
+            # Miss may be push lag for a just-deployed route: refetch once,
+            # rate-limited so real 404 traffic can't hammer the controller.
+            import time
+
+            import ray_tpu
+
+            if time.time() - self._routes_fetched > 0.5:
+                try:
+                    self._routes = ray_tpu.get(self._controller.get_routes.remote())
+                    self._routes_fetched = time.time()
+                    match = self._match_in(path, self._routes)
+                except Exception:
+                    pass
+        return match
+
+    @staticmethod
+    def _match_in(path: str, routes) -> Optional[Tuple[str, bool, str]]:
         best = None
-        for prefix, dep in routes.items():
+        for prefix, (dep, is_asgi) in routes.items():
             norm = prefix.rstrip("/") or ""
             if path == norm or path.startswith(norm + "/") or norm == "":
                 if best is None or len(norm) > len(best[0]):
-                    best = (norm, dep)
+                    best = (norm, dep, is_asgi)
         if best is None:
             return None
         rest = path[len(best[0]):] or "/"
-        return best[1], rest
+        return best[1], best[2], rest
+
+    def _handle_for(self, dep: str):
+        handle = self._handles.get(dep)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(dep, self._controller)
+            self._handles[dep] = handle
+        return handle
 
     async def _handle(self, request):
         from aiohttp import web
@@ -141,8 +168,23 @@ class HTTPProxy:
             return web.json_response(
                 {"error": f"no route for {request.path}"}, status=404
             )
-        dep, rest = match
+        dep, is_asgi, rest = match
         body = await request.read()
+        handle = self._handle_for(dep)
+        try:
+            if is_asgi:
+                return await self._handle_asgi(request, handle, rest, body)
+            return await self._handle_plain(request, handle, rest, body)
+        except Exception as e:  # noqa: BLE001 — surface as a 500
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def _handle_plain(self, request, handle, rest: str, body: bytes):
+        """Non-ASGI deployment: one streaming call; a generator return
+        streams as a chunked response, a plain return answers normally."""
+        from aiohttp import web
+
+        from ray_tpu.serve.handle import _ReplicaStream
+
         preq = ProxyRequest(
             method=request.method,
             path=rest,
@@ -151,19 +193,105 @@ class HTTPProxy:
             headers=dict(request.headers),
             body=body,
         )
-        handle = self._handles.get(dep)
-        if handle is None:
-            from ray_tpu.serve.handle import DeploymentHandle
-
-            handle = DeploymentHandle(dep, self._controller)
-            self._handles[dep] = handle
         loop = asyncio.get_event_loop()
+        stream = _ReplicaStream(handle._ensure_router(), "__call__", (preq,), {})
+        resp = None
         try:
-            resp = handle.remote(preq)
-            result = await loop.run_in_executor(None, resp.result)
-        except Exception as e:  # noqa: BLE001 — surface as a 500
-            return web.json_response({"error": str(e)}, status=500)
-        return self._to_response(result)
+            first = await loop.run_in_executor(None, stream.next_or_none)
+            if first is None:
+                return web.Response(status=204)
+            kind, value = first
+            if kind == "single":
+                return self._to_response(value)
+            # Generator deployment: chunked transfer, one chunk per yield.
+            resp = web.StreamResponse()
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+            ev = first
+            while ev is not None:
+                await resp.write(self._to_chunk(ev[1]))
+                ev = await loop.run_in_executor(None, stream.next_or_none)
+            await resp.write_eof()
+            return resp
+        except Exception as e:  # noqa: BLE001
+            # After prepare() the status line is on the wire: no second
+            # response is possible — drop the connection mid-stream instead.
+            if resp is None:
+                return web.json_response({"error": str(e)}, status=500)
+            return resp
+        finally:
+            stream.close()  # releases unconsumed items + router load unit
+
+    async def _handle_asgi(self, request, handle, rest: str, body: bytes):
+        """ASGI ingress: speak ASGI to the replica over a streaming call and
+        relay response events as they arrive (SSE/chunked stream end-to-end)."""
+        from aiohttp import web
+
+        from ray_tpu.serve.handle import _ReplicaStream
+
+        scope = {
+            "type": "http",
+            "asgi": {"version": "3.0", "spec_version": "2.3"},
+            "http_version": "1.1",
+            "method": request.method,
+            "path": rest,
+            "raw_path": request.raw_path.encode(),
+            "root_path": "",
+            "query_string": request.query_string.encode(),
+            "headers": [(k.lower(), v) for k, v in request.headers.items()],
+            "client": (request.remote, 0),
+            "server": ("127.0.0.1", self._port),
+        }
+        loop = asyncio.get_event_loop()
+        stream = _ReplicaStream(
+            handle._ensure_router(), "handle_asgi", (scope, body), {},
+            raw_method=True,
+        )
+        resp = None
+        try:
+            ev = await loop.run_in_executor(None, stream.next_or_none)
+            while ev is not None:
+                etype = ev.get("type")
+                if etype == "http.response.start":
+                    resp = web.StreamResponse(status=ev.get("status", 200))
+                    for hk, hv in ev.get("headers", []):
+                        k = hk.decode() if isinstance(hk, bytes) else hk
+                        v = hv.decode() if isinstance(hv, bytes) else hv
+                        if k.lower() not in ("content-length", "transfer-encoding"):
+                            resp.headers[k] = v
+                    resp.enable_chunked_encoding()
+                    await resp.prepare(request)
+                elif etype == "http.response.body":
+                    if resp is None:
+                        resp = web.StreamResponse()
+                        resp.enable_chunked_encoding()
+                        await resp.prepare(request)
+                    chunk = ev.get("body", b"")
+                    if chunk:
+                        await resp.write(chunk)
+                elif etype == "asgi.error":
+                    if resp is None:
+                        return web.json_response({"error": ev["error"]}, status=500)
+                    break
+                ev = await loop.run_in_executor(None, stream.next_or_none)
+            if resp is None:
+                return web.Response(status=204)
+            await resp.write_eof()
+            return resp
+        except Exception as e:  # noqa: BLE001
+            if resp is None:
+                return web.json_response({"error": str(e)}, status=500)
+            return resp  # mid-stream failure: connection ends where it stopped
+        finally:
+            stream.close()
+
+    @staticmethod
+    def _to_chunk(value) -> bytes:
+        if isinstance(value, bytes):
+            return value
+        if isinstance(value, str):
+            return value.encode()
+        return (json.dumps(value) + "\n").encode()
 
     @staticmethod
     def _to_response(result):

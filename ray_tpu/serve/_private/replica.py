@@ -40,6 +40,98 @@ class ServeReplica:
             target = getattr(self._callable, method_name)
         return target(*args, **kwargs)
 
+    def handle_request_stream(self, method_name: str, args: Tuple,
+                              kwargs: Dict[str, Any]):
+        """Streaming variant (called with num_returns="streaming"): a user
+        method returning a generator streams each item as its own object; a
+        plain return streams one ("single", value) event. First element of
+        each event tells the consumer which case it is (reference: streaming
+        deployment responses, `_private/replica.py` CallableWrapper gen path)."""
+        import inspect
+
+        out = self.handle_request(method_name, args, kwargs)
+        if inspect.isgenerator(out):
+            for item in out:
+                yield ("chunk", item)
+        elif inspect.isasyncgen(out):
+            import asyncio
+
+            loop = asyncio.new_event_loop()
+            try:
+                while True:
+                    try:
+                        item = loop.run_until_complete(out.__anext__())
+                    except StopAsyncIteration:
+                        break
+                    yield ("chunk", item)
+            finally:
+                loop.close()
+        else:
+            yield ("single", out)
+
+    def handle_asgi(self, scope: Dict[str, Any], body: bytes):
+        """Run one HTTP request through the deployment's ASGI app, yielding
+        ASGI messages ({"type": "http.response.start"/"http.response.body"})
+        as the app sends them — consumed by the proxy over a streaming actor
+        call, so chunked/SSE responses stream end-to-end (reference:
+        `serve.ingress` ASGI mounting, `python/ray/serve/api.py:160` +
+        `http_util.py ASGIReceiveProxy`)."""
+        import asyncio
+        import queue as q
+        import threading
+
+        app = getattr(self._callable, "__serve_asgi_app__", None)
+        if app is None:
+            raise AttributeError(
+                f"deployment {self.deployment_name} is not an ASGI ingress "
+                "(decorate the class with @serve.ingress(app))"
+            )
+        self._requests += 1
+        # Rebuild bytes-typed scope fields lost to the wire format.
+        scope = dict(scope)
+        scope["query_string"] = scope.get("query_string", b"") or b""
+        scope["headers"] = [
+            (k.encode() if isinstance(k, str) else k,
+             v.encode() if isinstance(v, str) else v)
+            for k, v in scope.get("headers", [])
+        ]
+        events: "q.Queue" = q.Queue()
+        _END = object()
+        got_body = {"v": False}
+
+        async def receive():
+            # First call: the (complete) request body. Later calls PARK
+            # instead of looping instantly — frameworks run
+            # `while True: await receive()` waiting for http.disconnect
+            # (e.g. Starlette's listen_for_disconnect), and a hot-returning
+            # receive would spin this thread and starve the response task.
+            if not got_body["v"]:
+                got_body["v"] = True
+                return {"type": "http.request", "body": body, "more_body": False}
+            import asyncio as aio
+
+            await aio.Event().wait()  # parked until the app task completes
+
+        async def send(message):
+            events.put(message)
+
+        def run():
+            loop = asyncio.new_event_loop()
+            try:
+                loop.run_until_complete(app(scope, receive, send))
+            except Exception as e:  # noqa: BLE001 — surfaced as a 500 event
+                events.put({"type": "asgi.error", "error": repr(e)})
+            finally:
+                loop.close()
+                events.put(_END)
+
+        threading.Thread(target=run, daemon=True, name="asgi-call").start()
+        while True:
+            ev = events.get()
+            if ev is _END:
+                return
+            yield ev
+
     def stats(self) -> Dict[str, Any]:
         return {
             "deployment": self.deployment_name,

@@ -85,6 +85,38 @@ def deployment(_target=None, **opts) -> Union[Deployment, Any]:
     return wrap
 
 
+def ingress(asgi_app):
+    """`@serve.ingress(app)`: mount an ASGI application (FastAPI/Starlette or
+    any ASGI-3 callable) on a deployment class — HTTP requests route through
+    the app's own router, streamed end-to-end (reference:
+    `python/ray/serve/api.py:160`).
+
+    Usage::
+
+        app = SomeASGIFramework()
+
+        @serve.deployment
+        @serve.ingress(app)
+        class Api:
+            ...
+
+    The decorated class (and its replicas) expose the app via
+    `__serve_asgi_app__`; the HTTP proxy speaks ASGI to them.
+    """
+    if not callable(asgi_app):
+        raise TypeError("serve.ingress expects an ASGI application callable")
+
+    def wrap(cls):
+        if not isinstance(cls, type):
+            raise TypeError("@serve.ingress decorates a class")
+        # staticmethod: instance access must yield the raw app callable, not
+        # a bound method (which would shift the scope/receive/send args).
+        cls.__serve_asgi_app__ = staticmethod(asgi_app)
+        return cls
+
+    return wrap
+
+
 # ---------------------------------------------------------------- runtime state
 _client: Dict[str, Any] = {}
 
@@ -143,6 +175,64 @@ def _get_proxy(create: bool = True, port: int = DEFAULT_HTTP_PORT):
         _client["http_port"] = bound
     _client["proxy"] = handle
     return handle
+
+
+def start(
+    *,
+    proxy_location: str = "HeadOnly",
+    http_options: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Start Serve system actors ahead of `serve.run` (reference:
+    `serve.start`, `http_options={"location": "EveryNode"}`). With
+    `proxy_location="EveryNode"` one HTTP proxy actor is pinned to EVERY
+    cluster node (the reference's per-node `HTTPProxy`,
+    `_private/http_proxy.py:250`), removing the single-proxy throughput
+    ceiling/SPOF; each binds its own port (`port=0` picks a free one —
+    required when virtual nodes share one machine). `serve.proxy_ports()`
+    lists them."""
+    from ray_tpu.serve._private.http_proxy import HTTPProxy
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    ray_tpu._private.worker._auto_init()
+    opts = dict(http_options or {})
+    location = opts.get("location", proxy_location)
+    port = int(opts.get("port", DEFAULT_HTTP_PORT))
+    controller = _get_controller()
+    if location != "EveryNode":
+        _get_proxy(create=True, port=port)
+        return
+    proxies = _client.setdefault("node_proxies", {})
+    for node in ray_tpu.nodes():
+        node_id = node["node_id"]
+        if node_id in proxies or not node.get("alive", True):
+            # A hard affinity to a dead node would never place.
+            continue
+        name = f"{PROXY_NAME}::{node_id[:8]}"
+        handle = (
+            ray_tpu.remote(HTTPProxy)
+            .options(
+                name=name,
+                num_cpus=0.1,
+                get_if_exists=True,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node_id, soft=False
+                ),
+            )
+            .remote(controller)
+        )
+        # Virtual nodes share a host: every proxy after the first would
+        # collide on a fixed port, so EveryNode always binds a free one.
+        bound = ray_tpu.get(handle.start.remote(port=0))
+        proxies[node_id] = (handle, bound)
+
+
+def proxy_ports() -> Dict[str, int]:
+    """node_id -> bound HTTP port for per-node proxies (+ the default proxy
+    under "head" when present)."""
+    out = {nid: port for nid, (_h, port) in _client.get("node_proxies", {}).items()}
+    if "http_port" in _client:
+        out["head"] = _client["http_port"]
+    return out
 
 
 def http_port() -> Optional[int]:
@@ -217,6 +307,7 @@ def run(
                 else dep._options.get("route_prefix")
             ),
             is_ingress=is_ingress,
+            is_asgi=hasattr(dep._target, "__serve_asgi_app__"),
         )
         ray_tpu.get(controller.deploy.remote(info))
     if _blocking_http:
@@ -259,6 +350,11 @@ def shutdown() -> None:
     if "proxy" in _client:
         try:
             ray_tpu.kill(_client["proxy"])
+        except Exception:
+            pass
+    for handle, _port in _client.get("node_proxies", {}).values():
+        try:
+            ray_tpu.kill(handle)
         except Exception:
             pass
     _client.clear()

@@ -42,6 +42,10 @@ class Router:
         self._version = -1  # -1 = never synced; first listen returns current
         self._have_table = threading.Event()
         self._inflight: Dict[str, List[Any]] = {}  # replica_id -> pending refs
+        # Streaming calls have no single ref to sweep: consumers decrement
+        # via stream_done() when the stream ends/closes, so load reports (and
+        # with them autoscaling) see HTTP/streaming traffic too.
+        self._inflight_streams: Dict[str, int] = {}
         self._last_load_report = 0.0
         self._closed = False
         _all_routers.add(self)
@@ -114,19 +118,33 @@ class Router:
         if now - self._last_load_report < _LOAD_REPORT_INTERVAL_S:
             return
         self._last_load_report = now
-        total = sum(len(v) for v in self._inflight.values())
+        total = sum(len(v) for v in self._inflight.values()) + sum(
+            self._inflight_streams.values()
+        )
         try:
             self._controller.report_load.remote(self._name, self._router_id, total)
         except Exception:
             pass
 
-    def route(self, method_name: str, args, kwargs, force_refresh: bool = False):
+    def stream_done(self, replica_id: str) -> None:
+        """A streaming call finished or was dropped: release its load unit."""
+        with self._lock:
+            n = self._inflight_streams.get(replica_id, 0)
+            if n <= 1:
+                self._inflight_streams.pop(replica_id, None)
+            else:
+                self._inflight_streams[replica_id] = n - 1
+
+    def route(self, method_name: str, args, kwargs, force_refresh: bool = False,
+              stream: bool = False, raw_method: bool = False):
         """Pick a replica (power of two choices) and submit.
 
         Returns ``(ref, replica_id)`` so the response can report the replica
         on actor-death and resubmit (dead-replica retry lives in
-        DeploymentResponse.result()).
-        """
+        DeploymentResponse.result()). With ``stream=True`` the first element
+        is an ObjectRefGenerator from a streaming call to
+        `handle_request_stream` (or to `method_name` itself when
+        ``raw_method`` — the proxy's ASGI path)."""
         from ray_tpu.actor import ActorHandle
 
         self._ensure_table(force=force_refresh)  # outside the lock (push needs it)
@@ -145,8 +163,20 @@ class Router:
                     else b
                 )
             handle = ActorHandle(chosen.actor_id, "ServeReplica")
-            ref = handle.handle_request.remote(method_name, tuple(args), kwargs)
-            self._inflight.setdefault(chosen.replica_id, []).append(ref)
+            if stream:
+                if raw_method:
+                    method = getattr(handle, method_name)
+                    ref = method.options(num_returns="streaming").remote(*args, **kwargs)
+                else:
+                    ref = handle.handle_request_stream.options(
+                        num_returns="streaming"
+                    ).remote(method_name, tuple(args), kwargs)
+                self._inflight_streams[chosen.replica_id] = (
+                    self._inflight_streams.get(chosen.replica_id, 0) + 1
+                )
+            else:
+                ref = handle.handle_request.remote(method_name, tuple(args), kwargs)
+                self._inflight.setdefault(chosen.replica_id, []).append(ref)
             self._report_load()
         return ref, chosen.replica_id
 
@@ -214,15 +244,108 @@ class DeploymentResponse:
             return ray_tpu.get(self.ref, timeout=remaining)
 
 
+class _ReplicaStream:
+    """One streaming call to a replica: pulls values off the core
+    ObjectRefGenerator, retries ONCE on another replica if the chosen one died
+    before producing anything, and releases the router's stream load unit when
+    the stream ends, errors, or is closed."""
+
+    def __init__(self, router: Router, method_name: str, args, kwargs,
+                 raw_method: bool = False):
+        self._router = router
+        self._call = (method_name, args, kwargs, raw_method)
+        self._gen, self._rid = router.route(
+            method_name, args, kwargs, stream=True, raw_method=raw_method
+        )
+        self._got_first = False
+        self._retried = False
+        self._done = False
+
+    @property
+    def replica_id(self) -> str:
+        return self._rid
+
+    def next_or_none(self):
+        """The next streamed value, or None at end-of-stream."""
+        import ray_tpu
+        from ray_tpu.exceptions import RayActorError, WorkerCrashedError
+
+        while True:
+            try:
+                ref = next(self._gen)
+                value = ray_tpu.get(ref)
+                self._got_first = True
+                return value
+            except StopIteration:
+                self._finish()
+                return None
+            except (RayActorError, WorkerCrashedError):
+                if self._got_first or self._retried:
+                    # Mid-stream death is not transparently retryable (items
+                    # already delivered); surface it.
+                    self._finish()
+                    raise
+                self._retried = True
+                self._router.report_failure(self._rid)
+                self._router.stream_done(self._rid)
+                method, args, kwargs, raw = self._call
+                self._gen, self._rid = self._router.route(
+                    method, args, kwargs, force_refresh=True,
+                    stream=True, raw_method=raw,
+                )
+
+    def close(self):
+        if not self._done:
+            try:
+                self._gen.close()
+            finally:
+                self._finish()
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._router.stream_done(self._rid)
+
+
+class DeploymentResponseGenerator:
+    """Streaming response: iterating yields the values a generator deployment
+    method produces, as they are produced (reference: `serve/handle.py`
+    `DeploymentResponseGenerator`, `handle.options(stream=True)`)."""
+
+    def __init__(self, stream: _ReplicaStream):
+        self._stream = stream
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        event = self._stream.next_or_none()
+        if event is None:
+            raise StopIteration
+        _kind, value = event
+        return value
+
+    def close(self):
+        self._stream.close()
+
+
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, controller, method_name: str = "__call__"):
+    def __init__(self, deployment_name: str, controller,
+                 method_name: str = "__call__", stream: bool = False):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method = method_name
+        self._stream = stream
         self._router: Optional[Router] = None
 
-    def options(self, *, method_name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, self._controller, method_name)
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name,
+            self._controller,
+            method_name if method_name is not None else self._method,
+            stream if stream is not None else self._stream,
+        )
         h._router = self._router
         return h
 
@@ -231,8 +354,12 @@ class DeploymentHandle:
             self._router = Router(self.deployment_name, self._controller)
         return self._router
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         router = self._ensure_router()
+        if self._stream:
+            return DeploymentResponseGenerator(
+                _ReplicaStream(router, self._method, args, kwargs)
+            )
         ref, replica_id = router.route(self._method, args, kwargs)
         return DeploymentResponse(
             ref, router, replica_id, (self._method, args, kwargs)
@@ -241,7 +368,7 @@ class DeploymentHandle:
     def __reduce__(self):
         return (
             DeploymentHandle,
-            (self.deployment_name, self._controller, self._method),
+            (self.deployment_name, self._controller, self._method, self._stream),
         )
 
     def __getattr__(self, name: str):

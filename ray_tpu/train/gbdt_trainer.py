@@ -183,7 +183,16 @@ class GBDTTrainer(BaseTrainer):
         if self.resume_from_checkpoint is not None:
             prev = self.resume_from_checkpoint.to_dict().get(MODEL_KEY)
             if prev is not None:
-                model = prev  # continue boosting from the saved ensemble
+                # COPY the ensemble: appending to the checkpointed model in
+                # place would silently grow the source checkpoint too.
+                model = GBDTModel(
+                    trees=list(prev.trees),
+                    base_score=prev.base_score,
+                    objective=prev.objective,
+                    learning_rate=prev.learning_rate,
+                    feature_columns=list(prev.feature_columns),
+                    label_column=prev.label_column,
+                )
 
         # Global quantile bins from a cross-shard sample.
         samples = ray_tpu.get(

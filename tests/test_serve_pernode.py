@@ -1,0 +1,38 @@
+"""Per-node HTTP proxies (reference: one HTTPProxy per node,
+`python/ray/serve/_private/http_proxy.py:250`). Own module: needs a fresh
+multi-node virtual cluster, not the shared single-node session."""
+
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def test_per_node_proxies():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})  # init()s this process
+    try:
+        cluster.add_node(num_cpus=2)
+
+        @serve.deployment
+        def ping(request):
+            return "pong"
+
+        serve.run(ping.bind(), route_prefix="/ping", _blocking_http=False)
+        serve.start(proxy_location="EveryNode")
+        ports = serve.proxy_ports()
+        node_ports = [p for nid, p in ports.items() if nid != "head"]
+        assert len(node_ports) == 2, ports
+        for p in node_ports:
+            status, body = _get(f"http://127.0.0.1:{p}/ping")
+            assert status == 200 and b"pong" in body
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        cluster.shutdown()
