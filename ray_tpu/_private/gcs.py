@@ -78,6 +78,10 @@ class GCS:
         self.named_actors: Dict[str, ActorID] = {}
         self.placement_groups: Dict[PlacementGroupID, Any] = {}
         self.function_table: Dict[str, bytes] = {}
+        # Detached actors (lifetime="detached"): actor_id bytes -> pickled
+        # creation record, persisted so a restarted head can restart them
+        # (reference: Redis-backed GcsActorManager recovery).
+        self.detached_actors: Dict[bytes, bytes] = {}
         self.task_events: List[TaskEvent] = []
         self._task_event_cap = 100000
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
@@ -117,24 +121,30 @@ class GCS:
     # `store_client/redis_store_client.h:28`, restore at `gcs_server.cc:59`) ---
     def snapshot_bytes(self) -> bytes:
         """Serialize the durable tables: the KV store (jobs/metrics/user data
-        ride it) and the function table. Live entities (actors, nodes, task
-        events) die with their processes and are intentionally not persisted —
-        the reference reconstructs those from re-registration, not storage."""
+        ride it), the function table, and detached-actor records. Other live
+        entities (owned actors, nodes, task events) die with their processes
+        and are intentionally not persisted — the reference reconstructs
+        those from re-registration, not storage."""
         import pickle
 
         with self.store._lock:
             data = {t: dict(kv) for t, kv in self.store._data.items()}
-        # function_table is mutated by the scheduler thread without a lock;
-        # retry the copy across "dict changed size" races.
-        for _ in range(5):
-            try:
-                functions = dict(self.function_table)
-                break
-            except RuntimeError:
-                continue
-        else:
-            functions = {}
-        return pickle.dumps({"store": data, "functions": functions})
+
+        def _copy(d):
+            # Mutated by the scheduler thread without a lock; retry the copy
+            # across "dict changed size" races.
+            for _ in range(5):
+                try:
+                    return dict(d)
+                except RuntimeError:
+                    continue
+            return {}
+
+        return pickle.dumps({
+            "store": data,
+            "functions": _copy(self.function_table),
+            "detached_actors": _copy(self.detached_actors),
+        })
 
     def restore_bytes(self, blob: bytes) -> None:
         import pickle
@@ -143,6 +153,7 @@ class GCS:
         with self.store._lock:
             self.store._data = {t: dict(kv) for t, kv in payload["store"].items()}
         self.function_table.update(payload.get("functions", {}))
+        self.detached_actors.update(payload.get("detached_actors", {}))
 
     def save_to(self, path: str) -> None:
         import os

@@ -88,6 +88,23 @@ def main() -> None:
     labels = {"head": "1", **tpu_accel.node_topology_labels()}
     scheduler.call("add_node", (resources, labels)).result()
 
+    # Restart persisted detached actors (reference: GcsActorManager restoring
+    # detached actors from Redis on GCS recovery). Creation replays, so the
+    # actor comes back with fresh state under its registered name. Job
+    # supervisors are NOT restored: their jobs were failed above (no one
+    # would re-invoke run()), so restoring would leak an idle actor.
+    from ray_tpu._private import serialization as _ser
+
+    for key, blob in list(gcs.detached_actors.items()):
+        try:
+            name = _ser.loads(blob).get("name") or ""
+            if name.startswith("JOB_SUPERVISOR::"):
+                gcs.detached_actors.pop(key, None)
+                continue
+            scheduler.call("restore_detached_actor", blob).result()
+        except Exception:
+            pass  # unrestorable record (e.g. stale format): skip, keep serving
+
     stop = threading.Event()
 
     if ns.persist:

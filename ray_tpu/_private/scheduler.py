@@ -264,6 +264,13 @@ class ActorRecord:
     state: str = "PENDING"  # PENDING -> ALIVE -> RESTARTING -> DEAD
     max_restarts: int = 0
     num_restarts: int = 0
+    # lifetime="detached": survives its creator, persists under head
+    # --persist, dies only via kill_actor (reference:
+    # `gcs_actor_manager.h:281` ownership rules).
+    detached: bool = False
+    # Holder id of the creating driver/worker for owned (non-detached)
+    # actors: its death kills the actor.
+    owner_holder: Optional[str] = None
     inflight: List[TaskID] = field(default_factory=list)
     # Method calls queued while the actor is PENDING/RESTARTING.
     backlog: List[ExecRequest] = field(default_factory=list)
@@ -499,6 +506,8 @@ class Scheduler:
             self._pull_sources.pop(dh.pull_node_id, None)
             self._fail_pulls_from(dh.pull_node_id)
         self._drop_holder_everywhere(dh.holder_id)
+        # Owned actors die with their creator; detached actors survive.
+        self._kill_actors_owned_by(dh.holder_id)
         try:
             dh.conn.close()
         except OSError:
@@ -882,6 +891,7 @@ class Scheduler:
             except OSError:
                 pass
         self._drop_holder_everywhere(wh.worker_id.hex())
+        self._kill_actors_owned_by(wh.worker_id.hex())
         if wh.actor_id is not None:
             self._handle_actor_worker_death(wh)
         elif wh.current_task is not None:
@@ -940,6 +950,7 @@ class Scheduler:
                 info.death_cause = ar.death_cause
             self._release_actor_resources(ar)
             self._release_actor_creation_pins(ar)
+            self._drop_detached(ar.actor_id)
             for req in ar.backlog:
                 rec = self.tasks.get(req.spec.task_id)
                 if rec is not None:
@@ -1045,6 +1056,7 @@ class Scheduler:
             ar.backlog.clear()
             self._release_actor_resources(ar)
             self._release_actor_creation_pins(ar)
+            self._drop_detached(ar.actor_id)
 
     # ------------------------------------------------------------------ generator streams
     # Reference semantics: `num_returns="dynamic"` / streaming generator tasks
@@ -1620,15 +1632,90 @@ class Scheduler:
         ar, info, name = payload
         self.actors[ar.actor_id] = ar
         self.gcs.actors[ar.actor_id] = info
+        if not ar.detached:
+            # Owned actor: the creator's death kills it (reference ownership
+            # rules, `gcs_actor_manager.h:281`). Detached actors have no owner.
+            ar.owner_holder = holder or self._INPROC_DRIVER
         if name:
             if name in self.gcs.named_actors:
                 raise ValueError(f"Actor name '{name}' already taken")
             self.gcs.named_actors[name] = ar.actor_id
+        if ar.detached:
+            self._persist_detached(ar, name)
         self._register_return_holders(
             ar.creation_req.return_ids, holder or self._INPROC_DRIVER
         )
         self._try_start_actor(ar)
         return True
+
+    # --------------------------------------------------------- detached actors
+    def _persist_detached(self, ar: ActorRecord, name: Optional[str]) -> None:
+        """Record a detached actor in the GCS so head --persist can restart
+        it after a head restart (reference: Redis-backed GcsActorManager
+        recovery). Only restorable records are kept: creation args must be
+        inline (segment payloads and ObjectRefs die with the session)."""
+        entries = list(
+            getattr(ar.creation_req, "_saved_arg_entries", None) or []
+        ) + list(
+            (getattr(ar.creation_req, "_saved_kwarg_entries", None) or {}).values()
+        )
+        restorable = all(
+            kind == "meta" and m.segment is None and not m.contained_ids
+            for kind, m in entries
+        )
+        if not restorable:
+            return
+        info = self.gcs.actors.get(ar.actor_id)
+        blob = serialization.dumps({
+            "creation_req": ar.creation_req,
+            "resources": ar.resources,
+            "max_restarts": ar.max_restarts,
+            "name": name,
+            "class_name": info.class_name if info else "Actor",
+            "actor_id": ar.actor_id,
+        })
+        self.gcs.detached_actors[ar.actor_id.binary()] = blob
+
+    def _drop_detached(self, actor_id: ActorID) -> None:
+        self.gcs.detached_actors.pop(actor_id.binary(), None)
+
+    def _cmd_restore_detached_actor(self, blob: bytes):
+        """Head restart with --persist: re-create a persisted detached actor
+        (fresh state — the creation task replays, like an actor restart)."""
+        from ray_tpu._private.gcs import ActorInfo
+
+        rec = serialization.loads(blob)
+        actor_id = rec["actor_id"]
+        if actor_id in self.actors:
+            return False
+        ar = ActorRecord(
+            actor_id=actor_id,
+            creation_req=rec["creation_req"],
+            resources=rec["resources"],
+            max_restarts=rec["max_restarts"],
+            detached=True,
+        )
+        info = ActorInfo(
+            actor_id=actor_id,
+            name=rec["name"],
+            class_name=rec["class_name"],
+            max_restarts=rec["max_restarts"],
+        )
+        name = rec["name"]
+        self.actors[actor_id] = ar
+        self.gcs.actors[actor_id] = info
+        if name:
+            self.gcs.named_actors[name] = actor_id
+        self.gcs.detached_actors[actor_id.binary()] = blob
+        self._try_start_actor(ar)
+        return True
+
+    def _kill_actors_owned_by(self, holder: str) -> None:
+        """An owner (driver/worker) died: its owned actors die with it;
+        detached actors survive."""
+        for ar in list(self.actors.values()):
+            if ar.owner_holder == holder and ar.state != "DEAD":
+                self._cmd_kill_actor((ar.actor_id, True))
 
     def _cmd_submit_actor_task(self, payload):
         req: ExecRequest = payload
@@ -1687,6 +1774,8 @@ class Scheduler:
         for name, aid in list(self.gcs.named_actors.items()):
             if aid == actor_id and ar.state == "DEAD":
                 del self.gcs.named_actors[name]
+        if ar.state == "DEAD":
+            self._drop_detached(actor_id)
         return True
 
     def _cmd_register_function(self, payload):

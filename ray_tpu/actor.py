@@ -184,6 +184,12 @@ class ActorClass:
         worker_mod._auto_init()
         opts = self._options
         name = opts.get("name")
+        lifetime = opts.get("lifetime")
+        if lifetime not in (None, "detached", "non_detached"):
+            # An unknown lifetime must not silently downgrade to "owned".
+            raise ValueError(
+                f'lifetime must be "detached" or "non_detached", got {lifetime!r}'
+            )
         if name and opts.get("get_if_exists"):
             existing = global_worker.context.get_actor_by_name(name)
             if existing is not None:
@@ -222,6 +228,7 @@ class ActorClass:
             creation_req=req,
             resources=resources,
             max_restarts=max_restarts,
+            detached=(lifetime == "detached"),
         )
         info = ActorInfo(
             actor_id=actor_id,

@@ -192,6 +192,9 @@ class JobSubmissionClient:
         sup = _JobSupervisor.options(
             name=f"JOB_SUPERVISOR::{job_id}",
             runtime_env=runtime_env,
+            # The job must outlive the submitting client (reference:
+            # JobSupervisor is a detached actor, `job_manager.py`).
+            lifetime="detached",
         ).remote(job_id, entrypoint)
         run_ref = sup.run.remote()
         # Teardown: reap waits on run()'s result (even an error) and then

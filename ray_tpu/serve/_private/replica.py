@@ -98,22 +98,36 @@ class ServeReplica:
         events: "q.Queue" = q.Queue()
         _END = object()
         got_body = {"v": False}
+        response_done: Dict[str, Any] = {"event": None}
 
         async def receive():
-            # First call: the (complete) request body. Later calls PARK
-            # instead of looping instantly — frameworks run
-            # `while True: await receive()` waiting for http.disconnect
-            # (e.g. Starlette's listen_for_disconnect), and a hot-returning
-            # receive would spin this thread and starve the response task.
+            # First call: the (complete) request body. Later calls park until
+            # the response finishes, then deliver http.disconnect — this
+            # serves both disconnect-watch patterns: a side task (Starlette's
+            # listen_for_disconnect) parks harmlessly, and a main-coroutine
+            # `send everything, then await receive()` unblocks at the end.
+            # A hot-returning receive would spin and starve the response task.
             if not got_body["v"]:
                 got_body["v"] = True
                 return {"type": "http.request", "body": body, "more_body": False}
             import asyncio as aio
 
-            await aio.Event().wait()  # parked until the app task completes
+            if response_done["event"] is None:
+                response_done["event"] = aio.Event()
+            await response_done["event"].wait()
+            return {"type": "http.disconnect"}
 
         async def send(message):
             events.put(message)
+            if message.get("type") == "http.response.body" and not message.get(
+                "more_body", False
+            ):
+                ev = response_done["event"]
+                if ev is None:
+                    import asyncio as aio
+
+                    response_done["event"] = ev = aio.Event()
+                ev.set()
 
         def run():
             loop = asyncio.new_event_loop()

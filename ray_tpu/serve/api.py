@@ -220,9 +220,13 @@ def start(
             )
             .remote(controller)
         )
-        # Virtual nodes share a host: every proxy after the first would
-        # collide on a fixed port, so EveryNode always binds a free one.
-        bound = ray_tpu.get(handle.start.remote(port=0))
+        # get_if_exists may return a proxy another driver already started:
+        # starting it again would stack a second HTTP server inside the actor.
+        bound = ray_tpu.get(handle.port.remote())
+        if bound is None:
+            # Virtual nodes share a host: every proxy after the first would
+            # collide on a fixed port, so EveryNode always binds a free one.
+            bound = ray_tpu.get(handle.start.remote(port=0))
         proxies[node_id] = (handle, bound)
 
 

@@ -46,6 +46,11 @@ class Router:
         # via stream_done() when the stream ends/closes, so load reports (and
         # with them autoscaling) see HTTP/streaming traffic too.
         self._inflight_streams: Dict[str, int] = {}
+        # stream_done must be GC-safe (DeploymentResponseGenerator.__del__):
+        # lock-free queue drained under the lock by _sweep.
+        import collections
+
+        self._stream_done_q: "collections.deque" = collections.deque()
         self._last_load_report = 0.0
         self._closed = False
         _all_routers.add(self)
@@ -102,9 +107,20 @@ class Router:
                 self._replicas = replicas
 
     def _sweep(self):
-        """Drop completed refs from the inflight books (lazy decrement)."""
+        """Drop completed refs from the inflight books (lazy decrement) and
+        apply queued stream completions."""
         import ray_tpu
 
+        while True:
+            try:
+                rid = self._stream_done_q.popleft()
+            except IndexError:
+                break
+            n = self._inflight_streams.get(rid, 0)
+            if n <= 1:
+                self._inflight_streams.pop(rid, None)
+            else:
+                self._inflight_streams[rid] = n - 1
         for rid, refs in list(self._inflight.items()):
             if not refs:
                 continue
@@ -112,6 +128,11 @@ class Router:
                 refs, num_returns=len(refs), timeout=0
             )
             self._inflight[rid] = not_ready
+
+    def _load_of(self, replica_id: str) -> int:
+        return len(self._inflight.get(replica_id, [])) + self._inflight_streams.get(
+            replica_id, 0
+        )
 
     def _report_load(self):
         now = time.time()
@@ -127,13 +148,9 @@ class Router:
             pass
 
     def stream_done(self, replica_id: str) -> None:
-        """A streaming call finished or was dropped: release its load unit."""
-        with self._lock:
-            n = self._inflight_streams.get(replica_id, 0)
-            if n <= 1:
-                self._inflight_streams.pop(replica_id, None)
-            else:
-                self._inflight_streams[replica_id] = n - 1
+        """A streaming call finished or was dropped: release its load unit.
+        Lock-free (callable from __del__); applied at the next _sweep."""
+        self._stream_done_q.append(replica_id)
 
     def route(self, method_name: str, args, kwargs, force_refresh: bool = False,
               stream: bool = False, raw_method: bool = False):
@@ -158,8 +175,7 @@ class Router:
                 a, b = random.sample(self._replicas, 2)
                 chosen = (
                     a
-                    if len(self._inflight.get(a.replica_id, []))
-                    <= len(self._inflight.get(b.replica_id, []))
+                    if self._load_of(a.replica_id) <= self._load_of(b.replica_id)
                     else b
                 )
             handle = ActorHandle(chosen.actor_id, "ServeReplica")
@@ -293,6 +309,11 @@ class _ReplicaStream:
                     method, args, kwargs, force_refresh=True,
                     stream=True, raw_method=raw,
                 )
+            except BaseException:
+                # User exception from the deployment (or any other failure):
+                # the stream is over — release the load unit before raising.
+                self._finish()
+                raise
 
     def close(self):
         if not self._done:
@@ -305,6 +326,14 @@ class _ReplicaStream:
         if not self._done:
             self._done = True
             self._router.stream_done(self._rid)
+
+    def __del__(self):
+        # Abandoned stream: releasing the load unit is GC-safe (lock-free
+        # queue); the core generator's own __del__ releases its items.
+        try:
+            self._finish()
+        except Exception:
+            pass
 
 
 class DeploymentResponseGenerator:
