@@ -1717,6 +1717,12 @@ class Scheduler:
             if ar.owner_holder == holder and ar.state != "DEAD":
                 self._cmd_kill_actor((ar.actor_id, True))
 
+    def _owns_live_actors(self, worker_hex: str) -> bool:
+        return any(
+            ar.owner_holder == worker_hex and ar.state != "DEAD"
+            for ar in self.actors.values()
+        )
+
     def _cmd_submit_actor_task(self, payload):
         req: ExecRequest = payload
         self._register_return_holders(req.return_ids, self._INPROC_DRIVER)
@@ -2728,7 +2734,14 @@ class Scheduler:
                 victim = None
                 for wid in node.idle:
                     cand = node.workers.get(wid)
-                    if cand is not None and cand.env_hash != want_hash:
+                    if (
+                        cand is not None
+                        and cand.env_hash != want_hash
+                        # Never evict a worker that owns live actors: its
+                        # death would kill them (ownership semantics) while
+                        # callers still hold working handles.
+                        and not self._owns_live_actors(cand.worker_id.hex())
+                    ):
                         victim = cand
                         break
                 if victim is None:

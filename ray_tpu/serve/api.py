@@ -144,6 +144,9 @@ def _get_controller(create: bool = True):
                 num_cpus=0.1,
                 max_concurrency=256,
                 get_if_exists=True,
+                # Serve outlives the driver that started it (reference: all
+                # Serve system actors are detached); serve.shutdown() kills.
+                lifetime="detached",
             )
             .remote()
         )
@@ -168,7 +171,10 @@ def _get_proxy(create: bool = True, port: int = DEFAULT_HTTP_PORT):
             return None
         handle = (
             ray_tpu.remote(HTTPProxy)
-            .options(name=PROXY_NAME, num_cpus=0.1, get_if_exists=True)
+            .options(
+                name=PROXY_NAME, num_cpus=0.1, get_if_exists=True,
+                lifetime="detached",
+            )
             .remote(controller)
         )
         bound = ray_tpu.get(handle.start.remote(port=port))
@@ -214,6 +220,7 @@ def start(
                 name=name,
                 num_cpus=0.1,
                 get_if_exists=True,
+                lifetime="detached",
                 scheduling_strategy=NodeAffinitySchedulingStrategy(
                     node_id=node_id, soft=False
                 ),

@@ -34,9 +34,42 @@ class CheckpointManager:
         self._count += 1
         path = os.path.join(self.run_dir, f"checkpoint_{self._count:06d}")
         checkpoint.to_directory(path)
+        # Metrics sidecar so a restored experiment (Tuner.restore) can rebuild
+        # best-checkpoint rankings from disk.
+        try:
+            import json
+
+            with open(os.path.join(path, "_tune_metrics.json"), "w") as f:
+                json.dump({k: v for k, v in (metrics or {}).items()
+                           if isinstance(v, (int, float, str, bool))}, f)
+        except (OSError, TypeError):
+            pass
         self._kept.append((path, dict(metrics or {})))
         self._prune()
         return Checkpoint.from_directory(path)
+
+    def restore_from_disk(self) -> None:
+        """Rediscover checkpoints already persisted under run_dir (experiment
+        resume: the in-memory book is gone, the directories are not)."""
+        import json
+        import re
+
+        found = []
+        for entry in sorted(os.listdir(self.run_dir)):
+            m = re.fullmatch(r"checkpoint_(\d+)", entry)
+            path = os.path.join(self.run_dir, entry)
+            if m is None or not os.path.isdir(path):
+                continue
+            metrics: Dict[str, Any] = {}
+            try:
+                with open(os.path.join(path, "_tune_metrics.json")) as f:
+                    metrics = json.load(f)
+            except (OSError, ValueError):
+                pass
+            found.append((int(m.group(1)), path, metrics))
+        found.sort()
+        self._kept = [(p, m) for _, p, m in found]
+        self._count = found[-1][0] if found else 0
 
     def best_checkpoint(self) -> Optional[Checkpoint]:
         attr = self.config.checkpoint_score_attribute

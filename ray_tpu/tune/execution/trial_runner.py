@@ -41,6 +41,7 @@ class TrialRunner:
         searcher=None,
         num_samples: int = 0,
         trial_factory=None,
+        experiment_dir: Optional[str] = None,
     ):
         self._train_fn = train_fn
         self.trials = trials
@@ -57,8 +58,36 @@ class TrialRunner:
         self._experiment_name = experiment_name
         self._actors: Dict[str, Any] = {}  # trial_id -> actor handle
         self._refs: Dict[Any, Trial] = {}  # outstanding next_result ref -> trial
+        self._experiment_dir = experiment_dir
         for t in trials:
             self._scheduler.on_trial_add(self, t)
+
+    def _save_state(self, force: bool = False) -> None:
+        """Journal every trial's state to <experiment_dir>/experiment_state.json
+        (atomic replace) so a killed driver can `Tuner.restore` (reference:
+        `TrialRunner.checkpoint`, throttled like the reference's
+        `checkpoint_period`). Lifecycle transitions force a write; per-report
+        writes are rate-limited — the journal is O(all trials) JSON."""
+        if self._experiment_dir is None:
+            return
+        now = time.time()
+        if not force and now - getattr(self, "_last_journal", 0.0) < 2.0:
+            return
+        self._last_journal = now
+        import json
+        import os
+
+        path = os.path.join(self._experiment_dir, "experiment_state.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"trials": [t.to_state() for t in self.trials]}, f)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 — journaling must never kill the run
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------ launch
     def _actor_options(self) -> Dict[str, Any]:
@@ -91,6 +120,7 @@ class TrialRunner:
         trial.status = trial_mod.RUNNING
         self._actors[trial.trial_id] = actor
         self._refs[actor.next_result.remote()] = trial
+        self._save_state(force=True)
 
     def _teardown(self, trial: Trial) -> None:
         actor = self._actors.pop(trial.trial_id, None)
@@ -122,6 +152,7 @@ class TrialRunner:
             self._launch(trial)
 
     def _complete(self, trial: Trial, error: bool = False) -> None:
+        self._save_state(force=True)
         self._scheduler.on_trial_complete(self, trial)
         if self._searcher is not None:
             self._searcher.on_trial_complete(
@@ -169,6 +200,7 @@ class TrialRunner:
                     trial.last_result = metrics
                     if tr.checkpoint is not None:
                         trial.checkpoint_manager.register(tr.checkpoint, metrics)
+                    self._save_state()
                     if self._should_stop(metrics):
                         decision = STOP
                     else:

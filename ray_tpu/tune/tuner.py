@@ -39,6 +39,65 @@ class Tuner:
         self._param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        # Set by Tuner.restore(): resume journaled trials instead of starting
+        # fresh ones.
+        self._restore_dir: Optional[str] = None
+        self._resume_errored = False
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable: Optional[Union[Callable, BaseTrainer]] = None,
+        *,
+        resume_errored: bool = False,
+    ) -> "Tuner":
+        """Resume a killed/interrupted experiment from its directory
+        (reference: `python/ray/tune/tuner.py:175 Tuner.restore`).
+
+        Finished trials keep their journaled results and checkpoints;
+        unfinished trials re-run, resuming from their latest checkpoint;
+        errored trials re-run only with `resume_errored=True`. `trainable`
+        may be re-supplied (required if the saved one fails to load)."""
+        import pickle
+
+        path = os.path.expanduser(path)
+        state_file = os.path.join(path, "experiment_state.json")
+        if not os.path.exists(state_file):
+            raise FileNotFoundError(
+                f"no experiment journal at {state_file}; was this experiment "
+                "run by Tuner.fit()?"
+            )
+        spec: Dict[str, Any] = {}
+        try:
+            with open(os.path.join(path, "tuner.pkl"), "rb") as f:
+                spec = pickle.load(f)
+        except Exception:  # noqa: BLE001 — trainable may be passed anew
+            if trainable is None:
+                raise ValueError(
+                    "could not load the saved tuner spec; pass `trainable=`"
+                ) from None
+        if trainable is None:
+            trainable = spec.get("trainable")
+        if trainable is None:
+            raise ValueError("saved spec has no trainable; pass `trainable=`")
+        tuner = cls(
+            trainable,
+            param_space=spec.get("param_space"),
+            tune_config=spec.get("tune_config"),
+            run_config=spec.get("run_config"),
+        )
+        tuner.run_config.name = os.path.basename(path.rstrip("/"))
+        tuner.run_config.storage_path = os.path.dirname(path.rstrip("/"))
+        tuner._restore_dir = path
+        tuner._resume_errored = resume_errored
+        return tuner
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(
+            os.path.join(os.path.expanduser(path), "experiment_state.json")
+        )
 
     def _resolve_trainable(self) -> Callable[[Dict[str, Any]], None]:
         if isinstance(self._trainable, BaseTrainer):
@@ -56,9 +115,22 @@ class Tuner:
         base = self.run_config.storage_path or default_storage_path()
         experiment_dir = os.path.join(os.path.expanduser(base), name)
         os.makedirs(experiment_dir, exist_ok=True)
+        self._save_spec(experiment_dir)
 
         searcher = self.tune_config.search_alg
-        if searcher is not None:
+        if self._restore_dir is not None:
+            trials = self._restored_trials(name)
+            if searcher is not None:
+                # Journaled trials carry their configs; the searcher (fresh
+                # state — observations are not replayed) suggests only the
+                # remaining num_samples - len(trials) samples.
+                searcher.set_search_properties(
+                    self.tune_config.metric,
+                    self.tune_config.mode,
+                    self._param_space,
+                    seed=self.tune_config.search_seed,
+                )
+        elif searcher is not None:
             searcher.set_search_properties(
                 self.tune_config.metric,
                 self.tune_config.mode,
@@ -100,8 +172,51 @@ class Tuner:
             searcher=searcher,
             num_samples=self.tune_config.num_samples if searcher is not None else 0,
             trial_factory=lambda i: Trial({}, experiment_dir, i, experiment_name=name),
+            experiment_dir=experiment_dir,
         )
         runner.run()
         return ResultGrid(
             runner.results(), metric=self.tune_config.metric, mode=self.tune_config.mode
         )
+
+    # ---------------------------------------------------------------- resume
+    def _save_spec(self, experiment_dir: str) -> None:
+        """Persist the tuner spec so `Tuner.restore(path)` can rebuild it."""
+        from ray_tpu._private import serialization
+
+        try:
+            blob = serialization.dumps({
+                "trainable": self._trainable,
+                "param_space": self._param_space,
+                "tune_config": self.tune_config,
+                "run_config": self.run_config,
+            })
+            tmp = os.path.join(experiment_dir, f"tuner.pkl.tmp.{os.getpid()}")
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(experiment_dir, "tuner.pkl"))
+        except Exception:  # noqa: BLE001 — unpicklable trainable: restore
+            pass  # will require re-passing trainable=
+
+    def _restored_trials(self, name: str):
+        """Rebuild trials from the experiment journal: finished trials keep
+        results/checkpoints; unfinished ones go PENDING and resume from their
+        latest persisted checkpoint."""
+        import json
+
+        from ray_tpu.tune.experiment import trial as trial_mod
+
+        with open(os.path.join(self._restore_dir, "experiment_state.json")) as f:
+            states = json.load(f)["trials"]
+        trials = []
+        for st in states:
+            t = Trial.from_state(st, self._restore_dir, experiment_name=name)
+            rerun = t.status in (trial_mod.PENDING, trial_mod.RUNNING) or (
+                t.status == trial_mod.ERROR and self._resume_errored
+            )
+            if rerun:
+                t.status = trial_mod.PENDING
+                t.error = None
+                t.restore_checkpoint = t.checkpoint  # latest persisted, if any
+            trials.append(t)
+        return trials

@@ -1,0 +1,155 @@
+"""Tuner.restore: experiment-level resume after a killed driver
+(reference: `python/ray/tune/tuner.py:175`, `tests/test_tuner_restore.py`).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The experiment script: 6 trials x 4 iterations, each iteration ~0.4s,
+# 2 concurrent. Each trial appends to runs.log on every start, so the test
+# can count re-executions. Checkpoints carry the iteration for resume.
+SCRIPT = """
+import sys, os, time
+sys.path.insert(0, {repo!r})
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import session
+from ray_tpu.air.checkpoint import Checkpoint
+
+EXP_DIR = {exp_dir!r}
+
+def trainable(config):
+    with open(os.path.join(EXP_DIR, "runs.log"), "a") as f:
+        f.write(f"start x={{config['x']}}\\n")
+    start = 0
+    ckpt = session.get_checkpoint()
+    if ckpt is not None:
+        start = ckpt.to_dict()["iter"]
+    for i in range(start, 4):
+        time.sleep(0.4)
+        session.report(
+            {{"score": config["x"] * 10 + i, "iter_done": i + 1}},
+            checkpoint=Checkpoint.from_dict({{"iter": i + 1}}),
+        )
+
+ray_tpu.init(num_cpus=2)
+tuner = tune.Tuner(
+    trainable,
+    param_space={{"x": tune.grid_search([0, 1, 2, 3, 4, 5])}},
+    tune_config=tune.TuneConfig(metric="score", mode="max",
+                                max_concurrent_trials=2),
+    run_config=ray_tpu.air.RunConfig(
+        name={name!r}, storage_path={storage!r}),
+)
+tuner.fit()
+print("FIT DONE")
+"""
+
+
+def test_restore_after_driver_kill(tmp_path):
+    storage = str(tmp_path)
+    name = "exp_kill"
+    exp_dir = os.path.join(storage, name)
+    os.makedirs(exp_dir, exist_ok=True)
+    script = SCRIPT.format(repo=REPO, exp_dir=exp_dir, name=name, storage=storage)
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    # Let roughly half the experiment finish, then kill the driver hard.
+    state_file = os.path.join(exp_dir, "experiment_state.json")
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        if os.path.exists(state_file):
+            with open(state_file) as f:
+                trials = json.load(f)["trials"]
+            if sum(t["status"] == "TERMINATED" for t in trials) >= 2:
+                break
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        pytest.fail("experiment never reached 2 finished trials")
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+    # The SIGKILLed driver can't clean its /dev/shm session (arena files are
+    # large; leaking them starves later sessions on this box).
+    import glob
+    import shutil
+
+    for d in glob.glob(f"/dev/shm/ray_tpu_session_{proc.pid}_*"):
+        shutil.rmtree(d, ignore_errors=True)
+
+    with open(state_file) as f:
+        before = json.load(f)["trials"]
+    done_before = {t["trial_id"] for t in before if t["status"] == "TERMINATED"}
+    assert 2 <= len(done_before) < 6
+
+    # Restore in this process and finish the plan.
+    ray_tpu.init(num_cpus=2)
+    try:
+        assert tune.Tuner.can_restore(exp_dir)
+        tuner = tune.Tuner.restore(exp_dir)
+        grid = tuner.fit()
+        results = list(grid)
+        assert len(results) == 6
+        scores = sorted(r.metrics["score"] for r in results)
+        # Every trial reached iteration 4: score = 10x + 3.
+        assert scores == [3, 13, 23, 33, 43, 53], scores
+        # Finished trials were NOT re-executed: each x appears once per
+        # execution; finished ones ran exactly once in the subprocess.
+        with open(os.path.join(exp_dir, "runs.log")) as f:
+            starts = f.read().count("start")
+        done_n = len(done_before)
+        # 6 first executions + re-starts only for the unfinished trials.
+        assert starts <= 6 + (6 - done_n), (starts, done_n)
+        # Resumed-from-checkpoint trials continued, not restarted: best
+        # checkpoint of every result says iter=4.
+        for r in results:
+            assert r.checkpoint.to_dict()["iter"] == 4
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_restore_errored_trials(tmp_path):
+    ray_tpu.init(num_cpus=2)
+    try:
+        flag = str(tmp_path / "fail_once")
+
+        def trainable(config):
+            from ray_tpu.air import session
+
+            if config["x"] == 1 and not os.path.exists(flag):
+                with open(flag, "w") as f:
+                    f.write("x")
+                raise RuntimeError("flaky failure")
+            session.report({"score": config["x"]})
+
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([0, 1, 2])},
+            run_config=ray_tpu.air.RunConfig(
+                name="exp_err", storage_path=str(tmp_path)),
+        )
+        grid = tuner.fit()
+        assert sum(1 for r in grid if r.error is not None) == 1
+
+        restored = tune.Tuner.restore(
+            str(tmp_path / "exp_err"), resume_errored=True
+        )
+        grid2 = restored.fit()
+        assert all(r.error is None for r in grid2)
+        assert sorted(r.metrics["score"] for r in grid2) == [0, 1, 2]
+    finally:
+        ray_tpu.shutdown()
