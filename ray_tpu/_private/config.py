@@ -56,11 +56,18 @@ class Config:
     # its path happens to be readable (single-machine multi-daemon clusters share
     # a filesystem), so the inter-node pull path is exercised.
     force_object_pulls: bool = False
+    # Fail cross-node pulls that would relay through the head instead of the
+    # peer-direct daemon data plane (testing/ops guard for the head NIC).
+    disable_pull_relay: bool = False
 
     # --- scheduling ---
     # Hybrid policy threshold: pack onto the best node until its utilization
     # exceeds this, then spread (reference: `hybrid_scheduling_policy.cc`).
     scheduler_spread_threshold: float = 0.5
+    # Locality-aware placement: argument objects at least this large pull a
+    # task toward the node holding them (reference: LocalityAwareLeasePolicy,
+    # `lease_policy.h:56`).
+    scheduler_locality_min_bytes: int = 100_000
     # How long a leased idle worker is kept before being returned to the pool.
     idle_worker_killing_time_threshold_ms: int = 1000
     # Max stateless workers started per node beyond num_cpus (oversubscription to

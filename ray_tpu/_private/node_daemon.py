@@ -45,15 +45,95 @@ class NodeDaemon:
         self._stop = threading.Event()
         self.conn = None
         self.node_id_hex = ""
+        self._data_listener = None
+
+    def _local_host(self) -> str:
+        """The address peers can reach this daemon at: the interface used to
+        talk to the head."""
+        import socket
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((self.head_host, self.head_port or 1))
+            return s.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+        finally:
+            s.close()
+
+    def _start_data_server(self):
+        """Peer-direct data plane: serve segment reads straight to readers on
+        other nodes, so object pulls skip the head relay (reference:
+        peer-to-peer transfer in `object_manager.cc`). Framed-pickle protocol
+        with the cluster authkey, like every other connection. WITHOUT an
+        authkey the server does not start (an open listener would be an
+        arbitrary-read endpoint); pulls then ride the authenticated relay."""
+        from multiprocessing.connection import Listener
+
+        authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", "")) or None
+        if authkey is None:
+            return None
+        self._data_listener = Listener(("0.0.0.0", 0), authkey=authkey)
+        port = self._data_listener.address[1]
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn = self._data_listener.accept()
+                except Exception:  # noqa: BLE001 — OSError/EOF/AuthenticationError
+                    if self._stop.is_set():
+                        return
+                    continue
+                threading.Thread(
+                    target=self._serve_data_conn, args=(conn,),
+                    daemon=True, name="data-serve",
+                ).start()
+
+        threading.Thread(target=accept_loop, daemon=True, name="data-accept").start()
+        return f"{self._local_host()}:{port}"
+
+    def _serve_data_conn(self, conn):
+        from ray_tpu._private.object_store import read_segment
+
+        shm_root = os.path.realpath(self.shm_dir)
+        try:
+            while True:
+                path, offset, length = serialization.loads(conn.recv_bytes())
+                try:
+                    # Only segments under this node's store dir are servable —
+                    # the wire must not become an arbitrary-file-read endpoint.
+                    real = os.path.realpath(path)
+                    if not real.startswith(shm_root + os.sep) and real != shm_root:
+                        raise PermissionError(f"path outside store dir: {path}")
+                    data = read_segment(real, offset, length)
+                    conn.send_bytes(serialization.dumps((True, data)))
+                except OSError as e:
+                    conn.send_bytes(serialization.dumps((False, repr(e))))
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def connect(self):
         from multiprocessing.connection import Client
 
         authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", ""))
+        data_address = self._start_data_server()
         self.conn = Client((self.head_host, self.head_port), authkey=authkey)
         self.conn.send_bytes(
             serialization.dumps(
-                ("daemon", {"resources": self.resources, "labels": self.labels, "shm_dir": self.shm_dir})
+                (
+                    "daemon",
+                    {
+                        "resources": self.resources,
+                        "labels": self.labels,
+                        "shm_dir": self.shm_dir,
+                        "data_address": data_address,
+                    },
+                )
             )
         )
         reply = serialization.loads(self.conn.recv_bytes())
@@ -122,15 +202,11 @@ class NodeDaemon:
     def _read_object(self, token: int, path: str, offset=None, length=None):
         # Off-thread: a large segment read must not block spawn/kill commands.
         # Arena objects read [offset, offset+length) of the arena file.
+        from ray_tpu._private.object_store import read_segment
+
         def _read():
             try:
-                with open(path, "rb") as f:
-                    if offset is not None:
-                        f.seek(offset)
-                        data = f.read(length)
-                    else:
-                        data = f.read()
-                self._send(("object_data", token, True, data))
+                self._send(("object_data", token, True, read_segment(path, offset, length)))
             except OSError as e:
                 self._send(("object_data", token, False, repr(e)))
 

@@ -237,17 +237,81 @@ def write_segment(dir_path: str, object_id: ObjectID, sv: SerializedValue) -> Ob
     )
 
 
-def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn, force_remote: bool) -> ObjectMeta:
+def read_segment(path: str, offset: Optional[int], length: Optional[int]) -> bytes:
+    """Read a whole segment file, or an arena allocation's [offset, offset+length)
+    slice. The single read used by the head relay, the daemon command path,
+    and the peer-direct data server."""
+    with open(path, "rb") as f:
+        if offset is not None:
+            f.seek(offset)
+            return f.read(length)
+        return f.read()
+
+
+# Peer-direct data-plane connections: per-address (conn, lock) so one hung
+# peer cannot serialize fetches from healthy ones.
+_peer_conns: Dict[str, Tuple[object, threading.Lock]] = {}
+_peer_lock = threading.Lock()
+# Nodes known to advertise no data server: skip the locate round-trip.
+_no_peer_nodes: set = set()
+
+
+def _fetch_peer(address: str, meta: ObjectMeta, timeout: float = 30.0) -> Optional[bytes]:
+    """Pull a segment's bytes straight from the owning daemon's data server
+    (reference: peer-to-peer object transfer, `object_manager.cc`); None on
+    any failure or timeout — the caller falls back to the head relay."""
+    from multiprocessing.connection import Client
+
+    from ray_tpu._private import serialization
+
+    host, _, port = address.rpartition(":")
+    authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", "")) or None
+    with _peer_lock:
+        entry = _peer_conns.get(address)
+    conn = None
+    try:
+        if entry is None:
+            conn = Client((host, int(port)), authkey=authkey)
+            entry = (conn, threading.Lock())
+            with _peer_lock:
+                _peer_conns[address] = entry
+        conn, conn_lock = entry
+        # One request/response at a time per CONNECTION; a bounded poll keeps
+        # a dead peer from hanging the task past the pull timeout.
+        with conn_lock:
+            conn.send_bytes(
+                serialization.dumps((meta.segment, meta.arena_offset, meta.size))
+            )
+            if not conn.poll(timeout):
+                raise TimeoutError(f"peer {address} did not answer in {timeout}s")
+            ok, data = serialization.loads(conn.recv_bytes())
+        return data if ok else None
+    except Exception:  # noqa: BLE001 — any wire failure: drop conn, fall back
+        with _peer_lock:
+            _peer_conns.pop(address, None)
+        try:
+            if conn is not None:
+                conn.close()
+        except Exception:
+            pass
+        return None
+
+
+def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn,
+                     force_remote: bool, locate_fn=None) -> ObjectMeta:
     """Return a meta whose segment is readable from this process, pulling the
-    bytes through `pull_fn(object_key) -> (meta, bytes)` when the segment lives
-    on another node. The single implementation behind every reader path (worker
-    task args, driver get, client-driver get) so pull semantics cannot drift.
+    bytes when the segment lives on another node. The single implementation
+    behind every reader path (worker task args, driver get, client-driver get)
+    so pull semantics cannot drift.
 
     - Same-node (or same-filesystem) segments are used in place: zero-copy.
     - `force_remote` (Config.force_object_pulls) treats other-node segments as
       unreadable even on a shared filesystem, to exercise the wire path.
-    - Pulled bytes are cached under the segment's basename in the local store
-      dir; later reads hit the cache instead of re-transferring.
+    - With `locate_fn(key) -> (meta, data_address)` the bytes come PEER-DIRECT
+      from the owning daemon's data server; `pull_fn(key) -> (meta, bytes)`
+      (head relay) is the fallback.
+    - Pulled bytes are cached under the object id in the local store dir;
+      later reads hit the cache instead of re-transferring.
     """
     import dataclasses
 
@@ -261,7 +325,26 @@ def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn, force
     local_path = os.path.join(store.shm_dir, meta.object_id.hex())
     if os.path.exists(local_path):
         return dataclasses.replace(meta, segment=local_path, arena_offset=None)
-    fetched, data = pull_fn(meta.object_id.binary())
+    fetched = data = None
+    if locate_fn is not None and meta.node_id not in _no_peer_nodes:
+        try:
+            located, addr = locate_fn(meta.object_id.binary())
+        except Exception:  # noqa: BLE001 — stale meta etc.: use the relay
+            located, addr = None, None
+        if located is not None and located.segment is not None and addr:
+            from ray_tpu._private.config import get_config
+
+            peer_bytes = _fetch_peer(
+                addr, located, timeout=get_config().object_pull_timeout_s
+            )
+            if peer_bytes is not None:
+                fetched, data = located, peer_bytes
+        elif located is not None and addr is None and located.node_id:
+            # Owner has no data server (head-local / client driver / virtual
+            # node): remember, so later pulls skip the locate round-trip.
+            _no_peer_nodes.add(located.node_id)
+    if fetched is None:
+        fetched, data = pull_fn(meta.object_id.binary())
     if fetched.segment is None:
         return fetched  # became inline (e.g. error overwrite)
     local_path = os.path.join(store.shm_dir, fetched.object_id.hex())

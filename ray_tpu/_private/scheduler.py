@@ -209,6 +209,9 @@ class NodeState:
     # Set for nodes backed by a separate daemon process; None for the head's
     # in-process node and virtual test nodes.
     daemon: Optional[DaemonHandle] = None
+    # "host:port" of the daemon's data server: readers pull segments straight
+    # from the owning node instead of relaying through the head.
+    data_address: Optional[str] = None
     # Last time work was dispatched here (autoscaler idle detection).
     last_active: float = field(default_factory=time.time)
 
@@ -454,6 +457,7 @@ class Scheduler:
             available=dict(resources),
             shm_dir=info["shm_dir"],
             labels=dict(info.get("labels") or {}),
+            data_address=info.get("data_address"),
         )
         daemon = DaemonHandle(node_id, conn)
         node.daemon = daemon
@@ -2060,6 +2064,30 @@ class Scheduler:
         self._respond(wh, req_id, True, getattr(self, "_cmd_" + name)(arg))
 
     # ------------------------------------------------------------------ object pulls
+    def _locate_object(self, object_key: bytes):
+        """(meta, data_address): where an object's bytes live. With a
+        data_address the reader pulls PEER-DIRECT from the owning daemon's
+        data server (reference: peer-to-peer chunk transfer,
+        `object_manager.cc`); None falls back to the head relay."""
+        meta = self.object_table.get(object_key)
+        if meta is None:
+            raise KeyError("object not sealed")
+        addr = None
+        if meta.segment is not None and meta.node_id:
+            node = self.nodes.get(NodeID(meta.node_id))
+            if node is not None and node.alive:
+                addr = node.data_address
+        return meta, addr
+
+    def _cmd_locate_object(self, object_key: bytes):
+        return self._locate_object(object_key)
+
+    def _req_locate_object(self, wh, req_id: int, object_key: bytes):
+        try:
+            self._respond(wh, req_id, True, self._locate_object(object_key))
+        except KeyError as e:
+            self._respond(wh, req_id, False, e)
+
     def _req_pull_object(self, wh, req_id: int, object_key: bytes):
         """A reader is missing a sealed object's segment locally: relay the bytes
         from whichever node (daemon or client driver) holds them. The 2-hop relay
@@ -2095,6 +2123,18 @@ class Scheduler:
             respond(True, (meta, None))
             return
         source = self._pull_sources.get(meta.node_id or b"")
+        if source is not None and self.config.disable_pull_relay:
+            # Test/ops guard: when the owner HAS a data server, cross-node
+            # bytes must ride the peer-direct plane; a relay request means
+            # that path failed. Owners without one (client drivers) have no
+            # alternative — the relay stays allowed for them.
+            owner = self.nodes.get(NodeID(meta.node_id)) if meta.node_id else None
+            if owner is not None and owner.data_address:
+                respond(False, RuntimeError(
+                    "head relay is disabled (disable_pull_relay); peer-direct "
+                    "pull from the owning daemon failed or was bypassed"
+                ))
+                return
         if source is None:
             # Head-local: virtual nodes and the head node share the head's shm
             # dir, so the segment is directly readable here. Read off-thread —
@@ -2102,13 +2142,10 @@ class Scheduler:
             # lock-protected sends, safe from other threads). Arena objects
             # read their allocation slice of the arena file.
             def _read_and_respond():
+                from ray_tpu._private.object_store import read_segment
+
                 try:
-                    with open(meta.segment, "rb") as f:
-                        if meta.arena_offset is not None:
-                            f.seek(meta.arena_offset)
-                            data = f.read(meta.size)
-                        else:
-                            data = f.read()
+                    data = read_segment(meta.segment, meta.arena_offset, meta.size)
                 except OSError as e:
                     respond(False, e)
                     return
@@ -2648,6 +2685,22 @@ class Scheduler:
                 return None
             self._rr_counter += 1
             return feasible[self._rr_counter % len(feasible)]
+        # Data locality (reference: `lease_policy.h:56 LocalityAwareLeasePolicy`):
+        # prefer the feasible node already holding the most argument bytes, so
+        # a task chases its data instead of pulling it across the wire. Small
+        # args don't drive placement (scheduler_locality_min_bytes).
+        loc = self._locality_bytes(rec)
+        if loc:
+            best_node, best_bytes = None, 0
+            for nid in self.node_order:
+                node = self.nodes[nid]
+                if not node.alive or not _fits(node.available, rec.spec.resources):
+                    continue
+                b = loc.get(nid.binary(), 0)
+                if b > best_bytes:
+                    best_node, best_bytes = node, b
+            if best_node is not None:
+                return best_node
         threshold = self.config.scheduler_spread_threshold
         best: Optional[NodeState] = None
         for nid in self.node_order:
@@ -2659,6 +2712,23 @@ class Scheduler:
             if best is None or node.utilization() < best.utilization():
                 best = node
         return best
+
+    def _locality_bytes(self, rec: TaskRecord) -> Dict[bytes, int]:
+        """Per-node resident bytes of this task's object arguments."""
+        out: Dict[bytes, int] = {}
+        min_b = self.config.scheduler_locality_min_bytes
+        for kind, v in list(rec.arg_entries) + list(rec.kwarg_entries.values()):
+            if kind != "id":
+                continue
+            meta = self.object_table.get(v)
+            if (
+                meta is not None
+                and meta.segment is not None
+                and meta.node_id
+                and meta.size >= min_b
+            ):
+                out[meta.node_id] = out.get(meta.node_id, 0) + meta.size
+        return out
 
     def _try_dispatch(self, rec: TaskRecord) -> bool:
         # 1) dependencies

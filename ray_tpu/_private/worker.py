@@ -501,8 +501,12 @@ class DriverContext:
                     f"object pull timed out after {get_config().object_pull_timeout_s}s"
                 ) from None
 
+        def locate(key: bytes):
+            return self.scheduler.call("locate_object", key).result()
+
         return resolve_for_read(
-            global_worker.store, meta, pull, get_config().force_object_pulls
+            global_worker.store, meta, pull, get_config().force_object_pulls,
+            locate_fn=locate,
         )
 
 
@@ -669,8 +673,14 @@ class RemoteDriverContext:
                     f"object pull timed out after {get_config().object_pull_timeout_s}s"
                 ) from None
 
+        def locate(key: bytes):
+            return self.wc.request(
+                "locate_object", key, timeout=get_config().object_pull_timeout_s
+            )
+
         return resolve_for_read(
-            global_worker.store, meta, pull, get_config().force_object_pulls
+            global_worker.store, meta, pull, get_config().force_object_pulls,
+            locate_fn=locate,
         )
 
 

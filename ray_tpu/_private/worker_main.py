@@ -187,9 +187,10 @@ class WorkerRuntime:
         return asyncio.run_coroutine_threadsafe(coro, self._aio_loop).result()
 
     def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
-        """Make a segment-backed object readable on this node, pulling the bytes
-        from the owning node through the head if the path is not present (the
-        reader-side of the reference's PullManager, `pull_manager.h:52`)."""
+        """Make a segment-backed object readable on this node, pulling the
+        bytes PEER-DIRECT from the owning daemon's data server when one
+        exists, else relaying through the head (the reader-side of the
+        reference's PullManager, `pull_manager.h:52`)."""
         from ray_tpu._private.object_store import resolve_for_read
 
         def pull(key: bytes):
@@ -197,7 +198,15 @@ class WorkerRuntime:
                 "pull_object", key, timeout=self.args.config.object_pull_timeout_s
             )
 
-        return resolve_for_read(self.store, meta, pull, self.args.config.force_object_pulls)
+        def locate(key: bytes):
+            return self.wc.request(
+                "locate_object", key, timeout=self.args.config.object_pull_timeout_s
+            )
+
+        return resolve_for_read(
+            self.store, meta, pull, self.args.config.force_object_pulls,
+            locate_fn=locate,
+        )
 
     def fetch_value(self, meta: ObjectMeta):
         """Read an object value, reconstructing from lineage if its bytes were

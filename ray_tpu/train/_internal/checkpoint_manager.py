@@ -34,12 +34,13 @@ class CheckpointManager:
         self._count += 1
         path = os.path.join(self.run_dir, f"checkpoint_{self._count:06d}")
         checkpoint.to_directory(path)
-        # Metrics sidecar so a restored experiment (Tuner.restore) can rebuild
-        # best-checkpoint rankings from disk.
+        # Metrics sidecar (NEXT TO the checkpoint dir, never inside it — the
+        # directory is user data exposed by to_dict()/to_directory()) so a
+        # restored experiment (Tuner.restore) can rebuild rankings from disk.
         try:
             import json
 
-            with open(os.path.join(path, "_tune_metrics.json"), "w") as f:
+            with open(f"{path}._tune_metrics.json", "w") as f:
                 json.dump({k: v for k, v in (metrics or {}).items()
                            if isinstance(v, (int, float, str, bool))}, f)
         except (OSError, TypeError):
@@ -62,7 +63,7 @@ class CheckpointManager:
                 continue
             metrics: Dict[str, Any] = {}
             try:
-                with open(os.path.join(path, "_tune_metrics.json")) as f:
+                with open(f"{path}._tune_metrics.json") as f:
                     metrics = json.load(f)
             except (OSError, ValueError):
                 pass
@@ -107,3 +108,7 @@ class CheckpointManager:
                 )[0]
             path, _ = self._kept.pop(victim)
             shutil.rmtree(path, ignore_errors=True)
+            try:
+                os.unlink(f"{path}._tune_metrics.json")
+            except OSError:
+                pass

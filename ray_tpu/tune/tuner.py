@@ -77,6 +77,14 @@ class Tuner:
                 raise ValueError(
                     "could not load the saved tuner spec; pass `trainable=`"
                 ) from None
+            import warnings
+
+            warnings.warn(
+                "tuner.pkl could not be loaded: restoring with DEFAULT "
+                "TuneConfig/RunConfig (metric/mode/num_samples/stop from the "
+                "original run are lost)",
+                stacklevel=2,
+            )
         if trainable is None:
             trainable = spec.get("trainable")
         if trainable is None:
