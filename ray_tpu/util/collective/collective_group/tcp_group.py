@@ -2,11 +2,17 @@
 analogue of the reference's pygloo-backed `GlooGroup`
 (`python/ray/util/collective/collective_group/gloo_collective_group.py`).
 
-Topology: rank 0 runs a coordinator server; every rank keeps one persistent
-connection to it. Collectives are sequence-numbered: the coordinator gathers all
-world_size contributions for a sequence, computes, and replies. This is O(N)
-through rank 0 — fine for control-plane payloads (rendezvous metadata, metrics,
-small gradients in tests); bulk tensor traffic belongs on the XLA/ICI backend.
+Topology, two planes:
+ - CONTROL (star): rank 0 runs a coordinator server; every rank keeps one
+   persistent connection to it. Small collectives (barrier, broadcast,
+   rendezvous metadata, sub-threshold allreduce) and p2p mailboxes ride it —
+   one round trip, lowest latency.
+ - BULK (ring): ranks additionally form a neighbor ring (rank r -> r+1) and
+   large allreduces run the classic chunked ring algorithm (reduce-scatter
+   then allgather, gloo's `allreduce_ring_chunked`): per step each rank
+   streams 1/N of the buffer to its neighbor while receiving another 1/N,
+   so per-link traffic is 2(N-1)/N x B regardless of N — bus bandwidth stays
+   flat-to-rising with message size instead of collapsing through rank 0.
 
 Rendezvous mirrors the reference's named-actor `NCCLUniqueIDStore`
 (`nccl_collective_group.py:28-60`) but uses the GCS KV (SURVEY.md §5: "rendezvous
@@ -169,6 +175,26 @@ class _Coordinator:
             pass
 
 
+# Below this, the one-round-trip star is faster than ring setup/steps.
+_RING_THRESHOLD_BYTES = 64 * 1024
+# Per-transfer slice of each ring step (bounds peak buffering; large enough
+# that syscall overhead amortizes).
+_RING_PIECE_BYTES = 4 * 1024 * 1024
+
+
+def _combine(acc: np.ndarray, other: np.ndarray, op: ReduceOp) -> None:
+    if op in (ReduceOp.SUM, ReduceOp.MEAN):
+        acc += other
+    elif op == ReduceOp.PRODUCT:
+        acc *= other
+    elif op == ReduceOp.MIN:
+        np.minimum(acc, other, out=acc)
+    elif op == ReduceOp.MAX:
+        np.maximum(acc, other, out=acc)
+    else:
+        raise ValueError(f"unsupported reduce op {op}")
+
+
 class TCPGroup(BaseGroup):
     def __init__(self, world_size: int, rank: int, group_name: str, kv):
         super().__init__(world_size, rank, group_name)
@@ -190,6 +216,10 @@ class TCPGroup(BaseGroup):
         # Per-peer FIFO sequence counters for p2p.
         self._send_seqs: Dict[int, int] = {}
         self._recv_seqs: Dict[int, int] = {}
+        # Bulk ring links (lazy: built on the first large allreduce).
+        self._ring_next: Optional[socket.socket] = None
+        self._ring_prev: Optional[socket.socket] = None
+        self._ring_lock = threading.Lock()
 
     def _round_trip(self, msg: Dict[str, Any]) -> Any:
         with self._sock_lock:
@@ -200,8 +230,109 @@ class TCPGroup(BaseGroup):
         self._seq += 1
         return self._seq
 
+    # ----------------------------------------------------------------- ring
+    def _ensure_ring(self):
+        """Build the neighbor ring: every rank listens, publishes its address,
+        connects to rank+1, and accepts from rank-1."""
+        if self._ring_next is not None or self.world_size == 1:
+            return
+        with self._ring_lock:
+            if self._ring_next is not None:
+                return
+            server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            server.bind(("127.0.0.1", 0))
+            server.listen(2)
+            key = f"collective/{self.group_name}/ring/{self.rank}".encode()
+            publish(self._kv, key, f"127.0.0.1:{server.getsockname()[1]}".encode())
+            nxt = (self.rank + 1) % self.world_size
+            nkey = f"collective/{self.group_name}/ring/{nxt}".encode()
+            host, port = wait_for(self._kv, nkey).decode().split(":")
+            # Connect-to-next and accept-from-prev in parallel (both block).
+            out: Dict[str, Any] = {}
+
+            def _accept():
+                conn, _ = server.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                out["prev"] = conn
+
+            t = threading.Thread(target=_accept, daemon=True)
+            t.start()
+            nxt_sock = socket.create_connection((host, int(port)), timeout=60)
+            nxt_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t.join(timeout=60)
+            server.close()
+            if "prev" not in out:
+                raise ConnectionError("ring neighbor never connected")
+            self._ring_prev = out["prev"]
+            self._ring_next = nxt_sock
+
+    def _ring_exchange(self, send_view: memoryview, recv_buf: memoryview):
+        """One ring step: stream send_view to next while filling recv_buf from
+        prev, in bounded pieces so neither side waits for the whole chunk."""
+        send_err: List[BaseException] = []
+
+        def _sender():
+            try:
+                for off in range(0, len(send_view), _RING_PIECE_BYTES):
+                    self._ring_next.sendall(send_view[off:off + _RING_PIECE_BYTES])
+            except BaseException as e:  # noqa: BLE001
+                send_err.append(e)
+
+        t = threading.Thread(target=_sender, daemon=True)
+        t.start()
+        got = 0
+        while got < len(recv_buf):
+            n = self._ring_prev.recv_into(recv_buf[got:], len(recv_buf) - got)
+            if n == 0:
+                raise ConnectionError("ring peer closed connection")
+            got += n
+        t.join()
+        if send_err:
+            raise send_err[0]
+
+    def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
+        """Chunked ring allreduce: N-1 reduce-scatter steps then N-1 allgather
+        steps; each step moves 1/N of the buffer per link."""
+        self._ensure_ring()
+        n, r = self.world_size, self.rank
+        flat = np.ascontiguousarray(arr).reshape(-1).copy()
+        # Chunk boundaries (last chunks may be smaller).
+        counts = [len(flat) // n + (1 if i < len(flat) % n else 0) for i in range(n)]
+        offsets = [0]
+        for c in counts[:-1]:
+            offsets.append(offsets[-1] + c)
+
+        def chunk(i):
+            i %= n
+            return flat[offsets[i]:offsets[i] + counts[i]]
+
+        scratch = np.empty(max(counts), dtype=flat.dtype)
+        # Phase 1: reduce-scatter. After step s, chunk (r-s-1) holds the
+        # running combination of s+2 ranks' contributions.
+        for s in range(n - 1):
+            send_c = chunk(r - s)
+            recv_c = chunk(r - s - 1)
+            recv_view = scratch[:len(recv_c)]
+            self._ring_exchange(memoryview(send_c).cast("B"), memoryview(recv_view).cast("B"))
+            _combine(recv_c, recv_view, op)
+        # Phase 2: allgather the fully reduced chunks around the ring.
+        for s in range(n - 1):
+            send_c = chunk(r + 1 - s)
+            recv_c = chunk(r - s)
+            self._ring_exchange(memoryview(send_c).cast("B"), memoryview(recv_c).cast("B"))
+        if op == ReduceOp.MEAN:
+            flat /= n
+        return flat.reshape(arr.shape)
+
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         arr = np.asarray(tensor)
+        if (
+            self.world_size > 1
+            and arr.nbytes >= _RING_THRESHOLD_BYTES
+            and op in (ReduceOp.SUM, ReduceOp.MEAN, ReduceOp.PRODUCT, ReduceOp.MIN, ReduceOp.MAX)
+        ):
+            return self._ring_allreduce(arr, op)
         return self._round_trip(
             {"kind": "allreduce", "seq": self._next_seq(), "data": arr, "op": op}
         )
@@ -249,9 +380,15 @@ class TCPGroup(BaseGroup):
         return self._round_trip({"kind": "recv", "seq": seq, "src": src_rank})
 
     def destroy(self):
+        for s in (self._sock, self._ring_next, self._ring_prev):
+            try:
+                if s is not None:
+                    s.close()
+            except OSError:
+                pass
         try:
-            self._sock.close()
-        except OSError:
+            clear(self._kv, f"collective/{self.group_name}/ring/{self.rank}".encode())
+        except Exception:
             pass
         if self._coord is not None:
             self._coord.stop()

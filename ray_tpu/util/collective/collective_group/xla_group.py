@@ -158,17 +158,31 @@ class XLAGroup(BaseGroup):
         return jax.jit(smapped)
 
     # ------------------------------------------------------------------ data movement
+    @staticmethod
+    def _is_device_array(tensor) -> bool:
+        import jax
+
+        return isinstance(tensor, jax.Array)
+
     def _to_group_array(self, tensor, spec_axis="proc"):
         """Stack this process's contribution into a (world, *shape) global array
-        sharded across processes (replicated over local devices)."""
+        sharded across processes (replicated over local devices). A
+        device-resident `jax.Array` input stays on device — no host numpy
+        staging (the D2H+H2D round trip the public API used to pay)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        local = np.asarray(tensor)
+        local = tensor if self._is_device_array(tensor) else np.asarray(tensor)
         sharding = NamedSharding(self.mesh, P("proc"))
         if self.world_size > 1:
             return jax.make_array_from_process_local_data(sharding, local[None])
         return jax.device_put(local[None], NamedSharding(self.mesh, P()))
+
+    @staticmethod
+    def _from_group(result, want_device: bool):
+        """Return the collective's result in the caller's currency: a
+        device-resident jax.Array for jax.Array inputs, host numpy otherwise."""
+        return result if want_device else np.asarray(result)
 
     def _shard_over_local(self, tensors: List):
         """Lay a list of per-device tensors out as one array sharded over the
@@ -186,10 +200,12 @@ class XLAGroup(BaseGroup):
     # ------------------------------------------------------------------ collectives (process-level)
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         if self.world_size == 1:
-            return np.asarray(tensor)  # a group of one process
+            return tensor if self._is_device_array(tensor) else np.asarray(tensor)
+        want_device = self._is_device_array(tensor)
         garr = self._to_group_array(tensor)
         fn = self._compiled("allreduce", op, garr.shape, garr.dtype)
-        return np.asarray(fn(garr))[0]
+        out = fn(garr)
+        return self._from_group(out[0], want_device)
 
     def barrier(self):
         self.allreduce(np.zeros((1,), np.float32))
@@ -209,10 +225,11 @@ class XLAGroup(BaseGroup):
         # Masked psum (root contributes, others zero): same 2x-of-optimal ring
         # bound as reduce() above, same rationale for not hand-rolling a tree.
         if self.world_size == 1:
-            return np.asarray(tensor)
+            return tensor if self._is_device_array(tensor) else np.asarray(tensor)
+        want_device = self._is_device_array(tensor)
         garr = self._to_group_array(tensor)
         fn = self._compiled("broadcast", ReduceOp.SUM, garr.shape, garr.dtype, (root_rank,))
-        return np.asarray(fn(garr))[0]
+        return self._from_group(fn(garr)[0], want_device)
 
     def allgather(self, tensor):
         if self.world_size == 1:
