@@ -34,20 +34,34 @@ class CheckpointManager:
         self._count += 1
         path = os.path.join(self.run_dir, f"checkpoint_{self._count:06d}")
         checkpoint.to_directory(path)
-        # Metrics sidecar (NEXT TO the checkpoint dir, never inside it — the
-        # directory is user data exposed by to_dict()/to_directory()) so a
-        # restored experiment (Tuner.restore) can rebuild rankings from disk.
-        try:
-            import json
-
-            with open(f"{path}._tune_metrics.json", "w") as f:
-                json.dump({k: v for k, v in (metrics or {}).items()
-                           if isinstance(v, (int, float, str, bool))}, f)
-        except (OSError, TypeError):
-            pass
         self._kept.append((path, dict(metrics or {})))
         self._prune()
+        self._write_manifest()
         return Checkpoint.from_directory(path)
+
+    def _manifest_path(self) -> str:
+        # One hidden manifest for the whole run (never matches checkpoint_*
+        # globs, and checkpoint dirs stay pure user data for to_dict()).
+        return os.path.join(self.run_dir, ".tune_checkpoint_metrics.json")
+
+    def _write_manifest(self) -> None:
+        """Persist {checkpoint basename: metrics} so a restored experiment
+        (Tuner.restore) can rebuild rankings from disk."""
+        import json
+
+        entries = {}
+        for path, metrics in self._kept:
+            entries[os.path.basename(path)] = {
+                k: v for k, v in metrics.items()
+                if isinstance(k, str) and isinstance(v, (int, float, str, bool))
+            }
+        try:
+            tmp = self._manifest_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(entries, f)
+            os.replace(tmp, self._manifest_path())
+        except (OSError, TypeError):
+            pass
 
     def restore_from_disk(self) -> None:
         """Rediscover checkpoints already persisted under run_dir (experiment
@@ -55,18 +69,21 @@ class CheckpointManager:
         import json
         import re
 
+        manifest: Dict[str, Any] = {}
+        try:
+            with open(self._manifest_path()) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            pass
         found = []
         for entry in sorted(os.listdir(self.run_dir)):
             m = re.fullmatch(r"checkpoint_(\d+)", entry)
             path = os.path.join(self.run_dir, entry)
             if m is None or not os.path.isdir(path):
                 continue
-            metrics: Dict[str, Any] = {}
-            try:
-                with open(f"{path}._tune_metrics.json") as f:
-                    metrics = json.load(f)
-            except (OSError, ValueError):
-                pass
+            metrics = manifest.get(entry, {})
+            if not isinstance(metrics, dict):
+                metrics = {}
             found.append((int(m.group(1)), path, metrics))
         found.sort()
         self._kept = [(p, m) for _, p, m in found]
@@ -108,7 +125,3 @@ class CheckpointManager:
                 )[0]
             path, _ = self._kept.pop(victim)
             shutil.rmtree(path, ignore_errors=True)
-            try:
-                os.unlink(f"{path}._tune_metrics.json")
-            except OSError:
-                pass
