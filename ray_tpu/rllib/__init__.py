@@ -16,15 +16,24 @@ from ray_tpu.rllib.core.rl_module import MLPModule, RLModule, SquashedGaussianMo
 from ray_tpu.rllib.core.learner import JaxLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.env.env_runner import EnvRunner
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv, make_multi_agent
+from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, Impala, ImpalaConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
     "DQN",
     "DQNConfig",
     "EnvRunner",
@@ -34,11 +43,16 @@ __all__ = [
     "ImpalaConfig",
     "JaxLearner",
     "LearnerGroup",
+    "MARWIL",
+    "MARWILConfig",
     "MLPModule",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
     "PPO",
     "PPOConfig",
     "RLModule",
     "SAC",
     "SACConfig",
     "SquashedGaussianModule",
+    "make_multi_agent",
 ]

@@ -32,6 +32,16 @@ class AlgorithmConfig:
         self.num_learners = 0  # 0 = local learner in the driver process
         self.model: Dict[str, Any] = {"hiddens": (64, 64)}
         self.framework_str = "jax"
+        # Multi-agent (reference `algorithm_config.py` `.multi_agent()`):
+        # policies maps policy_id -> None (spaces inferred from the env's
+        # per-agent dicts via policy_mapping_fn). Empty = single-agent.
+        self.policies: Dict[str, Any] = {}
+        self.policy_mapping_fn: Optional[Callable[[str], str]] = None
+        self.policies_to_train: Optional[List[str]] = None
+        # Offline data (reference `.offline_data(input_=...)`): a path/glob/
+        # list of JSON-lines files, a ray_tpu.data.Dataset, an InputReader,
+        # or a zero-arg callable returning an InputReader.
+        self.input_: Any = None
 
     # ------------------------------------------------------------ fluent API
     def environment(self, env=None, *, env_config: Optional[dict] = None) -> "AlgorithmConfig":
@@ -66,6 +76,61 @@ class AlgorithmConfig:
         if num_learners is not None:
             self.num_learners = num_learners
         return self
+
+    def multi_agent(
+        self,
+        *,
+        policies=None,
+        policy_mapping_fn: Optional[Callable[[str], str]] = None,
+        policies_to_train: Optional[List[str]] = None,
+    ) -> "AlgorithmConfig":
+        """Configure the policy map (reference: `AlgorithmConfig.multi_agent`).
+
+        `policies` is a dict policy_id -> None or an iterable of policy ids;
+        module specs are inferred from the MultiAgentEnv's per-agent spaces.
+        `policy_mapping_fn(agent_id) -> policy_id` routes agents; default maps
+        every agent to the sole policy (valid only with one policy).
+        """
+        if policies is not None:
+            if isinstance(policies, dict):
+                self.policies = dict(policies)
+            else:
+                self.policies = {pid: None for pid in policies}
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        if policies_to_train is not None:
+            self.policies_to_train = list(policies_to_train)
+        return self
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return bool(self.policies)
+
+    def offline_data(self, *, input_=None) -> "AlgorithmConfig":
+        """Configure the offline input source (reference:
+        `AlgorithmConfig.offline_data`). See `input_` in `__init__`."""
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def build_input_reader(self, batch_size: int, seed: int = 0):
+        """Resolve `input_` into an InputReader (the offline plugin seam)."""
+        from ray_tpu.rllib.offline import DatasetReader, InputReader, JsonReader
+
+        src = self.input_
+        if src is None:
+            raise ValueError("offline training requires config.offline_data(input_=...)")
+        if isinstance(src, InputReader):
+            return src
+        if isinstance(src, (str, list, tuple)):
+            return JsonReader(src, batch_size=batch_size, seed=seed)
+        from ray_tpu.data.dataset import Dataset
+
+        if isinstance(src, Dataset):
+            return DatasetReader(src, batch_size=batch_size)
+        if callable(src):
+            return src()
+        raise TypeError(f"unsupported offline input source: {type(src)}")
 
     def framework(self, framework: str) -> "AlgorithmConfig":
         if framework != "jax":
@@ -117,6 +182,9 @@ class Algorithm:
         self.config = config
         self.iteration = 0
         creator = config.env_creator()
+        if config.is_multi_agent:
+            self._init_multi_agent(creator)
+            return
         probe = creator()
         obs_space, act_space = probe.observation_space, probe.action_space
         probe.close()
@@ -136,6 +204,11 @@ class Algorithm:
             seed=config.seed,
             extra_update_fn=self.make_extra_update(),
         )
+        if not self._needs_env_runners:
+            # Offline algorithms (MARWIL/BC) train from an InputReader; the
+            # env exists only for spaces + evaluation.
+            self.env_runners = []
+            return
         runner_cls = ray_tpu.remote(EnvRunner)
         self.env_runners: List[Any] = [
             runner_cls.options(num_cpus=1).remote(
@@ -150,6 +223,105 @@ class Algorithm:
             )
             for i in range(config.num_env_runners)
         ]
+
+    # ------------------------------------------------------------- multi-agent
+    # Whether this algorithm supports policy maps (PPO opts in; see
+    # `_supports_multi_agent` checks below). Reference: every algorithm rides
+    # the same policy-map machinery; here MA support is per-algorithm.
+    _supports_multi_agent = False
+    # Offline algorithms (MARWIL/BC) set False: no sampling actors are built.
+    _needs_env_runners = True
+
+    def _init_multi_agent(self, creator) -> None:
+        import gymnasium as gym
+
+        import ray_tpu
+        from ray_tpu.rllib.core.learner_group import LearnerGroup
+        from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+
+        config = self.config
+        if not self._supports_multi_agent:
+            raise ValueError(
+                f"{type(self).__name__} does not support multi-agent training"
+            )
+        mapping = config.policy_mapping_fn
+        if mapping is None:
+            if len(config.policies) != 1:
+                raise ValueError(
+                    "policy_mapping_fn is required with more than one policy"
+                )
+            only = next(iter(config.policies))
+            mapping = lambda aid: only  # noqa: E731
+            config.policy_mapping_fn = mapping
+        probe = creator()
+        try:
+            obs_spaces, act_spaces = probe.observation_space, probe.action_space
+            if not isinstance(obs_spaces, dict):
+                raise ValueError(
+                    "multi-agent training requires a MultiAgentEnv with dict "
+                    "observation/action spaces (see make_multi_agent)"
+                )
+            # One representative agent per policy defines its module spec.
+            # Every agent must map INTO the policy map — an unmapped agent
+            # would die with a bare KeyError inside the runner actor later.
+            agent_of: Dict[str, str] = {}
+            for aid in obs_spaces:
+                pid = mapping(aid)
+                if pid not in config.policies:
+                    raise ValueError(
+                        f"policy_mapping_fn({aid!r}) -> {pid!r}, which is not "
+                        f"in policies {sorted(config.policies)}"
+                    )
+                agent_of.setdefault(pid, aid)
+            missing = set(config.policies) - set(agent_of)
+            if missing:
+                raise ValueError(
+                    f"no agent maps to policies {sorted(missing)}; check "
+                    "policy_mapping_fn against the env's agent ids"
+                )
+            self.modules: Dict[str, Any] = {}
+            for pid, aid in agent_of.items():
+                act_space = act_spaces[aid]
+                obs_dim = int(np.prod(obs_spaces[aid].shape))
+                if not isinstance(act_space, gym.spaces.Discrete):
+                    raise NotImplementedError(
+                        f"multi-agent supports Discrete actions; got {act_space}"
+                    )
+                self.modules[pid] = self.make_module(obs_dim, int(act_space.n))
+        finally:
+            probe.close()
+        self.module = None
+        self.learner_group = None
+        self.learner_groups: Dict[str, LearnerGroup] = {
+            pid: LearnerGroup(
+                mod,
+                self.make_loss(),
+                num_learners=config.num_learners,
+                learning_rate=config.lr,
+                optimizer=self.make_optimizer(),
+                seed=config.seed + 31 * i,
+                extra_update_fn=self.make_extra_update(),
+            )
+            for i, (pid, mod) in enumerate(self.modules.items())
+        }
+        runner_cls = ray_tpu.remote(MultiAgentEnvRunner)
+        self.env_runners = [
+            runner_cls.options(num_cpus=1).remote(
+                creator,
+                self.modules,
+                mapping,
+                num_envs=config.num_envs_per_runner,
+                rollout_length=config.rollout_fragment_length,
+                seed=config.seed + 1000 * (i + 1),
+                gamma=config.gamma,
+                lambda_=getattr(config, "lambda_", 0.95),
+            )
+            for i in range(config.num_env_runners)
+        ]
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return self.config.is_multi_agent
 
     # -------------------------------------------------------------- interface
     def make_module(self, obs_dim: int, num_actions: int):
@@ -220,11 +392,17 @@ class Algorithm:
 
     def save(self, path: str) -> str:
         os.makedirs(path, exist_ok=True)
+        if self.is_multi_agent:
+            learner_state = {
+                pid: lg.state() for pid, lg in self.learner_groups.items()
+            }
+        else:
+            learner_state = self.learner_group.state()
         with open(os.path.join(path, "algo_state.pkl"), "wb") as fh:
             pickle.dump(
                 {
                     "iteration": self.iteration,
-                    "learner": self.learner_group.state(),
+                    "learner": learner_state,
                     "extra": self._extra_state(),
                 },
                 fh,
@@ -235,7 +413,11 @@ class Algorithm:
         with open(os.path.join(path, "algo_state.pkl"), "rb") as fh:
             state = pickle.load(fh)
         self.iteration = state["iteration"]
-        self.learner_group.load_state(state["learner"])
+        if self.is_multi_agent:
+            for pid, s in state["learner"].items():
+                self.learner_groups[pid].load_state(s)
+        else:
+            self.learner_group.load_state(state["learner"])
         self._load_extra_state(state.get("extra", {}))
 
     def stop(self) -> None:

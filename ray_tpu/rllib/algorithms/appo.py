@@ -1,0 +1,219 @@
+"""APPO: Asynchronous PPO — IMPALA's V-trace chassis + PPO's clipped
+surrogate against a lagging target policy.
+
+Reference: `rllib/algorithms/appo/appo.py:39` (APPOConfig(ImpalaConfig):
+`clip_param=0.4, use_kl_loss=False, kl_coeff=1.0, kl_target=0.01, tau=1.0,
+target_update_frequency=1`) and the loss in `appo_torch_policy.py:171-266`:
+V-trace computed with the TARGET network as the target policy
+(rho = pi_target/mu), `is_ratio = clamp(mu/pi_target, 0, 2)`,
+`logp_ratio = is_ratio * pi/mu`, clipped surrogate, optional
+KL(target || current), value loss vs the V-trace targets; target network
+refreshed every `target_update_frequency` updates by a tau-blend
+(`appo.py:117` "updated_param = tau * current + (1 - tau) * target").
+
+TPU-first shape: same (N, T) env-major batches and in-loss `lax.scan`
+V-trace as IMPALA; the target params ride as the learner's replicated
+`extra` pytree so the whole loss stays one pure jitted SPMD program, and the
+tau-blend is a host-triggered `set_extra` (no torch-style target_model
+module copies).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.clip_param = 0.4
+        self.use_kl_loss = False
+        self.kl_coeff = 1.0
+        self.kl_target = 0.01
+        self.tau = 1.0
+        self.target_update_frequency = 1
+        self._algo_cls = APPO
+
+
+def make_appo_loss(config: APPOConfig) -> Callable:
+    """Pure (module, params, batch, target_params) -> (loss, aux)."""
+    gamma = config.gamma
+    rho_bar = config.vtrace_clip_rho_threshold
+    pg_rho_bar = config.vtrace_clip_pg_rho_threshold
+    c_bar = config.vtrace_clip_c_threshold
+    clip = config.clip_param
+    vf_coeff = config.vf_loss_coeff
+    ent_coeff = config.entropy_coeff
+    use_kl = config.use_kl_loss
+
+    def loss(module, params, batch, target_params):
+        import jax
+        import jax.numpy as jnp
+
+        obs = batch["obs"]            # (N, T, obs)
+        actions = batch["actions"]    # (N, T)
+        behavior_logp = batch["logp"]
+        rewards = batch["rewards"]
+        terms = batch["terminateds"]
+        dones = batch["dones"]
+        truncs = batch["truncateds"]
+        final_obs = batch["final_obs"]
+        last_obs = batch["last_obs"]
+
+        logits, values = module.forward(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        curr_logp = jnp.take_along_axis(logp_all, actions[..., None], axis=-1)[..., 0]
+        # Old (lagging target) policy — gradients never flow into it.
+        t_logits, _ = module.forward(jax.lax.stop_gradient(target_params), obs)
+        t_logp_all = jax.nn.log_softmax(t_logits)
+        old_logp = jnp.take_along_axis(t_logp_all, actions[..., None], axis=-1)[..., 0]
+
+        _, last_values = module.forward(params, last_obs)
+        _, fin_values = module.forward(params, final_obs)
+
+        # V-trace with the target policy as pi (appo_torch_policy.py:208:
+        # target_policy_logits = old_policy_behaviour_logits).
+        rho = jnp.exp(old_logp - behavior_logp)
+        clipped_rho = jnp.minimum(rho, rho_bar)
+        c = jnp.minimum(rho, c_bar)
+
+        next_values = jnp.concatenate([values[:, 1:], last_values[:, None]], axis=1)
+        next_values = jnp.where(truncs > 0, fin_values, next_values)
+        next_values = next_values * (1.0 - terms)
+        delta = clipped_rho * (rewards + gamma * next_values - values)
+
+        def scan_fn(acc, xs):
+            delta_t, c_t, done_t = xs
+            acc = delta_t + gamma * c_t * (1.0 - done_t) * acc
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            scan_fn,
+            jnp.zeros(values.shape[0], values.dtype),
+            (delta.T, c.T, dones.T),
+            reverse=True,
+        )
+        vs = jax.lax.stop_gradient(vs_minus_v.T + values)
+
+        vs_next = jnp.concatenate([vs[:, 1:], last_values[:, None]], axis=1)
+        vs_next = jnp.where(truncs > 0, fin_values, vs_next)
+        vs_next = vs_next * (1.0 - terms)
+        pg_adv = jax.lax.stop_gradient(
+            jnp.minimum(rho, pg_rho_bar) * (rewards + gamma * vs_next - values)
+        )
+
+        # PPO surrogate with the decoupled importance ratio
+        # (appo_torch_policy.py:236-251).
+        is_ratio = jnp.clip(jnp.exp(behavior_logp - old_logp), 0.0, 2.0)
+        logp_ratio = is_ratio * jnp.exp(curr_logp - behavior_logp)
+        surrogate = jnp.minimum(
+            pg_adv * logp_ratio,
+            pg_adv * jnp.clip(logp_ratio, 1.0 - clip, 1.0 + clip),
+        )
+        pi_loss = -jnp.mean(surrogate)
+        vf_loss = 0.5 * jnp.mean(jnp.square(values - vs))
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        # KL(old_policy || current) (appo_torch_policy.py:201).
+        kl = jnp.mean(
+            jnp.sum(jnp.exp(t_logp_all) * (t_logp_all - logp_all), axis=-1)
+        )
+        total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        if use_kl:
+            total = total + jnp.mean(batch["kl_coeff"]) * kl
+        aux = {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_kl": kl,
+            "mean_is_ratio": jnp.mean(is_ratio),
+        }
+        return total, aux
+
+    return loss
+
+
+class APPO(Impala):
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        self.kl_coeff = float(config.kl_coeff)
+        self._updates_since_target_sync = 0
+        # Target network = initial weights (reference initializes the target
+        # model as a copy of the model).
+        self.learner_group.set_extra(self.learner_group.get_weights())
+
+    def make_loss(self) -> Callable:
+        return make_appo_loss(self.config)
+
+    # ----------------------------------------------------------- one iteration
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.config
+        weights = self.learner_group.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.env_runners])
+        rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
+
+        def env_major(key):
+            return np.concatenate(
+                [np.moveaxis(ro[key], 0, 1) for ro in rollouts], axis=0
+            )
+
+        batch = {
+            k: env_major(k)
+            for k in (
+                "obs", "actions", "logp", "rewards",
+                "dones", "terminateds", "truncateds", "final_obs",
+            )
+        }
+        batch["last_obs"] = np.concatenate(
+            [ro["last_obs"] for ro in rollouts], axis=0
+        )
+        N = batch["rewards"].shape[0]
+        batch["kl_coeff"] = np.full(N, self.kl_coeff, np.float32)
+        out = dict(self.learner_group.update(batch))
+
+        # Adaptive KL (only meaningful when the KL term is in the loss).
+        if cfg.use_kl_loss:
+            if out["mean_kl"] > 2.0 * cfg.kl_target:
+                self.kl_coeff *= 1.5
+            elif out["mean_kl"] < 0.5 * cfg.kl_target:
+                self.kl_coeff *= 0.5
+            out["kl_coeff"] = self.kl_coeff
+
+        # Lagging target refresh (appo.py:117 tau-blend), every
+        # `target_update_frequency` updates.
+        self._updates_since_target_sync += 1
+        if self._updates_since_target_sync >= cfg.target_update_frequency:
+            self._updates_since_target_sync = 0
+            import jax
+
+            current = self.learner_group.get_weights()
+            target = self.learner_group.get_extra()
+            tau = cfg.tau
+            blended = jax.tree.map(
+                lambda c, t: tau * np.asarray(c) + (1.0 - tau) * np.asarray(t),
+                current,
+                target,
+            )
+            self.learner_group.set_extra(blended)
+            out["num_target_updates"] = 1
+
+        out["num_env_steps_sampled"] = int(batch["rewards"].size)
+        return self.collect_episode_metrics(out)
+
+    # -------------------------------------------------------------- checkpoint
+    def _extra_state(self) -> Dict[str, Any]:
+        return {
+            "kl_coeff": self.kl_coeff,
+            "target_params": self.learner_group.get_extra(),
+        }
+
+    def _load_extra_state(self, state: Dict[str, Any]) -> None:
+        self.kl_coeff = float(state.get("kl_coeff", self.config.kl_coeff))
+        if state.get("target_params") is not None:
+            self.learner_group.set_extra(state["target_params"])

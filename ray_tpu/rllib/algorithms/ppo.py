@@ -17,7 +17,7 @@ inside XLA over ICI. GAE postprocessing stays on the host (numpy over the
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Tuple
 
 import numpy as np
 
@@ -158,10 +158,16 @@ class PPO(Algorithm):
     # PPO bootstraps truncations through runner-side values (bootstrap_values)
     # and never reads final_obs: skip shipping the obs-sized buffer.
     _record_final_obs = False
+    # Policy-map training via MultiAgentEnvRunner (reference: PPO rides the
+    # generic multi-agent machinery in `rollout_worker.py`).
+    _supports_multi_agent = True
 
     def __init__(self, config: PPOConfig):
         super().__init__(config)
-        self.kl_coeff = float(config.kl_coeff)
+        if self.is_multi_agent:
+            self.kl_coeff = {pid: float(config.kl_coeff) for pid in self.modules}
+        else:
+            self.kl_coeff = float(config.kl_coeff)
 
     def make_loss(self) -> Callable:
         return make_ppo_loss(self.config)
@@ -175,9 +181,86 @@ class PPO(Algorithm):
         )
 
     # ----------------------------------------------------------- one iteration
+    def _sgd_epochs(self, learner_group, batch: Dict[str, np.ndarray],
+                    kl_coeff: float) -> Tuple[Dict[str, float], float]:
+        """Multi-epoch minibatch SGD on one flat batch; returns (mean metrics,
+        KL sampled over the final epoch) — shared by the single- and
+        multi-agent paths."""
+        cfg = self.config
+        a = batch["advantages"]
+        batch["advantages"] = (a - a.mean()) / max(1e-4, a.std())
+        B = len(batch["advantages"])
+        mb = min(cfg.minibatch_size, B)
+        if cfg.num_learners > 1:
+            mb = max(cfg.num_learners, mb - mb % cfg.num_learners)
+        if mb > B:
+            raise ValueError(
+                f"train batch of {B} rows is smaller than num_learners="
+                f"{cfg.num_learners}; sample more steps per iteration"
+            )
+        metrics_acc: List[Dict[str, float]] = []
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        mb_per_epoch = 0
+        for epoch in range(cfg.num_epochs):
+            perm = rng.permutation(B)
+            mb_per_epoch = 0
+            for start in range(0, B - mb + 1, mb):
+                idx = perm[start : start + mb]
+                minibatch = {k: v[idx] for k, v in batch.items()}
+                minibatch["kl_coeff"] = np.full(mb, kl_coeff, np.float32)
+                metrics_acc.append(learner_group.update(minibatch))
+                mb_per_epoch += 1
+        out = {
+            k: float(np.mean([m[k] for m in metrics_acc])) for k in metrics_acc[0]
+        }
+        sampled_kl = float(
+            np.mean([m["mean_kl"] for m in metrics_acc[-mb_per_epoch:]])
+        )
+        out["num_env_steps_trained"] = B
+        return out, sampled_kl
+
+    def _adapt_kl(self, sampled_kl: float, current: float) -> float:
+        """`torch_mixins.py:87` rule: *=1.5 above 2*target, *=0.5 below /2."""
+        target = self.config.kl_target
+        if sampled_kl > 2.0 * target:
+            return current * 1.5
+        if sampled_kl < 0.5 * target:
+            return current * 0.5
+        return current
+
+    def _training_step_multi_agent(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.config
+        weights = {pid: lg.get_weights() for pid, lg in self.learner_groups.items()}
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.env_runners])
+        samples = ray_tpu.get([r.sample.remote() for r in self.env_runners])
+        out: Dict[str, Any] = {}
+        total_steps = 0
+        train_set = cfg.policies_to_train or list(self.learner_groups)
+        for pid, lg in self.learner_groups.items():
+            chunks = [s[pid] for s in samples if pid in s]
+            if not chunks:
+                continue
+            batch = {
+                k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]
+            }
+            total_steps += len(batch["advantages"])
+            if pid not in train_set:
+                continue
+            metrics, sampled_kl = self._sgd_epochs(lg, batch, self.kl_coeff[pid])
+            self.kl_coeff[pid] = self._adapt_kl(sampled_kl, self.kl_coeff[pid])
+            metrics["kl_coeff"] = self.kl_coeff[pid]
+            for k, v in metrics.items():
+                out[f"policy_{pid}/{k}"] = v
+        out["num_env_steps_sampled"] = total_steps
+        return self.collect_episode_metrics(out)
+
     def training_step(self) -> Dict[str, Any]:
         import ray_tpu
 
+        if self.is_multi_agent:
+            return self._training_step_multi_agent()
         cfg = self.config
         # 1. Push current weights to all samplers.
         weights = self.learner_group.get_weights()
@@ -200,44 +283,11 @@ class PPO(Algorithm):
             "value_targets",
         )
         batch = {k: np.concatenate([f[k] for f in flats]) for k in keys}
-        # Standardize advantages (reference: standardize_fields=["advantages"]).
-        a = batch["advantages"]
-        batch["advantages"] = (a - a.mean()) / max(1e-4, a.std())
         B = len(batch["advantages"])
-        # 4. Multi-epoch minibatch SGD.
-        mb = min(cfg.minibatch_size, B)
-        if cfg.num_learners > 1:
-            # Each remote learner gets an equal shard of every minibatch.
-            mb = max(cfg.num_learners, mb - mb % cfg.num_learners)
-        if mb > B:
-            raise ValueError(
-                f"train batch of {B} rows is smaller than num_learners="
-                f"{cfg.num_learners}; sample more steps per iteration"
-            )
-        metrics_acc: List[Dict[str, float]] = []
-        rng = np.random.default_rng(cfg.seed + self.iteration)
-        mb_per_epoch = 0
-        for epoch in range(cfg.num_epochs):
-            perm = rng.permutation(B)
-            mb_per_epoch = 0
-            for start in range(0, B - mb + 1, mb):
-                idx = perm[start : start + mb]
-                minibatch = {k: v[idx] for k, v in batch.items()}
-                minibatch["kl_coeff"] = np.full(mb, self.kl_coeff, np.float32)
-                metrics_acc.append(self.learner_group.update(minibatch))
-                mb_per_epoch += 1
-        out: Dict[str, Any] = {
-            k: float(np.mean([m[k] for m in metrics_acc])) for k in metrics_acc[0]
-        }
-        # 5. Adaptive KL coefficient (torch_mixins.py:87 rule) on the KL
-        # sampled over the final epoch's minibatches.
-        sampled_kl = float(
-            np.mean([m["mean_kl"] for m in metrics_acc[-mb_per_epoch:]])
-        )
-        if sampled_kl > 2.0 * cfg.kl_target:
-            self.kl_coeff *= 1.5
-        elif sampled_kl < 0.5 * cfg.kl_target:
-            self.kl_coeff *= 0.5
+        # 4. Standardized advantages + multi-epoch minibatch SGD, then the
+        # adaptive KL update on the final epoch's sampled KL.
+        out, sampled_kl = self._sgd_epochs(self.learner_group, batch, self.kl_coeff)
+        self.kl_coeff = self._adapt_kl(sampled_kl, self.kl_coeff)
         out["kl_coeff"] = self.kl_coeff
         out["num_env_steps_sampled"] = B
         return self.collect_episode_metrics(out)
@@ -247,4 +297,5 @@ class PPO(Algorithm):
         return {"kl_coeff": self.kl_coeff}
 
     def _load_extra_state(self, state: Dict[str, Any]) -> None:
-        self.kl_coeff = float(state.get("kl_coeff", self.config.kl_coeff))
+        kl = state.get("kl_coeff", self.config.kl_coeff)
+        self.kl_coeff = dict(kl) if isinstance(kl, dict) else float(kl)

@@ -1,0 +1,290 @@
+"""MultiAgentEnvRunner: sampling actor over MultiAgentEnv instances with
+per-policy action routing.
+
+Reference: `rllib/evaluation/rollout_worker.py` multi-agent path — obs are
+routed to policies via `policy_mapping_fn(agent_id)`, actions route back, and
+each policy accumulates its own train batch
+(`rllib/evaluation/episode.py` + `sample_batch_builder`). The TPU-first
+difference: per step, all agents mapped to the same policy batch into ONE
+jitted forward (the reference loops per-agent through eager torch), and GAE
+runs here on the completed per-agent trajectories so the learner receives
+flat, shard-ready per-policy batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _segment_gae(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    bootstrap: float,
+    gamma: float,
+    lambda_: float,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """GAE over one contiguous single-agent trajectory segment. `bootstrap`
+    is V(next_obs) after the last row (0.0 when the segment terminated)."""
+    T = len(rewards)
+    adv = np.zeros(T, np.float32)
+    lastgaelam = 0.0
+    for t in reversed(range(T)):
+        next_v = bootstrap if t == T - 1 else values[t + 1]
+        delta = rewards[t] + gamma * next_v - values[t]
+        lastgaelam = delta + gamma * lambda_ * lastgaelam
+        adv[t] = lastgaelam
+    return adv, adv + values
+
+
+class _Trajectory:
+    """Per-(env, agent) rollout accumulator."""
+
+    __slots__ = ("obs", "actions", "logp", "logits", "values", "rewards")
+
+    def __init__(self):
+        self.obs: List[np.ndarray] = []
+        self.actions: List[Any] = []
+        self.logp: List[float] = []
+        self.logits: List[np.ndarray] = []
+        self.values: List[float] = []
+        self.rewards: List[float] = []
+
+    def __len__(self):
+        return len(self.actions)
+
+
+class MultiAgentEnvRunner:
+    def __init__(
+        self,
+        env_creator: Callable[[], Any],
+        modules: Dict[str, Any],  # policy_id -> RLModule
+        policy_mapping_fn: Callable[[str], str],
+        num_envs: int = 2,
+        rollout_length: int = 128,
+        seed: int = 0,
+        gamma: float = 0.99,
+        lambda_: float = 0.95,
+    ):
+        import jax
+
+        self._envs = [env_creator() for _ in range(num_envs)]
+        self.modules = modules
+        self.policy_mapping_fn = policy_mapping_fn
+        self.rollout_length = rollout_length
+        self.gamma = gamma
+        self.lambda_ = lambda_
+        self._key = jax.random.PRNGKey(seed)
+        self._params = {
+            pid: m.init(jax.random.PRNGKey(seed + i))
+            for i, (pid, m) in enumerate(modules.items())
+        }
+        self._act = {
+            pid: jax.jit(
+                (lambda mod: lambda p, o, k, explore: mod.action_dist(p, o, k, explore))(m),
+                static_argnums=(3,),
+            )
+            for pid, m in modules.items()
+        }
+        # Live episode state per env.
+        self._obs: List[Dict[str, Any]] = []
+        self._done_agents: List[set] = []
+        self._episode_return: List[float] = []
+        self._episode_len: List[int] = []
+        self._completed: List[Tuple[float, int]] = []
+        for i, env in enumerate(self._envs):
+            obs, _ = env.reset(seed=seed + 7919 * (i + 1))
+            self._obs.append(obs)
+            self._done_agents.append(set())
+            self._episode_return.append(0.0)
+            self._episode_len.append(0)
+        # Open per-(env, agent-id) trajectories.
+        self._traj: List[Dict[str, _Trajectory]] = [dict() for _ in self._envs]
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        for pid, w in weights.items():
+            self._params[pid] = w
+
+    # ------------------------------------------------------------------ sample
+    def sample(self, explore: bool = True) -> Dict[str, Dict[str, np.ndarray]]:
+        """Collect `rollout_length` env steps; returns per-policy flat batches
+        with advantages/value_targets already attached."""
+        out: Dict[str, Dict[str, List[np.ndarray]]] = {
+            pid: {
+                k: []
+                for k in (
+                    "obs", "actions", "logp", "behavior_logits",
+                    "advantages", "value_targets",
+                )
+            }
+            for pid in self.modules
+        }
+        for _ in range(self.rollout_length):
+            self._step_once(out, explore)
+        # Close out still-open trajectories, bootstrapping through the value
+        # of the CURRENT obs (episode continues next fragment).
+        for e in range(len(self._envs)):
+            open_agents = list(self._traj[e].keys())
+            if not open_agents:
+                continue
+            boots = self._values_for(
+                {aid: self._obs[e][aid] for aid in open_agents if aid in self._obs[e]}
+            )
+            for aid in open_agents:
+                self._close_trajectory(out, e, aid, boots.get(aid, 0.0))
+        return {
+            pid: {k: _stack(v) for k, v in cols.items()}
+            for pid, cols in out.items()
+            if cols["actions"]
+        }
+
+    def _group_by_policy(
+        self, per_env_obs: List[Dict[str, Any]]
+    ) -> Dict[str, List[Tuple[int, str]]]:
+        """(env_idx, agent_id) pairs ready to act, grouped by policy."""
+        groups: Dict[str, List[Tuple[int, str]]] = {}
+        for e, obs in enumerate(per_env_obs):
+            for aid in obs:
+                if aid in self._done_agents[e]:
+                    continue
+                groups.setdefault(self.policy_mapping_fn(aid), []).append((e, aid))
+        return groups
+
+    def _step_once(self, out, explore: bool) -> None:
+        import jax
+
+        groups = self._group_by_policy(self._obs)
+        actions: List[Dict[str, Any]] = [dict() for _ in self._envs]
+        for pid, members in groups.items():
+            obs_batch = np.stack(
+                [np.asarray(self._obs[e][aid], np.float32).ravel() for e, aid in members]
+            )
+            self._key, sub = jax.random.split(self._key)
+            a, logp, value, logits = self._act[pid](
+                self._params[pid], obs_batch, sub, explore
+            )
+            a = np.asarray(a)
+            logp = np.asarray(logp)
+            value = np.asarray(value)
+            logits = np.asarray(logits)
+            for j, (e, aid) in enumerate(members):
+                tr = self._traj[e].setdefault(aid, _Trajectory())
+                tr.obs.append(obs_batch[j])
+                tr.actions.append(a[j])
+                tr.logp.append(float(logp[j]))
+                tr.logits.append(logits[j])
+                tr.values.append(float(value[j]))
+                actions[e][aid] = a[j]
+        for e, env in enumerate(self._envs):
+            if not actions[e]:
+                self._reset_env(e)
+                continue
+            obs, rews, terms, truncs, infos = env.step(actions[e])
+            for aid, r in rews.items():
+                if aid in self._traj[e] and len(self._traj[e][aid]):
+                    self._traj[e][aid].rewards.append(float(r))
+                self._episode_return[e] += float(r)
+            self._episode_len[e] += 1
+            next_obs = dict(self._obs[e])
+            next_obs.update(obs)
+            for aid in list(rews):
+                terminated = bool(terms.get(aid, False))
+                truncated = bool(truncs.get(aid, False))
+                if terminated or truncated:
+                    self._done_agents[e].add(aid)
+                    boot = 0.0
+                    if truncated and not terminated and aid in obs:
+                        boot = self._values_for({aid: obs[aid]}).get(aid, 0.0)
+                    self._close_trajectory(out, e, aid, boot)
+            self._obs[e] = next_obs
+            if terms.get("__all__") or truncs.get("__all__"):
+                # Close any trajectories still open (an env may end the whole
+                # episode via __all__ without per-agent terminal flags):
+                # truncation-style end bootstraps through V(last obs),
+                # termination cuts to zero — and either way the buffers must
+                # not leak into the next episode.
+                open_agents = list(self._traj[e].keys())
+                if open_agents:
+                    boots = (
+                        self._values_for(
+                            {
+                                aid: next_obs[aid]
+                                for aid in open_agents
+                                if aid in next_obs
+                            }
+                        )
+                        if truncs.get("__all__")
+                        else {}
+                    )
+                    for aid in open_agents:
+                        self._close_trajectory(out, e, aid, boots.get(aid, 0.0))
+                self._completed.append(
+                    (self._episode_return[e], self._episode_len[e])
+                )
+                self._reset_env(e)
+
+    def _reset_env(self, e: int) -> None:
+        obs, _ = self._envs[e].reset()
+        self._obs[e] = obs
+        self._done_agents[e] = set()
+        self._episode_return[e] = 0.0
+        self._episode_len[e] = 0
+
+    def _values_for(self, obs_by_agent: Dict[str, Any]) -> Dict[str, float]:
+        """V(obs) per agent under the agent's policy (bootstrap helper)."""
+        import jax
+
+        vals: Dict[str, float] = {}
+        groups: Dict[str, List[str]] = {}
+        for aid in obs_by_agent:
+            groups.setdefault(self.policy_mapping_fn(aid), []).append(aid)
+        for pid, aids in groups.items():
+            batch = np.stack(
+                [np.asarray(obs_by_agent[a], np.float32).ravel() for a in aids]
+            )
+            self._key, sub = jax.random.split(self._key)
+            _, _, value, _ = self._act[pid](self._params[pid], batch, sub, False)
+            for a, v in zip(aids, np.asarray(value)):
+                vals[a] = float(v)
+        return vals
+
+    def _close_trajectory(self, out, e: int, aid: str, bootstrap: float) -> None:
+        tr = self._traj[e].pop(aid, None)
+        if tr is None or len(tr) == 0:
+            return
+        n = min(len(tr.rewards), len(tr.actions))
+        rewards = np.asarray(tr.rewards[:n], np.float32)
+        values = np.asarray(tr.values[:n], np.float32)
+        adv, targets = _segment_gae(
+            rewards, values, bootstrap, self.gamma, self.lambda_
+        )
+        pid = self.policy_mapping_fn(aid)
+        cols = out[pid]
+        cols["obs"].append(np.stack(tr.obs[:n]))
+        cols["actions"].append(np.asarray(tr.actions[:n]))
+        cols["logp"].append(np.asarray(tr.logp[:n], np.float32))
+        cols["behavior_logits"].append(np.stack(tr.logits[:n]))
+        cols["advantages"].append(adv)
+        cols["value_targets"].append(targets)
+
+    # ------------------------------------------------------------------- stats
+    def episode_stats(self, clear: bool = True) -> Dict[str, float]:
+        eps = self._completed
+        if clear:
+            self._completed = []
+        if not eps:
+            return {"episodes": 0}
+        rets = [r for r, _ in eps]
+        lens = [l for _, l in eps]
+        return {
+            "episodes": len(eps),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+            "episode_return_min": float(np.min(rets)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+
+def _stack(chunks: List[np.ndarray]) -> np.ndarray:
+    return np.concatenate(chunks, axis=0)

@@ -1,0 +1,14 @@
+"""ray_tpu.rllib.offline: offline-RL data input/output.
+
+Reference: `rllib/offline/` — `InputReader` (`input_reader.py`), JSON
+readers/writers (`json_reader.py`, `json_writer.py`), and the Ray-Data-backed
+`DatasetReader` (`dataset_reader.py`). Batches are dicts of numpy columns
+over transitions; JSON files hold one episode (or fragment) per line.
+"""
+
+from ray_tpu.rllib.offline.input_reader import InputReader
+from ray_tpu.rllib.offline.json_reader import JsonReader
+from ray_tpu.rllib.offline.json_writer import JsonWriter
+from ray_tpu.rllib.offline.dataset_reader import DatasetReader
+
+__all__ = ["DatasetReader", "InputReader", "JsonReader", "JsonWriter"]

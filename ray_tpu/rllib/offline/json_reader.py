@@ -1,0 +1,89 @@
+"""JsonReader: sample batches from JSON-lines experience files.
+
+Reference: `rllib/offline/json_reader.py` — reads the files produced by
+`JsonWriter` (one episode/fragment batch per line), shuffles at the line
+level, and serves fixed-size transition batches. Episode boundaries are
+preserved in `dones` so return computation never leaks across lines: a
+synthetic done closes each line's tail even for fragments.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Sequence, Union
+
+import numpy as np
+
+from ray_tpu.rllib.offline.input_reader import InputReader
+
+
+def _expand(paths: Union[str, Sequence[str]]) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.json"))))
+        else:
+            files.extend(sorted(glob.glob(p)) or [p])
+    if not files:
+        raise FileNotFoundError(f"no offline data files match {paths!r}")
+    return files
+
+
+class JsonReader(InputReader):
+    def __init__(self, inputs: Union[str, Sequence[str]],
+                 batch_size: int = 256, seed: int = 0):
+        self.files = _expand(inputs)
+        self.batch_size = batch_size
+        self._rng = np.random.default_rng(seed)
+        self._episodes: List[Dict[str, np.ndarray]] = []
+        for fname in self.files:
+            with open(fname) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    ep = {k: np.asarray(v) for k, v in row.items()}
+                    n = len(ep["actions"])
+                    # Close the line's tail so per-batch return computation
+                    # treats every line as a self-contained segment.
+                    dones = np.zeros(n, np.float32)
+                    for key in ("dones", "terminateds", "truncateds"):
+                        if key in ep:
+                            dones = np.maximum(
+                                dones, np.asarray(ep[key], np.float32)
+                            )
+                    dones[-1] = 1.0
+                    ep["dones"] = dones
+                    self._episodes.append(ep)
+        if not self._episodes:
+            raise ValueError(f"offline files {self.files} contain no batches")
+        self._order = self._rng.permutation(len(self._episodes))
+        self._cursor = 0
+
+    def _next_episode(self) -> Dict[str, np.ndarray]:
+        if self._cursor >= len(self._order):
+            self._order = self._rng.permutation(len(self._episodes))
+            self._cursor = 0
+        ep = self._episodes[self._order[self._cursor]]
+        self._cursor += 1
+        return ep
+
+    def next(self) -> Dict[str, np.ndarray]:
+        """Concatenate whole episodes until `batch_size` transitions."""
+        chunks: List[Dict[str, np.ndarray]] = []
+        rows = 0
+        while rows < self.batch_size:
+            ep = self._next_episode()
+            chunks.append(ep)
+            rows += len(ep["actions"])
+        keys = set(chunks[0])
+        for c in chunks[1:]:
+            keys &= set(c)
+        return {
+            k: np.concatenate([np.asarray(c[k]) for c in chunks]) for k in keys
+        }

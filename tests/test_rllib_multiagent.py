@@ -1,0 +1,198 @@
+"""Multi-agent RLlib tests (reference: `rllib/tests/test_multi_agent_env.py`
+— make_multi_agent round-trip + two-policy learning; VERDICT round-3 #1)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _imports():
+    pytest.importorskip("gymnasium")
+
+
+def test_make_multi_agent_env_protocol():
+    """make_multi_agent wraps N independent copies: per-agent dict API,
+    final-obs reporting, and the __all__ end-of-episode flag."""
+    _imports()
+
+    from ray_tpu.rllib import make_multi_agent
+
+    creator = make_multi_agent("CartPole-v1")
+    env = creator({"num_agents": 3})
+    assert set(env.observation_space) == {"0", "1", "2"}
+    obs, infos = env.reset(seed=0)
+    assert set(obs) == {"0", "1", "2"}
+    assert all(o.shape == (4,) for o in obs.values())
+    done_agents = set()
+    for _ in range(500):
+        actions = {aid: 0 for aid in obs if aid not in done_agents}
+        obs, rews, terms, truncs, infos = env.step(actions)
+        for aid, te in terms.items():
+            if aid != "__all__" and (te or truncs.get(aid)):
+                done_agents.add(aid)
+                # Done agents still report a final obs for bootstrap.
+                assert aid in obs
+        if terms["__all__"] or truncs["__all__"]:
+            break
+    # Always-push-left terminates every cartpole quickly.
+    assert done_agents == {"0", "1", "2"}
+    # After reset all agents act again.
+    obs, _ = env.reset()
+    assert set(obs) == {"0", "1", "2"}
+    env.close()
+
+
+def test_multi_agent_runner_routes_policies():
+    """Transitions land in the batch of the policy the mapping function
+    chose, with GAE columns attached per policy."""
+    _imports()
+
+    from ray_tpu.rllib import MLPModule, make_multi_agent
+    from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+
+    creator = make_multi_agent("CartPole-v1")
+    modules = {"even": MLPModule(4, 2), "odd": MLPModule(4, 2)}
+    runner = MultiAgentEnvRunner(
+        lambda: creator({"num_agents": 2}),
+        modules,
+        lambda aid: "even" if int(aid) % 2 == 0 else "odd",
+        num_envs=2,
+        rollout_length=32,
+        seed=0,
+    )
+    batches = runner.sample()
+    assert set(batches) == {"even", "odd"}
+    for pid, batch in batches.items():
+        n = len(batch["actions"])
+        assert n > 0
+        for key in ("obs", "logp", "behavior_logits", "advantages", "value_targets"):
+            assert len(batch[key]) == n, (pid, key)
+        assert batch["obs"].shape[1] == 4
+        assert batch["behavior_logits"].shape[1] == 2
+    # 2 envs x 2 agents x 32 steps bounds total transitions.
+    total = sum(len(b["actions"]) for b in batches.values())
+    assert total <= 2 * 2 * 32
+
+
+def _ma_ppo_config():
+    from ray_tpu.rllib import PPOConfig, make_multi_agent
+
+    creator = make_multi_agent("CartPole-v1")
+    return (
+        PPOConfig()
+        .environment(lambda cfg=None: creator({"num_agents": 2}))
+        .env_runners(
+            num_env_runners=2, num_envs_per_runner=2, rollout_fragment_length=64
+        )
+        .training(
+            lr=3e-4, gamma=0.99, minibatch_size=128, num_epochs=4,
+            entropy_coeff=0.01,
+        )
+        .multi_agent(
+            policies=["p0", "p1"],
+            policy_mapping_fn=lambda aid: "p0" if aid == "0" else "p1",
+        )
+    )
+
+
+def test_multi_agent_ppo_learns(ray_start_regular):
+    """Two independent policies trained from one env both improve: the
+    summed episode return climbs well above the random-policy floor
+    (~2x22 for two random cartpoles)."""
+    _imports()
+    algo = _ma_ppo_config().build()
+    try:
+        first, best = None, -np.inf
+        m = {}
+        for _ in range(15):
+            m = algo.train()
+            ret = m.get("episode_return_mean")
+            if ret is not None:
+                if first is None:
+                    first = ret
+                best = max(best, ret)
+            if first is not None and best > first + 60:
+                break
+        assert first is not None, "no episodes completed"
+        assert best > first + 40, f"no learning: first={first:.1f} best={best:.1f}"
+        # Both policies actually trained this iteration.
+        assert "policy_p0/total_loss" in m and "policy_p1/total_loss" in m
+        assert np.isfinite(m["policy_p0/total_loss"])
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_policies_to_train_freezes_others(ray_start_regular):
+    """policies_to_train=['p0'] leaves p1's weights untouched."""
+    _imports()
+    import jax
+
+    cfg = _ma_ppo_config().multi_agent(
+        policies=["p0", "p1"],
+        policy_mapping_fn=lambda aid: "p0" if aid == "0" else "p1",
+        policies_to_train=["p0"],
+    )
+    algo = cfg.build()
+    try:
+        frozen_before = algo.learner_groups["p1"].get_weights()
+        trained_before = algo.learner_groups["p0"].get_weights()
+        m = algo.train()
+        frozen_after = algo.learner_groups["p1"].get_weights()
+        trained_after = algo.learner_groups["p0"].get_weights()
+        for a, b in zip(jax.tree.leaves(frozen_before), jax.tree.leaves(frozen_after)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        diffs = [
+            float(np.abs(np.asarray(a) - np.asarray(b)).max())
+            for a, b in zip(
+                jax.tree.leaves(trained_before), jax.tree.leaves(trained_after)
+            )
+        ]
+        assert max(diffs) > 0.0
+        assert "policy_p1/total_loss" not in m
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_checkpoint_save_restore(ray_start_regular, tmp_path):
+    """save() -> restore() round-trips every policy's learner state and the
+    per-policy KL coefficients."""
+    _imports()
+    import jax
+
+    algo = _ma_ppo_config().build()
+    try:
+        algo.train()
+        algo.kl_coeff["p1"] = 0.456
+        path = algo.save(str(tmp_path / "ck"))
+        w_before = {
+            pid: lg.get_weights() for pid, lg in algo.learner_groups.items()
+        }
+    finally:
+        algo.stop()
+    algo2 = _ma_ppo_config().build()
+    try:
+        algo2.restore(path)
+        assert algo2.kl_coeff["p1"] == pytest.approx(0.456)
+        for pid, lg in algo2.learner_groups.items():
+            for a, b in zip(
+                jax.tree.leaves(w_before[pid]), jax.tree.leaves(lg.get_weights())
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        algo2.train()  # trains on after restore
+    finally:
+        algo2.stop()
+
+
+def test_multi_agent_requires_mapping_with_multiple_policies():
+    _imports()
+    from ray_tpu.rllib import PPOConfig, make_multi_agent
+
+    creator = make_multi_agent("CartPole-v1")
+    cfg = (
+        PPOConfig()
+        .environment(lambda cfg=None: creator({"num_agents": 2}))
+        .multi_agent(policies=["a", "b"])
+    )
+    with pytest.raises(ValueError, match="policy_mapping_fn"):
+        cfg.build()

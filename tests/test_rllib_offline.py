@@ -1,0 +1,236 @@
+"""Offline-RL tests: JSON reader/writer, DatasetReader, BC and MARWIL
+learning (reference: `rllib/offline/tests/`, `rllib/algorithms/bc/tests/
+test_bc.py`, `rllib/algorithms/marwil/tests/test_marwil.py`; VERDICT
+round-3 #1)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _imports():
+    pytest.importorskip("gymnasium")
+
+
+def _scripted_cartpole_episodes(n_episodes, policy, seed0=0):
+    """Roll a scripted policy; yields per-episode column dicts."""
+    import gymnasium as gym
+
+    env = gym.make("CartPole-v1")
+    for ep in range(n_episodes):
+        obs, _ = env.reset(seed=seed0 + ep)
+        rows = {
+            k: []
+            for k in ("obs", "actions", "rewards", "terminateds", "truncateds")
+        }
+        done = False
+        while not done:
+            a = policy(obs, ep)
+            nxt, r, te, tr, _ = env.step(a)
+            rows["obs"].append(obs.tolist())
+            rows["actions"].append(int(a))
+            rows["rewards"].append(float(r))
+            rows["terminateds"].append(bool(te))
+            rows["truncateds"].append(bool(tr))
+            obs = nxt
+            done = te or tr
+        yield rows
+    env.close()
+
+
+def _expert(obs, ep):
+    # Push toward the pole's lean: near-perfect CartPole play (~500 return).
+    return int(obs[2] + 0.5 * obs[3] > 0)
+
+
+def _write_episodes(path, episodes):
+    from ray_tpu.rllib.offline import JsonWriter
+
+    w = JsonWriter(str(path))
+    count = 0
+    for rows in episodes:
+        w.write(rows)
+        count += 1
+    w.close()
+    return count
+
+
+# ------------------------------------------------------------------ readers
+def test_json_writer_reader_roundtrip(tmp_path):
+    _imports()
+    from ray_tpu.rllib.offline import JsonReader
+
+    _write_episodes(tmp_path, _scripted_cartpole_episodes(5, _expert))
+    reader = JsonReader(str(tmp_path), batch_size=64)
+    batch = reader.next()
+    assert len(batch["actions"]) >= 64
+    assert batch["obs"].shape[1] == 4
+    # Reader closes every line's tail: the flat batch ends done, and each
+    # line's last row is done.
+    assert batch["dones"][-1] == 1.0
+    # Cycling never exhausts.
+    for _ in range(50):
+        b = reader.next()
+        assert len(b["actions"]) >= 64
+
+
+def test_json_reader_missing_files_raise(tmp_path):
+    from ray_tpu.rllib.offline import JsonReader
+
+    with pytest.raises(FileNotFoundError):
+        JsonReader(str(tmp_path / "nope" / "*.json"))
+
+
+def test_compute_returns_resets_at_dones():
+    from ray_tpu.rllib.algorithms.marwil import compute_returns
+
+    rewards = np.array([1.0, 1.0, 1.0, 2.0, 2.0], np.float32)
+    dones = np.array([0.0, 0.0, 1.0, 0.0, 1.0], np.float32)
+    out = compute_returns(rewards, dones, gamma=0.5)
+    # Episode 1: [1 + .5(1 + .5*1), 1 + .5*1, 1]; episode 2: [2 + .5*2, 2].
+    np.testing.assert_allclose(out, [1.75, 1.5, 1.0, 3.0, 2.0], rtol=1e-6)
+
+
+def test_dataset_reader_cycles(ray_start_regular):
+    from ray_tpu import data as rdata
+    from ray_tpu.rllib.offline import DatasetReader
+
+    items = [
+        {"obs": np.full(4, i, np.float32), "actions": i % 2} for i in range(30)
+    ]
+    ds = rdata.from_items(items)
+    reader = DatasetReader(ds, batch_size=16)
+    seen = 0
+    for _ in range(5):  # 80 rows > 30-row dataset: cycles through epochs
+        b = reader.next()
+        assert b["obs"].shape[1] == 4
+        seen += len(b["actions"])
+    assert seen >= 70
+
+
+# ----------------------------------------------------------------------- BC
+def _bc_config(source):
+    from ray_tpu.rllib import BCConfig
+
+    return (
+        BCConfig()
+        .environment("CartPole-v1")
+        .training(lr=1e-3, train_batch_size=512, updates_per_iteration=20)
+        .offline_data(input_=source)
+    )
+
+
+def test_bc_learns_from_expert_json(ray_start_regular, tmp_path):
+    """Behavioral cloning on scripted-expert episodes: the greedy policy's
+    eval return lands far above the random floor (~22)."""
+    _imports()
+    _write_episodes(tmp_path, _scripted_cartpole_episodes(40, _expert))
+    algo = _bc_config(str(tmp_path)).build()
+    try:
+        for _ in range(10):
+            m = algo.train()
+        assert np.isfinite(m["total_loss"])
+        assert m["vf_loss"] == 0.0  # beta=0: no value term
+        ev = algo.evaluate(num_episodes=8)
+        assert ev["episode_return_mean"] > 150, ev
+    finally:
+        algo.stop()
+
+
+def test_bc_learns_from_ray_data_dataset(ray_start_regular, tmp_path):
+    """The DatasetReader path: BC fed straight from a ray_tpu.data Dataset
+    of transition rows (reference: `offline/dataset_reader.py` feeding BC)."""
+    _imports()
+    from ray_tpu import data as rdata
+
+    rows = []
+    for ep in _scripted_cartpole_episodes(30, _expert):
+        for obs, act in zip(ep["obs"], ep["actions"]):
+            rows.append({"obs": np.asarray(obs, np.float32), "actions": act})
+    ds = rdata.from_items(rows)
+    algo = _bc_config(ds).build()
+    try:
+        for _ in range(10):
+            m = algo.train()
+        ev = algo.evaluate(num_episodes=8)
+        assert ev["episode_return_mean"] > 150, ev
+    finally:
+        algo.stop()
+
+
+def test_bc_rejects_nonzero_beta():
+    from ray_tpu.rllib import BCConfig
+
+    with pytest.raises(ValueError, match="beta"):
+        BCConfig().training(beta=0.5)
+
+
+# ------------------------------------------------------------------- MARWIL
+def test_marwil_learns_from_mixed_data(ray_start_regular, tmp_path):
+    """beta=1 advantage weighting upweights the expert half of mixed-quality
+    data: eval return beats plain averaging of the two behavior policies."""
+    _imports()
+    rng = np.random.default_rng(0)
+
+    def mixed(obs, ep):
+        if ep % 2 == 0:
+            return _expert(obs, ep)
+        return int(rng.integers(2))
+
+    _write_episodes(tmp_path, _scripted_cartpole_episodes(40, mixed))
+    from ray_tpu.rllib import MARWILConfig
+
+    cfg = (
+        MARWILConfig()
+        .environment("CartPole-v1")
+        .training(
+            lr=1e-3, beta=1.0, train_batch_size=512, updates_per_iteration=20
+        )
+        .offline_data(input_=str(tmp_path))
+    )
+    algo = cfg.build()
+    try:
+        for _ in range(12):
+            m = algo.train()
+        # The advantage-norm EMA actually moved off its start value.
+        assert m["ma_sqd_adv_norm"] != pytest.approx(
+            cfg.moving_average_sqd_adv_norm_start
+        )
+        assert m["vf_loss"] > 0.0
+        ev = algo.evaluate(num_episodes=8)
+        assert ev["episode_return_mean"] > 150, ev
+    finally:
+        algo.stop()
+
+
+def test_marwil_checkpoint_roundtrips_ma_norm(ray_start_regular, tmp_path):
+    _imports()
+    _write_episodes(
+        tmp_path / "data", _scripted_cartpole_episodes(10, _expert)
+    )
+    from ray_tpu.rllib import MARWILConfig
+
+    def build():
+        return (
+            MARWILConfig()
+            .environment("CartPole-v1")
+            .training(lr=1e-3, train_batch_size=256, updates_per_iteration=4)
+            .offline_data(input_=str(tmp_path / "data"))
+            .build()
+        )
+
+    algo = build()
+    try:
+        algo.train()
+        norm = algo.ma_sqd_adv_norm
+        path = algo.save(str(tmp_path / "ck"))
+    finally:
+        algo.stop()
+    algo2 = build()
+    try:
+        algo2.restore(path)
+        assert algo2.ma_sqd_adv_norm == pytest.approx(norm)
+        algo2.train()
+    finally:
+        algo2.stop()
