@@ -59,6 +59,16 @@ def _tcp_group_bench(world: int, nbytes: int, iters: int) -> float:
 
 
 def main() -> None:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Virtual CPU mesh requested: the axon sitecustomize plugin beats
+        # plain env vars, so drop its trigger and pin the platform before
+        # any jax backend initializes (same sequence as __graft_entry__).
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import ray_tpu
 
     ray_tpu.init(num_cpus=4)
@@ -92,7 +102,14 @@ def main() -> None:
                 in_specs=P("d"), out_specs=P(), check_vma=False,
             )
         )
-        for label, nbytes, iters in (("1MB", 1 << 20, 50), ("64MB", 64 << 20, 20)):
+        # On the virtual CPU mesh all devices timeshare one core: 64MB/dev
+        # trips XLA's 40 s collective-rendezvous watchdog. Real accelerator
+        # meshes take the full-size point.
+        if jax.default_backend() == "cpu":
+            points = (("1MB", 1 << 20, 30), ("8MB", 8 << 20, 10))
+        else:
+            points = (("1MB", 1 << 20, 50), ("64MB", 64 << 20, 20))
+        for label, nbytes, iters in points:
             x = jax.device_put(
                 np.ones((ndev, nbytes // 4), np.float32),
                 NamedSharding(mesh, P("d")),

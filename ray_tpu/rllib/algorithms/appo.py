@@ -151,28 +151,8 @@ class APPO(Impala):
 
     # ----------------------------------------------------------- one iteration
     def training_step(self) -> Dict[str, Any]:
-        import ray_tpu
-
         cfg = self.config
-        weights = self.learner_group.get_weights()
-        ray_tpu.get([r.set_weights.remote(weights) for r in self.env_runners])
-        rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
-
-        def env_major(key):
-            return np.concatenate(
-                [np.moveaxis(ro[key], 0, 1) for ro in rollouts], axis=0
-            )
-
-        batch = {
-            k: env_major(k)
-            for k in (
-                "obs", "actions", "logp", "rewards",
-                "dones", "terminateds", "truncateds", "final_obs",
-            )
-        }
-        batch["last_obs"] = np.concatenate(
-            [ro["last_obs"] for ro in rollouts], axis=0
-        )
+        batch = self._sample_env_major_batch()
         N = batch["rewards"].shape[0]
         batch["kl_coeff"] = np.full(N, self.kl_coeff, np.float32)
         out = dict(self.learner_group.update(batch))

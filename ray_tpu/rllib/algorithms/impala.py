@@ -143,15 +143,17 @@ class Impala(Algorithm):
         )
 
     # ----------------------------------------------------------- one iteration
-    def training_step(self) -> Dict[str, Any]:
+    def _sample_env_major_batch(self) -> Dict[str, np.ndarray]:
+        """Sync weights, gather rollouts, and assemble the (N, T, ...)
+        env-major batch the V-trace losses consume — concat over runners on
+        the env axis (the axis LearnerGroup shards / the mesh data axis).
+        Shared by IMPALA and APPO."""
         import ray_tpu
 
         weights = self.learner_group.get_weights()
         ray_tpu.get([r.set_weights.remote(weights) for r in self.env_runners])
         rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
 
-        # (T, N, ...) buffers -> env-major (N, T, ...), concat over runners on
-        # the env axis (the axis LearnerGroup shards / the mesh data axis).
         def env_major(key):
             return np.concatenate(
                 [np.moveaxis(ro[key], 0, 1) for ro in rollouts], axis=0
@@ -165,6 +167,10 @@ class Impala(Algorithm):
             )
         }
         batch["last_obs"] = np.concatenate([ro["last_obs"] for ro in rollouts], axis=0)
+        return batch
+
+    def training_step(self) -> Dict[str, Any]:
+        batch = self._sample_env_major_batch()
         out = dict(self.learner_group.update(batch))
         out["num_env_steps_sampled"] = int(batch["rewards"].size)
         return self.collect_episode_metrics(out)

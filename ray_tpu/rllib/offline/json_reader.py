@@ -34,42 +34,67 @@ def _expand(paths: Union[str, Sequence[str]]) -> List[str]:
 
 
 class JsonReader(InputReader):
+    """Streams one file at a time (files are bounded by the writer's
+    `max_file_size`), shuffling file order per epoch and episode order within
+    each file — the whole dataset is never resident (reference: the streaming
+    `json_reader.py` shuffles at file granularity the same way)."""
+
     def __init__(self, inputs: Union[str, Sequence[str]],
                  batch_size: int = 256, seed: int = 0):
         self.files = _expand(inputs)
+        missing = [f for f in self.files if not os.path.exists(f)]
+        if missing:
+            raise FileNotFoundError(f"offline data files not found: {missing}")
         self.batch_size = batch_size
         self._rng = np.random.default_rng(seed)
-        self._episodes: List[Dict[str, np.ndarray]] = []
-        for fname in self.files:
-            with open(fname) as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    row = json.loads(line)
-                    ep = {k: np.asarray(v) for k, v in row.items()}
-                    n = len(ep["actions"])
-                    # Close the line's tail so per-batch return computation
-                    # treats every line as a self-contained segment.
-                    dones = np.zeros(n, np.float32)
-                    for key in ("dones", "terminateds", "truncateds"):
-                        if key in ep:
-                            dones = np.maximum(
-                                dones, np.asarray(ep[key], np.float32)
-                            )
-                    dones[-1] = 1.0
-                    ep["dones"] = dones
-                    self._episodes.append(ep)
-        if not self._episodes:
-            raise ValueError(f"offline files {self.files} contain no batches")
-        self._order = self._rng.permutation(len(self._episodes))
+        self._file_order: List[int] = []
+        self._loaded: List[Dict[str, np.ndarray]] = []
         self._cursor = 0
 
-    def _next_episode(self) -> Dict[str, np.ndarray]:
-        if self._cursor >= len(self._order):
-            self._order = self._rng.permutation(len(self._episodes))
+    @staticmethod
+    def _parse_line(line: str) -> Dict[str, np.ndarray]:
+        row = json.loads(line)
+        ep = {k: np.asarray(v) for k, v in row.items()}
+        n = len(ep["actions"])
+        # Close the line's tail so per-batch return computation treats
+        # every line as a self-contained segment.
+        dones = np.zeros(n, np.float32)
+        for key in ("dones", "terminateds", "truncateds"):
+            if key in ep:
+                dones = np.maximum(dones, np.asarray(ep[key], np.float32))
+        dones[-1] = 1.0
+        ep["dones"] = dones
+        return ep
+
+    def _load_next_file(self) -> None:
+        """Parse one file's episodes into the serving window."""
+        attempts = 0
+        while not self._loaded:
+            if not self._file_order:
+                if attempts >= len(self.files):
+                    raise ValueError(
+                        f"offline files {self.files} contain no batches"
+                    )
+                self._file_order = list(
+                    self._rng.permutation(len(self.files))
+                )
+            fname = self.files[self._file_order.pop()]
+            attempts += 1
+            with open(fname) as fh:
+                episodes = [
+                    self._parse_line(line)
+                    for line in fh
+                    if line.strip()
+                ]
+            self._rng.shuffle(episodes)
+            self._loaded = episodes
             self._cursor = 0
-        ep = self._episodes[self._order[self._cursor]]
+
+    def _next_episode(self) -> Dict[str, np.ndarray]:
+        if self._cursor >= len(self._loaded):
+            self._loaded = []
+            self._load_next_file()
+        ep = self._loaded[self._cursor]
         self._cursor += 1
         return ep
 

@@ -220,6 +220,7 @@ class TCPGroup(BaseGroup):
         self._ring_next: Optional[socket.socket] = None
         self._ring_prev: Optional[socket.socket] = None
         self._ring_lock = threading.Lock()
+        self._ring_uds_path: Optional[str] = None
 
     def _round_trip(self, msg: Dict[str, Any]) -> Any:
         with self._sock_lock:
@@ -231,41 +232,115 @@ class TCPGroup(BaseGroup):
         return self._seq
 
     # ----------------------------------------------------------------- ring
+    @staticmethod
+    def _host_id() -> str:
+        """Identity shared by processes on one host (boot id + hostname):
+        same-host neighbors upgrade their ring link from TCP loopback to a
+        Unix-domain socket (~40% more loopback throughput — no TCP stack)."""
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as fh:
+                boot = fh.read().strip()
+        except OSError:
+            boot = "noboot"
+        return f"{boot}/{socket.gethostname()}"
+
     def _ensure_ring(self):
-        """Build the neighbor ring: every rank listens, publishes its address,
-        connects to rank+1, and accepts from rank-1."""
+        """Build the neighbor ring: every rank listens (TCP + a same-host UDS
+        endpoint), publishes its addresses, connects to rank+1 over UDS when
+        co-hosted else TCP, and accepts from rank-1."""
         if self._ring_next is not None or self.world_size == 1:
             return
         with self._ring_lock:
             if self._ring_next is not None:
                 return
+            import os
+            import tempfile
+
             server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             server.bind(("127.0.0.1", 0))
             server.listen(2)
+            uds_path = os.path.join(
+                tempfile.gettempdir(),
+                f"rtring_{os.getpid()}_{self.group_name[:24]}_{self.rank}.sock",
+            )
+            try:
+                os.unlink(uds_path)
+            except OSError:
+                pass
+            uds_server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            uds_server.bind(uds_path)
+            uds_server.listen(2)
+            self._ring_uds_path = uds_path
+            host_id = self._host_id()
             key = f"collective/{self.group_name}/ring/{self.rank}".encode()
-            publish(self._kv, key, f"127.0.0.1:{server.getsockname()[1]}".encode())
+            record = f"{host_id}|127.0.0.1:{server.getsockname()[1]}|{uds_path}"
+            publish(self._kv, key, record.encode())
             nxt = (self.rank + 1) % self.world_size
             nkey = f"collective/{self.group_name}/ring/{nxt}".encode()
-            host, port = wait_for(self._kv, nkey).decode().split(":")
+            n_host_id, n_tcp, n_uds = wait_for(self._kv, nkey).decode().split("|")
             # Connect-to-next and accept-from-prev in parallel (both block).
+            # The prev neighbor picks TCP or UDS; accept on both, first wins.
             out: Dict[str, Any] = {}
+            accept_done = threading.Event()
 
-            def _accept():
-                conn, _ = server.accept()
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            def _accept(srv, is_tcp):
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                if accept_done.is_set():
+                    conn.close()
+                    return
+                if is_tcp:
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # Publish the connection BEFORE signalling: the waiter checks
+                # out["prev"] as soon as the event fires.
                 out["prev"] = conn
+                accept_done.set()
 
-            t = threading.Thread(target=_accept, daemon=True)
-            t.start()
-            nxt_sock = socket.create_connection((host, int(port)), timeout=60)
-            nxt_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            t.join(timeout=60)
+            threads = [
+                threading.Thread(target=_accept, args=(server, True), daemon=True),
+                threading.Thread(target=_accept, args=(uds_server, False), daemon=True),
+            ]
+            for t in threads:
+                t.start()
+            nxt_sock = None
+            if n_host_id == host_id:
+                # Same host id is necessary but not sufficient for UDS (two
+                # containers can share boot_id+hostname without sharing /tmp):
+                # try briefly, then fall back to the published TCP address.
+                uds = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                deadline = time.time() + 10
+                while nxt_sock is None and time.time() < deadline:
+                    try:
+                        uds.connect(n_uds)
+                        nxt_sock = uds
+                    except OSError:
+                        time.sleep(0.05)
+                if nxt_sock is None:
+                    uds.close()
+            if nxt_sock is None:
+                thost, tport = n_tcp.split(":")
+                nxt_sock = socket.create_connection((thost, int(tport)), timeout=60)
+                nxt_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            accept_done.wait(timeout=60)
             server.close()
+            uds_server.close()
             if "prev" not in out:
                 raise ConnectionError("ring neighbor never connected")
             self._ring_prev = out["prev"]
             self._ring_next = nxt_sock
+            # Deep buffers let a whole ring piece queue per syscall instead of
+            # draining through the ~208KB default in many scheduler wakeups —
+            # that context-switch churn is the cost that matters when many
+            # ranks share few cores.
+            for s in (self._ring_prev, self._ring_next):
+                for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+                    try:
+                        s.setsockopt(socket.SOL_SOCKET, opt, _RING_PIECE_BYTES)
+                    except OSError:
+                        pass
 
     def _ring_exchange(self, send_view: memoryview, recv_buf: memoryview):
         """One ring step: stream send_view to next while filling recv_buf from
@@ -384,6 +459,13 @@ class TCPGroup(BaseGroup):
             try:
                 if s is not None:
                     s.close()
+            except OSError:
+                pass
+        if self._ring_uds_path is not None:
+            import os
+
+            try:
+                os.unlink(self._ring_uds_path)
             except OSError:
                 pass
         try:

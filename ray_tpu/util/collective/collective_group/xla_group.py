@@ -87,7 +87,15 @@ class XLAGroup(BaseGroup):
         reference fills with a named NCCLUniqueIDStore actor)."""
         import jax
 
-        if jax.process_count() == self.world_size:
+        # Probe WITHOUT touching the backend: jax.process_count() would
+        # initialize XLA and make distributed.initialize() impossible.
+        if jax.distributed.is_initialized():
+            if jax.process_count() != self.world_size:
+                raise RuntimeError(
+                    f"jax.distributed already initialized with "
+                    f"{jax.process_count()} processes; group wants "
+                    f"{self.world_size}"
+                )
             return  # already initialized (e.g. by JaxBackend.on_start)
         key = f"collective/{self.group_name}/jax_coordinator".encode()
         if self.rank == 0:
