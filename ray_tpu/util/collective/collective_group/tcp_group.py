@@ -324,7 +324,27 @@ class TCPGroup(BaseGroup):
                 thost, tport = n_tcp.split(":")
                 nxt_sock = socket.create_connection((thost, int(tport)), timeout=60)
                 nxt_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            accept_done.wait(timeout=60)
+            if accept_done.wait(timeout=60):
+                # Wake whichever listener is still blocked in accept()
+                # (closing a listening socket does NOT unblock accept on
+                # Linux): a throwaway self-connection makes the loser see
+                # accept_done and exit instead of leaking a blocked thread +
+                # pinned socket per ring build. Only after success — before
+                # accept_done is set a waker would be mistaken for the real
+                # neighbor.
+                for fam, addr in (
+                    (socket.AF_INET, server.getsockname()),
+                    (socket.AF_UNIX, uds_path),
+                ):
+                    try:
+                        w = socket.socket(fam, socket.SOCK_STREAM)
+                        w.settimeout(1)
+                        w.connect(addr)
+                        w.close()
+                    except OSError:
+                        pass
+                for t in threads:
+                    t.join(timeout=5)
             server.close()
             uds_server.close()
             if "prev" not in out:
