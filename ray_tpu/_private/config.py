@@ -73,6 +73,11 @@ class Config:
     # Max stateless workers started per node beyond num_cpus (oversubscription to
     # break ray.get deadlocks, reference worker_pool prestart behaviour).
     maximum_startup_concurrency: int = 4
+    # Max tasks in flight per leased stateless worker (1 = no pipelining).
+    # When a dispatch class saturates the node, further same-class tasks
+    # queue directly on the class's busy workers — the reference's
+    # lease-based pipelined submission (`direct_task_transport.h:75`).
+    worker_pipeline_depth: int = 8
     max_io_workers: int = 2
 
     # --- fault tolerance ---

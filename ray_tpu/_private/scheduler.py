@@ -165,6 +165,12 @@ class WorkerHandle:
     send_lock: threading.Lock = field(default_factory=threading.Lock)
     outbox: List[bytes] = field(default_factory=list)
     started_at: float = field(default_factory=time.time)
+    # Lease pipelining (stateless workers): the dispatch class this worker is
+    # leased to and its FIFO of in-flight task ids — inflight_tasks[0] is the
+    # task actually executing (and the one holding the acquired resources;
+    # accounting transfers to the successor on completion).
+    lease_key: Optional[tuple] = None
+    inflight_tasks: List[TaskID] = field(default_factory=list)
 
     def send(self, msg) -> bool:
         data = serialization.dumps(msg)
@@ -255,6 +261,124 @@ class TaskRecord:
     # and producers parked until the consumer catches up (threshold, respond).
     stream_requested: int = -1
     throttle_waiters: List[Tuple[int, Callable]] = field(default_factory=list)
+    # Cached dispatch-class key (see _PendingQueue): tasks with equal keys
+    # have identical feasibility, so one failed dispatch parks the class.
+    dispatch_key: Optional[tuple] = None
+
+
+class _PendingQueue:
+    """Pending tasks indexed by dispatch class.
+
+    A burst of N same-shaped submissions must not cost O(N) dispatch attempts
+    per scheduler wakeup (the reference queues ~1M tasks/node,
+    `release/benchmarks/README.md:30`; its ClusterTaskManager keys queues by
+    scheduling class, `common/task/task_spec.h SchedulingClass`). Records
+    whose (resources, strategy, runtime-env, PG) tuple matches are one class:
+    per wakeup each class is drained head-first until its first
+    resource-failure, so cost is O(classes + dispatched) instead of
+    O(pending).
+
+    Dependency-unresolved records are parked OUT of the class queues (the
+    object-ready callback re-queues them), so an unresolved head never blocks
+    the rest of its class.
+    """
+
+    def __init__(self):
+        from collections import OrderedDict, deque
+
+        self._deque = deque
+        self._by_class: "OrderedDict[tuple, Any]" = OrderedDict()
+        self._parked: Dict[int, TaskRecord] = {}
+
+    @staticmethod
+    def key_of(rec: TaskRecord) -> tuple:
+        if rec.dispatch_key is None:
+            from ray_tpu._private.runtime_env import env_hash
+
+            spec = rec.spec
+            strategy = spec.scheduling_strategy
+            if isinstance(strategy, str) or strategy is None:
+                strat_key = strategy
+            else:
+                strat_key = (
+                    getattr(strategy, "node_id", None),
+                    getattr(strategy, "soft", None),
+                )
+            rec.dispatch_key = (
+                spec.is_actor_creation,
+                frozenset(spec.resources.items()),
+                spec.placement_group_id,
+                spec.placement_group_bundle_index,
+                env_hash(spec.runtime_env),
+                strat_key,
+            )
+        return rec.dispatch_key
+
+    def push(self, rec: TaskRecord, front: bool = False) -> None:
+        key = self.key_of(rec)
+        q = self._by_class.get(key)
+        if q is None:
+            q = self._by_class[key] = self._deque()
+        if front:
+            q.appendleft(rec)
+        else:
+            q.append(rec)
+
+    def park(self, rec: TaskRecord) -> None:
+        """Hold a dependency-unresolved record outside the class queues."""
+        self._parked[id(rec)] = rec
+
+    def unpark(self, rec: TaskRecord) -> bool:
+        return self._parked.pop(id(rec), None) is not None
+
+    def classes(self) -> List[tuple]:
+        return list(self._by_class.keys())
+
+    def head(self, key: tuple) -> Optional[TaskRecord]:
+        q = self._by_class.get(key)
+        return q[0] if q else None
+
+    def pop_head(self, key: tuple) -> Optional[TaskRecord]:
+        q = self._by_class.get(key)
+        if not q:
+            self._by_class.pop(key, None)
+            return None
+        rec = q.popleft()
+        if not q:
+            del self._by_class[key]
+        return rec
+
+    def remove(self, rec: TaskRecord) -> bool:
+        if self.unpark(rec):
+            return True
+        key = self.key_of(rec)
+        q = self._by_class.get(key)
+        if q is None:
+            return False
+        try:
+            q.remove(rec)
+        except ValueError:
+            return False
+        if not q:
+            del self._by_class[key]
+        return True
+
+    def records(self) -> List[TaskRecord]:
+        out = [r for q in self._by_class.values() for r in q]
+        out.extend(self._parked.values())
+        return out
+
+    def __contains__(self, rec: TaskRecord) -> bool:
+        if id(rec) in self._parked:
+            return True
+        q = self._by_class.get(self.key_of(rec))
+        return bool(q) and rec in q
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._by_class.values()) + len(self._parked)
+
+    def __bool__(self) -> bool:
+        return bool(self._by_class) or bool(self._parked)
 
 
 @dataclass
@@ -332,13 +456,25 @@ class Scheduler:
         self.object_table: Dict[bytes, ObjectMeta] = {}
         self.object_waiters: Dict[bytes, List[Callable[[ObjectMeta], None]]] = {}
         self.tasks: Dict[TaskID, TaskRecord] = {}
-        self.pending: List[TaskRecord] = []
+        self.pending = _PendingQueue()
         self.actors: Dict[ActorID, ActorRecord] = {}
         self.pgs: Dict[PlacementGroupID, PGRecord] = {}
         self.pending_pgs: List[PGRecord] = []
         self._commands: "queue.SimpleQueue" = queue.SimpleQueue()
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
+        # True while a wake byte is undrained: submit bursts send one wake
+        # syscall, not one per task. _wake_lock couples the flag to the byte
+        # state — set+send and drain+clear are each atomic, so the flag can
+        # never be True with no byte in flight (which would strand commands
+        # until the loop's poll timeout).
+        self._wake_pending = False
+        self._wake_lock = threading.Lock()
+        # Per-_schedule-pass exec coalescing buffer ({wh: [ExecRequest]}).
+        self._exec_buffer: Optional[Dict[Any, List[Any]]] = None
+        # dispatch-class key -> leased workers (kept in sync by dispatch /
+        # idle / death transitions): O(1) pipeline-candidate lookup.
+        self._leases: Dict[tuple, List[WorkerHandle]] = {}
         self._conn_to_worker: Dict[Any, WorkerHandle] = {}
         self._conn_to_daemon: Dict[Any, DaemonHandle] = {}
         self._conn_to_driver: Dict[Any, DriverHandle] = {}
@@ -568,11 +704,33 @@ class Scheduler:
                 pass  # settled by the loop in the meantime
         return fut
 
+    def call_nowait(self, method: str, payload: Any) -> None:
+        """Fire-and-forget command: enqueue and return without waiting for
+        the loop to process it. Used by the hot submission path — pipelined
+        `.remote()` bursts must not pay one loop-wakeup ack each. FIFO with
+        `call()` commands, so a later blocking get/wait still observes every
+        prior submission. Errors surface through the task's return refs (the
+        command itself only registers the record)."""
+        if self._stopped.is_set():
+            raise RuntimeError("scheduler is stopped")
+        self._commands.put((method, payload, None))
+        self._wake()
+        # Post-put stop-race check (mirrors call()): if the loop's final
+        # drain already ran, this command would be dropped silently.
+        if self._stopped.is_set():
+            raise RuntimeError("scheduler is stopped")
+
     def _wake(self):
-        try:
-            self._wake_w.send(b"x")
-        except OSError:
-            pass
+        if self._wake_pending:
+            return  # racy fast-path read; re-checked under the lock
+        with self._wake_lock:
+            if self._wake_pending:
+                return
+            self._wake_pending = True
+            try:
+                self._wake_w.send(b"x")
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------ main loop
     def _loop(self):
@@ -600,11 +758,16 @@ class Scheduler:
                             self._on_worker_death(wh)
             for obj in ready:
                 if obj is self._wake_r:
-                    try:
-                        while self._wake_r.recv(4096):
+                    # Drain + clear atomically vs _wake's set + send: after
+                    # this block, either no byte is pending and the flag is
+                    # False, or a producer has sent a fresh byte.
+                    with self._wake_lock:
+                        try:
+                            while self._wake_r.recv(4096):
+                                pass
+                        except BlockingIOError:
                             pass
-                    except BlockingIOError:
-                        pass
+                        self._wake_pending = False
                     continue
                 wh = self._conn_to_worker.get(obj)
                 if wh is not None:
@@ -617,7 +780,9 @@ class Scheduler:
                 dh = self._conn_to_driver.get(obj)
                 if dh is not None:
                     self._drain_driver(dh)
-            # Drain commands.
+            # Drain commands (a fire-and-forget submit has fut=None: the whole
+            # burst is processed in ONE wakeup instead of one ack round trip
+            # per submission — the pipelined-submission fast path).
             while True:
                 try:
                     method, payload, fut = self._commands.get_nowait()
@@ -632,9 +797,16 @@ class Scheduler:
                     result = getattr(self, "_cmd_" + method)(payload)
                     # _ASYNC handlers resolve a caller-provided inner future later;
                     # the command future just acknowledges receipt.
-                    fut.set_result(None if result is _ASYNC else result)
+                    if fut is not None:
+                        fut.set_result(None if result is _ASYNC else result)
                 except Exception as e:  # noqa: BLE001
-                    fut.set_exception(e)
+                    if fut is not None:
+                        fut.set_exception(e)
+                    else:
+                        # Fire-and-forget command: the error must reach the
+                        # caller through the task's return refs, or a get()
+                        # on them would hang forever.
+                        self._seal_submit_failure(payload, e)
             # The loop must survive any scheduling-path exception: a dead
             # scheduler thread would hang every future get/put forever.
             try:
@@ -692,6 +864,8 @@ class Scheduler:
                 if kind == "req":
                     _, req_id, method, payload = msg
                     self._on_worker_request(dh, req_id, method, payload)
+                elif kind == "cmd":
+                    self._on_worker_request(dh, None, msg[1], msg[2])
                 elif kind == "object_data":
                     _, token, ok, data = msg
                     self._finish_pull(token, ok, data)
@@ -898,10 +1072,17 @@ class Scheduler:
         self._kill_actors_owned_by(wh.worker_id.hex())
         if wh.actor_id is not None:
             self._handle_actor_worker_death(wh)
-        elif wh.current_task is not None:
-            rec = self.tasks.get(wh.current_task)
-            if rec is not None:
-                self._handle_task_worker_death(rec)
+        else:
+            # Every in-flight task dies with the worker — the running head
+            # AND any lease-pipelined tasks queued behind it.
+            dead = list(wh.inflight_tasks) or (
+                [wh.current_task] if wh.current_task is not None else []
+            )
+            self._drop_lease(wh)
+            for tid in dead:
+                rec = self.tasks.get(tid)
+                if rec is not None and rec.state == "RUNNING":
+                    self._handle_task_worker_death(rec)
 
     def _handle_task_worker_death(self, rec: TaskRecord):
         self._release_task_resources(rec)
@@ -909,7 +1090,7 @@ class Scheduler:
             rec.retries_left -= 1
             rec.state = "PENDING"
             rec.worker = None
-            self.pending.append(rec)
+            self.pending.push(rec)
             self._record_event(rec.spec, "RETRY")
         else:
             from ray_tpu.exceptions import WorkerCrashedError
@@ -969,19 +1150,30 @@ class Scheduler:
         if kind == "done":
             _, task_id_bytes, ok, metas = msg
             self._on_task_done(wh, TaskID(task_id_bytes), ok, metas)
+        elif kind == "done_batch":
+            # Lease-pipelined workers batch completions while their local
+            # queue is non-empty; order within the batch = execution order.
+            for task_id_bytes, ok, metas in msg[1]:
+                self._on_task_done(wh, TaskID(task_id_bytes), ok, metas)
         elif kind == "stream":
             _, task_id_bytes, index, meta = msg
             self._on_stream_item(TaskID(task_id_bytes), index, meta)
         elif kind == "req":
             _, req_id, method, payload = msg
             self._on_worker_request(wh, req_id, method, payload)
+        elif kind == "cmd":
+            # One-way request (no ack): the pipelined submission path.
+            self._on_worker_request(wh, None, msg[1], msg[2])
         elif kind == "ref_ops":
             self._apply_ref_ops(msg[1], wh.worker_id.hex())
 
-    def _respond(self, wh: WorkerHandle, req_id: int, ok: bool, payload):
+    def _respond(self, wh: WorkerHandle, req_id: Optional[int], ok: bool, payload):
+        # req_id None = one-way "cmd" message: no ack is expected.
+        if req_id is None:
+            return
         wh.send(("resp", req_id, ok, payload))
 
-    def _on_worker_request(self, wh: WorkerHandle, req_id: int, method: str, payload):
+    def _on_worker_request(self, wh: WorkerHandle, req_id: Optional[int], method: str, payload):
         handler = getattr(self, "_req_" + method, None)
         if handler is None:
             self._respond(wh, req_id, False, ValueError(f"unknown request {method}"))
@@ -989,11 +1181,48 @@ class Scheduler:
         try:
             handler(wh, req_id, payload)
         except Exception as e:  # noqa: BLE001
-            self._respond(wh, req_id, False, e)
+            if req_id is None:
+                # One-way submit: surface the failure through the task's
+                # return refs (nobody is waiting on an ack).
+                self._seal_submit_failure(payload, e)
+            else:
+                self._respond(wh, req_id, False, e)
+
+    def _seal_submit_failure(self, payload, err: Exception) -> None:
+        """A fire-and-forget submit's handler raised: seal the error into the
+        payload's return refs so the caller's get() raises instead of
+        hanging. Payloads without return refs just log."""
+        import traceback
+
+        traceback.print_exc()
+        rec = None
+        if isinstance(payload, TaskRecord):
+            rec = payload
+        elif isinstance(payload, ExecRequest):
+            rec = self.tasks.get(payload.spec.task_id) or TaskRecord(
+                spec=payload.spec,
+                arg_entries=[],
+                kwarg_entries={},
+                return_ids=list(payload.return_ids),
+                func_blob=None,
+            )
+        if rec is not None and rec.return_ids:
+            try:
+                self._register_return_holders(rec.return_ids, self._INPROC_DRIVER)
+                self._store_error_results(rec, err)
+            except Exception:
+                traceback.print_exc()
 
     def _on_task_done(self, wh: WorkerHandle, task_id: TaskID, ok: bool, metas: List[ObjectMeta]):
         rec = self.tasks.get(task_id)
         if rec is None:
+            return
+        if rec.state == "CANCELLED":
+            # The task executed before its cancel landed (its done was
+            # buffered/in flight). The cancel already sealed the results and
+            # removed it from the worker's inflight window — re-running the
+            # completion path would clobber the successor's transferred
+            # accounting and overwrite the cancellation error.
             return
         rec.state = "FINISHED" if ok else "FAILED"
         self._record_event(rec.spec, rec.state)
@@ -1013,13 +1242,40 @@ class Scheduler:
                 if rec.spec.is_actor_creation:
                     self._on_actor_created(ar, ok, metas)
         else:
-            self._release_task_resources(rec)
-            if wh.actor_id is None:
-                wh.state = "idle"
-                wh.current_task = None
-                node = self.nodes.get(wh.node_id)
-                if node is not None and wh.worker_id not in node.idle and node.alive:
-                    node.idle.append(wh.worker_id)
+            was_inflight = task_id in wh.inflight_tasks
+            if was_inflight:
+                wh.inflight_tasks.remove(task_id)
+            elif wh.inflight_tasks:
+                # Stale done (task already removed from the window, e.g. a
+                # cancel raced an in-flight completion): other tasks still
+                # own the lease — touching the transfer logic would corrupt
+                # their accounting.
+                return
+            successor = None
+            if wh.actor_id is None and wh.inflight_tasks:
+                successor = self.tasks.get(wh.inflight_tasks[0])
+            if successor is not None:
+                # Lease pipelining: the worker is already executing the next
+                # queued task — transfer the resource accounting instead of
+                # release+reacquire (every acquired unit still released
+                # exactly once, by whichever task finishes last).
+                successor.acquired = rec.acquired
+                successor.acquired_pg = rec.acquired_pg
+                rec.acquired = {}
+                rec.acquired_pg = None
+                wh.current_task = successor.spec.task_id
+                if wh.state == "blocked":
+                    # The blocked head finished; the successor runs unblocked.
+                    wh.state = "busy"
+            else:
+                self._release_task_resources(rec)
+                if wh.actor_id is None:
+                    wh.state = "idle"
+                    wh.current_task = None
+                    self._drop_lease(wh)
+                    node = self.nodes.get(wh.node_id)
+                    if node is not None and wh.worker_id not in node.idle and node.alive:
+                        node.idle.append(wh.worker_id)
 
     def _on_actor_created(self, ar: ActorRecord, ok: bool, metas: List[ObjectMeta]):
         info = self.gcs.actors.get(ar.actor_id)
@@ -1838,11 +2094,26 @@ class Scheduler:
         if rec is None:
             return False
         if rec.state == "PENDING":
-            if rec in self.pending:
-                self.pending.remove(rec)
+            self.pending.remove(rec)
             self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
             rec.state = "CANCELLED"
             return True
+        if rec.state == "RUNNING" and rec.spec.actor_id is None:
+            # Pipelined-but-not-started (queued behind a leased worker's
+            # current task): cancel cleanly without touching the worker's
+            # running task — tell the worker to skip it when popped.
+            node = self.nodes.get(rec.node)
+            wh = node.workers.get(rec.worker) if node else None
+            if (
+                wh is not None
+                and wh.current_task != task_id
+                and task_id in wh.inflight_tasks
+            ):
+                wh.inflight_tasks.remove(task_id)
+                wh.send(("cancel_queued", task_id.binary()))
+                self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
+                rec.state = "CANCELLED"
+                return True
         if rec.state == "RUNNING" and force and rec.spec.actor_id is None:
             node = self.nodes.get(rec.node)
             wh = node.workers.get(rec.worker) if node else None
@@ -1894,7 +2165,7 @@ class Scheduler:
         GCS monitor endpoint the reference autoscaler polls,
         `gcs/gcs_server/gcs_monitor_server.h` / `load_metrics.py`)."""
         now = time.time()
-        pending = [dict(rec.spec.resources) for rec in self.pending if rec.state == "PENDING"]
+        pending = [dict(rec.spec.resources) for rec in self.pending.records() if rec.state == "PENDING"]
         pending_bundles = [
             dict(b.resources)
             for pg in self.pending_pgs
@@ -2372,7 +2643,7 @@ class Scheduler:
             # AFTER all dep additions, so GC's per-dep decrement is symmetric.
             for d in rec.dep_ids:
                 self.lineage_consumers[d] = self.lineage_consumers.get(d, 0) + 1
-        self.pending.append(rec)
+        self.pending.push(rec)
 
     def _submit_actor_task(self, req: ExecRequest, owner: Optional[str] = None):
         from ray_tpu.exceptions import RayActorError
@@ -2632,16 +2903,36 @@ class Scheduler:
         self._try_schedule_pgs()
         if not self.pending:
             return
-        # Swap the queue out first: death handlers invoked from _try_dispatch may
-        # legitimately append (retries, actor restarts) — those must land in the
-        # live queue, not be lost when we reassign it.
-        snapshot = self.pending
-        self.pending = []
-        for rec in snapshot:
-            if rec.state != "PENDING":
-                continue  # cancelled or already failed while queued
-            if not self._try_dispatch(rec):
-                self.pending.append(rec)
+        # Coalesce this pass's dispatches into one message per worker.
+        self._exec_buffer = {}
+        try:
+            self._schedule_classes()
+        finally:
+            self._flush_exec_buffer()
+
+    def _schedule_classes(self):
+        # Per dispatch class: drain head-first until the first resource
+        # failure (same key => same feasibility), so a wakeup costs
+        # O(classes + dispatched), not O(pending). Dep-unresolved records
+        # park; the object-ready callback re-queues them.
+        for key in self.pending.classes():
+            while True:
+                rec = self.pending.head(key)
+                if rec is None:
+                    break
+                if rec.state != "PENDING":
+                    self.pending.pop_head(key)
+                    continue  # cancelled or already failed while queued
+                if self._try_dispatch(rec):
+                    self.pending.pop_head(key)
+                    continue
+                self.pending.pop_head(key)
+                if rec.unresolved:
+                    self.pending.park(rec)
+                    continue  # a waiting head must not block its class
+                # Resource/worker failure: whole class waits for capacity.
+                self.pending.push(rec, front=True)
+                break
 
     def _pick_node(self, rec: TaskRecord) -> Optional[NodeState]:
         """Hybrid policy: prefer the first (head) node until its utilization crosses
@@ -2745,7 +3036,10 @@ class Scheduler:
                     remaining["n"] -= 1
                     if remaining["n"] == 0:
                         rec.unresolved = 0
-                        # task is still in self.pending; next pass dispatches
+                        # Back into the class queue (the record parked when
+                        # its deps were missing); next pass dispatches.
+                        if self.pending.unpark(rec):
+                            self.pending.push(rec)
 
                 for i in set(missing):
                     self.object_waiters.setdefault(i, []).append(on_ready)
@@ -2771,10 +3065,10 @@ class Scheduler:
         # 2) actor creation: dedicated worker + resources
         if rec.spec.is_actor_creation:
             return self._try_dispatch_actor_creation(rec, metas, kw)
-        # 3) node + resources
+        # 3) node + resources — or pipeline onto an existing class lease.
         node = self._pick_node(rec)
         if node is None:
-            return False
+            return self._try_pipeline(rec, metas, kw)
         # 4) worker — idle reuse is per runtime-env hash (plain tasks reuse
         # plain workers; pip/working_dir tasks get/reuse provisioned workers).
         from ray_tpu._private.runtime_env import env_hash as _renv_hash
@@ -2815,7 +3109,7 @@ class Scheduler:
                         victim = cand
                         break
                 if victim is None:
-                    return False
+                    return self._try_pipeline(rec, metas, kw)
                 try:
                     victim.process.terminate()
                 except Exception:
@@ -2837,7 +3131,14 @@ class Scheduler:
         node.last_active = time.time()
         wh.state = "busy"
         wh.current_task = rec.spec.task_id
+        wh.lease_key = _PendingQueue.key_of(rec)
+        wh.inflight_tasks = [rec.spec.task_id]
+        self._leases.setdefault(wh.lease_key, []).append(wh)
         self._record_event(rec.spec, "RUNNING")
+        self._send_exec(wh, rec, metas, kw)
+        return True
+
+    def _send_exec(self, wh: WorkerHandle, rec: TaskRecord, metas, kw) -> None:
         req = ExecRequest(
             spec=rec.spec,
             arg_metas=metas,
@@ -2848,11 +3149,68 @@ class Scheduler:
         if rec.spec.func.function_id not in wh.known_functions:
             req.func_blob = self.gcs.function_table.get(rec.spec.func.function_id, rec.func_blob)
             wh.known_functions.add(rec.spec.func.function_id)
+        if self._exec_buffer is not None:
+            # Inside a _schedule pass: coalesce this wakeup's dispatches into
+            # one message per worker (flushed in _flush_exec_buffer).
+            self._exec_buffer.setdefault(wh.worker_id, (wh, []))[1].append(req)
+            return
         if not wh.send(("exec", req)):
             # Death handling retries or seals an error for this record itself;
-            # return True so the caller does not also re-queue it.
+            # the caller must not also re-queue it.
             self._on_worker_death(wh)
-        return True
+
+    def _flush_exec_buffer(self) -> None:
+        buffer, self._exec_buffer = self._exec_buffer, None
+        for wh, reqs in buffer.values():
+            msg = ("exec", reqs[0]) if len(reqs) == 1 else ("exec_batch", reqs)
+            if not wh.send(msg):
+                self._on_worker_death(wh)
+
+    def _drop_lease(self, wh: WorkerHandle) -> None:
+        if wh.lease_key is not None:
+            lst = self._leases.get(wh.lease_key)
+            if lst is not None:
+                try:
+                    lst.remove(wh)
+                except ValueError:
+                    pass
+                if not lst:
+                    self._leases.pop(wh.lease_key, None)
+            wh.lease_key = None
+        wh.inflight_tasks = []
+
+    def _try_pipeline(self, rec: TaskRecord, metas, kw) -> bool:
+        """Queue a resource-starved task onto a busy worker already leased to
+        its dispatch class (reference: lease reuse + pipelined pushes,
+        `direct_task_transport.h:75`). Called from _try_dispatch after node
+        pick / worker-pool admission failed — dependencies are resolved and
+        error-free, and `metas`/`kw` are the arg metas it already built."""
+        spec = rec.spec
+        if spec.is_actor_creation:
+            return False
+        if spec.scheduling_strategy == "SPREAD":
+            return False  # concentrating on one worker defeats SPREAD
+        depth = self.config.worker_pipeline_depth
+        if depth <= 1:
+            return False
+        for wh in self._leases.get(_PendingQueue.key_of(rec), ()):
+            if wh.state != "busy" or len(wh.inflight_tasks) >= depth:
+                continue
+            # The running head of the lease holds the resources; accounting
+            # transfers on its completion (_on_task_done).
+            rec.acquired = {}
+            rec.acquired_pg = None
+            rec.state = "RUNNING"
+            rec.worker = wh.worker_id
+            rec.node = wh.node_id
+            wh.inflight_tasks.append(spec.task_id)
+            node = self.nodes.get(wh.node_id)
+            if node is not None:
+                node.last_active = time.time()
+            self._record_event(spec, "RUNNING")
+            self._send_exec(wh, rec, metas, kw)
+            return True
+        return False
 
     def _try_dispatch_actor_creation(self, rec: TaskRecord, metas, kw) -> bool:
         ar = self.actors.get(rec.spec.actor_id)

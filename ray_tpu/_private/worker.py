@@ -379,10 +379,12 @@ class DriverContext:
         self.scheduler = scheduler
 
     def submit(self, rec: TaskRecord):
-        self.scheduler.call("submit", rec).result()
+        # Fire-and-forget: pipelined `.remote()` bursts drain in one scheduler
+        # wakeup. Errors surface through the return refs, never the submit.
+        self.scheduler.call_nowait("submit", rec)
 
     def submit_actor_task(self, req: ExecRequest):
-        self.scheduler.call("submit_actor_task", req).result()
+        self.scheduler.call_nowait("submit_actor_task", req)
 
     def create_actor(self, payload):
         self.scheduler.call("create_actor", payload).result()
@@ -557,10 +559,11 @@ class RemoteDriverContext:
 
     # --- core ops (worker-style req/resp) ---
     def submit(self, rec):
-        self.wc.request("submit", rec)
+        # One-way: no ack round trip per pipelined submission.
+        self.wc.send(("cmd", "submit", rec))
 
     def submit_actor_task(self, req: ExecRequest):
-        self.wc.request("submit_actor_task", req)
+        self.wc.send(("cmd", "submit_actor_task", req))
 
     def create_actor(self, payload):
         self.wc.request("create_actor", payload)
@@ -691,10 +694,11 @@ class WorkerProcContext:
         self.rt = runtime  # worker_main.WorkerRuntime
 
     def submit(self, rec: TaskRecord):
-        self.rt.wc.request("submit", rec)
+        # One-way: nested submissions from tasks pipeline without acks.
+        self.rt.wc.send(("cmd", "submit", rec))
 
     def submit_actor_task(self, req: ExecRequest):
-        self.rt.wc.request("submit_actor_task", req)
+        self.rt.wc.send(("cmd", "submit_actor_task", req))
 
     def create_actor(self, payload):
         self.rt.wc.request("create_actor", payload)
@@ -807,10 +811,10 @@ def _connect_worker_process(runtime):
 
     orig_execute = wm._execute
 
-    def tracking_execute(rt, req):
+    def tracking_execute(rt, req, *args, **kwargs):
         global_worker.current_task_id = req.spec.task_id
         try:
-            orig_execute(rt, req)
+            orig_execute(rt, req, *args, **kwargs)
         finally:
             global_worker.current_task_id = None
 

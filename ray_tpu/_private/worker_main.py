@@ -18,7 +18,7 @@ import sys
 import threading
 import traceback
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import Config, set_config
@@ -57,6 +57,14 @@ class WorkerConnection:
         self._pending: Dict[int, "queue.SimpleQueue"] = {}
         self.task_queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._closed = threading.Event()
+        # Task ids the scheduler cancelled while they were lease-queued here:
+        # the dispatch loop drops them unrun (the scheduler already sealed
+        # their results; no "done" is expected).
+        self.cancelled: set = set()
+        # Batched "done" payloads from the serial dispatch loop: flushed when
+        # the local queue drains, so a pipelined burst pays one send per
+        # batch instead of per task.
+        self._done_buffer: List[tuple] = []
         # Hook for message kinds beyond exec/resp/shutdown (e.g. a client-mode
         # driver serving "read_object" pulls for objects it put).
         self.misc_handler = None
@@ -69,6 +77,28 @@ class WorkerConnection:
     def send(self, msg) -> None:
         with self._send_lock:
             self.conn.send_bytes(serialization.dumps(msg))
+
+    def send_done(self, payload: tuple, batch: bool = False) -> None:
+        """Send (or buffer) one task-completion payload. Completion order
+        must reach the scheduler in execution order (lease accounting
+        transfers on each done), so an immediate send always flushes the
+        buffer first."""
+        if batch:
+            self._done_buffer.append(payload)
+            if len(self._done_buffer) >= 32:
+                self.flush_dones()
+            return
+        self.flush_dones()
+        self.send(("done",) + payload)
+
+    def flush_dones(self) -> None:
+        buf, self._done_buffer = self._done_buffer, []
+        if not buf:
+            return
+        if len(buf) == 1:
+            self.send(("done",) + buf[0])
+        else:
+            self.send(("done_batch", buf))
 
     def request(self, method: str, payload: Any, timeout: float | None = None) -> Any:
         """Blocking control-plane RPC to the driver (e.g. get/wait/submit)."""
@@ -96,12 +126,17 @@ class WorkerConnection:
                 kind = msg[0]
                 if kind == "exec":
                     self.task_queue.put(msg[1])
+                elif kind == "exec_batch":
+                    for req in msg[1]:
+                        self.task_queue.put(req)
                 elif kind == "resp":
                     _, req_id, ok, payload = msg
                     with self._req_lock:
                         q = self._pending.pop(req_id, None)
                     if q is not None:
                         q.put((ok, payload))
+                elif kind == "cancel_queued":
+                    self.cancelled.add(msg[1])
                 elif kind == "shutdown":
                     self.task_queue.put(None)
                     return
@@ -286,7 +321,7 @@ def _run_generator(rt: WorkerRuntime, req: ExecRequest, out, progress: Dict[byte
     return item_oids
 
 
-def _execute(rt: WorkerRuntime, req: ExecRequest):
+def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
     from ray_tpu import exceptions
     from ray_tpu._private import worker as worker_mod
 
@@ -375,7 +410,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
         # registration this task made reaches the scheduler before its
         # dependency pins are released.
         worker_mod.flush_ref_ops()
-        rt.wc.send(("done", spec.task_id.binary(), True, metas))
+        rt.wc.send_done((spec.task_id.binary(), True, metas), batch=batch_done)
     except Exception as e:  # noqa: BLE001 — every task error must be captured
         if exec_span is not None:
             from ray_tpu.util import tracing
@@ -413,7 +448,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest):
                 meta.is_error = True
                 metas.append(meta)
         worker_mod.flush_ref_ops()
-        rt.wc.send(("done", spec.task_id.binary(), False, metas))
+        rt.wc.send_done((spec.task_id.binary(), False, metas), batch=batch_done)
     finally:
         if exec_span is not None:
             from ray_tpu.util import tracing
@@ -452,9 +487,20 @@ def worker_loop(conn, args: WorkerArgs):
             rt.setup_error = e
     wc.send(("register", args.worker_id_hex, os.getpid()))
     while True:
+        # Flush batched completions on EVERY pass with an empty queue — a
+        # skipped (cancelled) task or any other continue-path must never
+        # leave a buffered done stranded while the loop blocks in get().
+        if wc.task_queue.empty():
+            wc.flush_dones()
         req = wc.task_queue.get()
         if req is None:
+            wc.flush_dones()
             break
+        if req.spec.task_id.binary() in wc.cancelled:
+            # Cancelled while lease-queued: the scheduler already sealed the
+            # result; drop without executing or replying.
+            wc.cancelled.discard(req.spec.task_id.binary())
+            continue
         if (
             rt.concurrency > 1
             and req.spec.actor_id is not None
@@ -466,6 +512,9 @@ def worker_loop(conn, args: WorkerArgs):
             # methods; __ray_terminate__ stays on the dispatch loop).
             rt.submit_call(lambda r=req: _execute(rt, r))
         else:
-            _execute(rt, req)
+            # Serial dispatch: batch completion messages while more work is
+            # queued locally (lease pipelining; flushed at loop top when the
+            # queue drains).
+            _execute(rt, req, batch_done=True)
     rt.store.detach_all()
     sys.exit(0)

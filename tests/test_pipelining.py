@@ -1,0 +1,138 @@
+"""Lease-pipelined submission tests (reference: pipelined pushes to leased
+workers, `core_worker/transport/direct_task_transport.h:75`; VERDICT r3 #5).
+
+The scheduler queues same-class tasks onto busy leased workers once node
+resources saturate; completion transfers the lease accounting to the next
+queued task. These tests pin the correctness properties of that path:
+results, cancellation of queued tasks, nested-task liveness, and worker-death
+retry of the whole in-flight window.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_burst_larger_than_pool_completes(ray_start_regular):
+    """A burst far beyond CPU slots pipelines onto leased workers and every
+    result is correct (no drops, no duplicates)."""
+
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    out = ray_tpu.get([sq.remote(i) for i in range(300)], timeout=120)
+    assert out == [i * i for i in range(300)]
+
+
+def test_pipelined_queue_preserves_fifo_per_worker(ray_start_regular):
+    """Tasks queued on one leased worker run in submission order."""
+
+    @ray_tpu.remote
+    def stamp(i):
+        import os
+        import time
+
+        return (i, os.getpid(), time.perf_counter())
+
+    rows = ray_tpu.get([stamp.remote(i) for i in range(60)], timeout=120)
+    by_pid = {}
+    for i, pid, t in rows:
+        by_pid.setdefault(pid, []).append((t, i))
+    for pid, entries in by_pid.items():
+        entries.sort()
+        indices = [i for _, i in entries]
+        assert indices == sorted(indices), f"worker {pid} ran out of order"
+
+
+def test_cancel_task_queued_on_leased_worker(ray_start_regular):
+    """Cancelling a pipelined-but-not-started task seals TaskCancelledError
+    without killing the worker or its running task."""
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(1.2)
+        return "done"
+
+    @ray_tpu.remote
+    def quick():
+        return "ran"
+
+    # Fill every CPU slot with slow tasks, then pipeline extras behind them.
+    blockers = [slow.remote() for _ in range(4)]
+    queued = [quick.remote() for _ in range(8)]
+    time.sleep(0.3)  # let the extras land in worker queues
+    victim = queued[0]
+    ray_tpu.cancel(victim)  # returns None (reference semantics)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(victim, timeout=30)
+    # Everything else still completes on the same workers.
+    assert ray_tpu.get(blockers, timeout=60) == ["done"] * 4
+    assert ray_tpu.get(queued[1:], timeout=60) == ["ran"] * 7
+
+
+def test_nested_submission_no_deadlock(ray_start_regular):
+    """A running task that blocks on its own child while siblings are queued
+    behind it must not deadlock (blocked-worker CPU release + spawn)."""
+
+    @ray_tpu.remote
+    def child(i):
+        return i + 1
+
+    @ray_tpu.remote
+    def parent(i):
+        return ray_tpu.get(child.remote(i))
+
+    out = ray_tpu.get([parent.remote(i) for i in range(12)], timeout=120)
+    assert out == [i + 1 for i in range(12)]
+
+
+def test_worker_death_retries_whole_pipeline_window(ray_start_regular):
+    """Killing a worker fails/retries every task in its in-flight window —
+    the running head AND the lease-queued tasks behind it."""
+
+    # One poison task + enough friends to share its worker queue; the poison
+    # kills the worker only on its first attempt (flag file).
+    import tempfile
+
+    flag = tempfile.mktemp(prefix="pipew_")
+
+    @ray_tpu.remote(max_retries=2)
+    def poison_once(path):
+        import os
+        import time
+
+        if not os.path.exists(path):
+            with open(path, "w") as fh:
+                fh.write("x")
+            time.sleep(0.4)
+            os._exit(1)
+        return "recovered"
+
+    @ray_tpu.remote(max_retries=2)
+    def friendly(i):
+        import time
+
+        time.sleep(0.05)
+        return i
+
+    refs = [poison_once.remote(flag)] + [friendly.remote(i) for i in range(20)]
+    out = ray_tpu.get(refs, timeout=120)
+    assert out[0] == "recovered"
+    assert out[1:] == list(range(20))
+
+
+def test_task_ids_unique_under_burst(ray_start_regular):
+    """Batched-entropy id minting never repeats across a fast burst."""
+
+    @ray_tpu.remote
+    def tid():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().current_task_id.hex()
+
+    ids = ray_tpu.get([tid.remote() for _ in range(200)], timeout=120)
+    assert len(set(ids)) == 200
