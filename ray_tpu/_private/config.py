@@ -73,6 +73,17 @@ class Config:
     # Max stateless workers started per node beyond num_cpus (oversubscription to
     # break ray.get deadlocks, reference worker_pool prestart behaviour).
     maximum_startup_concurrency: int = 4
+    # Memory monitor (reference: memory_monitor.h + worker_killing_policy.h):
+    # kill a worker by policy when host/cgroup usage crosses the threshold.
+    # refresh_ms = 0 disables monitoring.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 500
+    # "retriable_fifo" | "retriable_lifo" | "group_by_owner"
+    worker_killing_policy: str = "retriable_fifo"
+    # Delay before re-queuing an OOM-killed retriable task (reference:
+    # task_oom_retry_delay_ms) — immediate redispatch under sustained
+    # pressure would burn every retry in under a second.
+    task_oom_retry_delay_ms: int = 1000
     # Max tasks in flight per leased stateless worker (1 = no pipelining).
     # When a dispatch class saturates the node, further same-class tasks
     # queue directly on the class's busy workers — the reference's

@@ -140,6 +140,15 @@ class NodeDaemon:
         if reply[0] != "ok":
             raise RuntimeError(f"head rejected daemon registration: {reply!r}")
         self.node_id_hex = reply[1]
+        # Monitor settings pushed by the head (its config governs — this
+        # process never saw the driver's _system_config).
+        monitor = reply[2] if len(reply) > 2 else {}
+        self.memory_usage_threshold = float(
+            monitor.get("memory_usage_threshold", 0.95)
+        )
+        self.memory_monitor_refresh_ms = int(
+            monitor.get("memory_monitor_refresh_ms", 500)
+        )
 
     def _send(self, msg) -> bool:
         with self._lock:
@@ -215,7 +224,10 @@ class NodeDaemon:
     # ------------------------------------------------------------------ loops
     def _reaper_loop(self):
         """Report dead worker processes to the head (the raylet's worker-death
-        notification path)."""
+        notification path), and this host's memory pressure (the memory
+        monitor's per-node sampling — the kill DECISION runs in the head's
+        scheduler, which knows tasks and retry budgets)."""
+        last_mem = 0.0
         while not self._stop.is_set():
             dead = []
             with self._lock:
@@ -225,6 +237,19 @@ class NodeDaemon:
                         del self.procs[wid]
             for wid in dead:
                 self._send(("worker_exit", wid))
+            refresh_ms = getattr(self, "memory_monitor_refresh_ms", 500)
+            now = time.time()
+            if refresh_ms > 0 and now - last_mem >= max(refresh_ms, 100) / 1000.0:
+                last_mem = now
+                from ray_tpu._private.memory_monitor import get_memory_snapshot
+
+                snap = get_memory_snapshot()
+                if snap.used_fraction >= getattr(
+                    self, "memory_usage_threshold", 0.95
+                ):
+                    self._send(
+                        ("memory_pressure", snap.used_bytes, snap.total_bytes)
+                    )
             time.sleep(0.2)
 
     def serve(self):

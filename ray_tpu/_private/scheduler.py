@@ -264,6 +264,13 @@ class TaskRecord:
     # Cached dispatch-class key (see _PendingQueue): tasks with equal keys
     # have identical feasibility, so one failed dispatch parks the class.
     dispatch_key: Optional[tuple] = None
+    # Memory-monitor bookkeeping: when this task started running, the holder
+    # that submitted it (group-by-owner policy), and whether its worker was
+    # OOM-killed (error type selection on death).
+    running_since: float = 0.0
+    owner: str = ""
+    oom_killed: bool = False
+    oom_detail: str = ""  # human context, e.g. " (node at 97% of 4096MB)" 
 
 
 class _PendingQueue:
@@ -475,6 +482,9 @@ class Scheduler:
         # dispatch-class key -> leased workers (kept in sync by dispatch /
         # idle / death transitions): O(1) pipeline-candidate lookup.
         self._leases: Dict[tuple, List[WorkerHandle]] = {}
+        self._last_memory_check = 0.0
+        # (when, rec) pairs re-queued after a delay (OOM retry backoff).
+        self._delayed_retries: List[Tuple[float, TaskRecord]] = []
         self._conn_to_worker: Dict[Any, WorkerHandle] = {}
         self._conn_to_daemon: Dict[Any, DaemonHandle] = {}
         self._conn_to_driver: Dict[Any, DriverHandle] = {}
@@ -601,7 +611,16 @@ class Scheduler:
         self.node_order.append(node_id)
         self._conn_to_daemon[conn] = daemon
         self._pull_sources[node_id.binary()] = daemon
-        daemon.send(("ok", node_id.hex()))
+        daemon.send(
+            (
+                "ok",
+                node_id.hex(),
+                {
+                    "memory_usage_threshold": self.config.memory_usage_threshold,
+                    "memory_monitor_refresh_ms": self.config.memory_monitor_refresh_ms,
+                },
+            )
+        )
         return node_id
 
     def _cmd_attach_driver(self, payload):
@@ -756,6 +775,18 @@ class Scheduler:
                     for wh in list(node.workers.values()):
                         if not wh.process.is_alive() and wh.conn is None:
                             self._on_worker_death(wh)
+            # Self-gated by memory_monitor_refresh_ms (NOT the 0.5s health
+            # gate — sub-500ms refresh settings must be honored).
+            self._memory_monitor_tick(now)
+            if self._delayed_retries:
+                due = [x for x in self._delayed_retries if x[0] <= now]
+                if due:
+                    self._delayed_retries = [
+                        x for x in self._delayed_retries if x[0] > now
+                    ]
+                    for _, rec in due:
+                        if rec.state == "PENDING":
+                            self.pending.push(rec)
             for obj in ready:
                 if obj is self._wake_r:
                     # Drain + clear atomically vs _wake's set + send: after
@@ -853,6 +884,22 @@ class Scheduler:
         elif kind == "object_data":
             _, token, ok, data = msg
             self._finish_pull(token, ok, data)
+        elif kind == "memory_pressure":
+            from ray_tpu._private.memory_monitor import MemorySnapshot
+
+            snap = MemorySnapshot(msg[1], msg[2])
+            # The head's config governs (daemons sample with the thresholds
+            # pushed at registration, but re-check here so init-time
+            # disabling always wins).
+            if (
+                self.config.memory_monitor_refresh_ms > 0
+                and snap.used_fraction >= self.config.memory_usage_threshold
+            ):
+                node = next(
+                    (n for n in self.nodes.values() if n.daemon is daemon), None
+                )
+                if node is not None and node.alive:
+                    self._oom_kill_one([node], snap)
         elif kind == "heartbeat":
             pass
 
@@ -1090,16 +1137,109 @@ class Scheduler:
             rec.retries_left -= 1
             rec.state = "PENDING"
             rec.worker = None
-            self.pending.push(rec)
             self._record_event(rec.spec, "RETRY")
+            if rec.oom_killed:
+                # Back off before re-queuing (task_oom_retry_delay_ms): an
+                # immediate redispatch under sustained pressure would be
+                # re-killed on the next tick, burning every retry at once.
+                rec.oom_killed = False
+                delay = self.config.task_oom_retry_delay_ms / 1000.0
+                self._delayed_retries.append((time.time() + delay, rec))
+            else:
+                self.pending.push(rec)
         else:
-            from ray_tpu.exceptions import WorkerCrashedError
+            from ray_tpu.exceptions import OutOfMemoryError, WorkerCrashedError
 
-            err = WorkerCrashedError(
-                f"Worker running task {rec.spec.name or rec.spec.func.name} died "
-                "unexpectedly (no retries left)."
-            )
+            name = rec.spec.name or rec.spec.func.name
+            if rec.oom_killed:
+                err: Exception = OutOfMemoryError(
+                    f"Task {name} was killed by the memory monitor"
+                    f"{rec.oom_detail} (no retries left)."
+                )
+            else:
+                err = WorkerCrashedError(
+                    f"Worker running task {name} died "
+                    "unexpectedly (no retries left)."
+                )
             self._store_error_results(rec, err)
+
+    # -------------------------------------------------------------- OOM killer
+    def _memory_monitor_tick(self, now: float) -> None:
+        """Sample host/cgroup usage; above the threshold, kill one worker by
+        the configured policy (reference: MemoryMonitor callback ->
+        WorkerKillingPolicy). Daemon-managed nodes sample their own hosts and
+        report pressure via ("memory_pressure", used, total)."""
+        if self.config.memory_monitor_refresh_ms <= 0:
+            return
+        if now - self._last_memory_check < self.config.memory_monitor_refresh_ms / 1000.0:
+            return
+        self._last_memory_check = now
+        from ray_tpu._private import memory_monitor as mm
+
+        snap = mm.get_memory_snapshot()
+        if snap.used_fraction < self.config.memory_usage_threshold:
+            return
+        # Local tick covers locally-spawned workers; daemon nodes are killed
+        # on their own pressure reports.
+        nodes = [n for n in self.nodes.values() if n.alive and n.daemon is None]
+        self._oom_kill_one(nodes, snap)
+
+    def _oom_kill_one(self, nodes: List["NodeState"], snap) -> None:
+        from ray_tpu._private import memory_monitor as mm
+
+        candidates = []
+        for node in nodes:
+            for wh in node.workers.values():
+                if wh.actor_id is not None or wh.current_task is None:
+                    continue
+                rec = self.tasks.get(wh.current_task)
+                if rec is None or rec.state != "RUNNING":
+                    continue
+                candidates.append(
+                    mm.KillCandidate(
+                        worker_key=wh,
+                        retriable=rec.retries_left > 0,
+                        started_at=rec.running_since,
+                        owner=rec.owner,
+                    )
+                )
+        victim = mm.select_worker_to_kill(
+            candidates, self.config.worker_killing_policy
+        )
+        if victim is None:
+            return
+        wh = victim.worker_key
+        detail = (
+            f" (node at {snap.used_fraction:.0%} of "
+            f"{snap.total_bytes >> 20}MB, policy "
+            f"{self.config.worker_killing_policy})"
+        )
+        # Tag every task in the worker's in-flight window so the death
+        # handler raises OutOfMemoryError (retriable) instead of a crash.
+        for tid in wh.inflight_tasks or (
+            [wh.current_task] if wh.current_task else []
+        ):
+            rec = self.tasks.get(tid)
+            if rec is not None:
+                rec.oom_killed = True
+                rec.oom_detail = detail
+        # The process dies asynchronously (EOF/exit notification lags the
+        # terminate by up to a health-check period): take the worker OUT of
+        # scheduling NOW or fresh tasks pipeline onto the corpse and die as
+        # collateral. Keep inflight_tasks — the death handler fails/retries
+        # exactly that window.
+        self._remove_from_lease_index(wh)
+        wh.lease_key = None
+        wh.state = "dying"
+        node = self.nodes.get(wh.node_id)
+        if node is not None and wh.worker_id in node.idle:
+            node.idle.remove(wh.worker_id)
+        try:
+            wh.process.terminate()
+        except Exception:
+            pass
+        # Local processes reap via conn EOF / liveness check; daemon workers
+        # via the daemon's worker_exit notification.
 
     def _handle_actor_worker_death(self, wh: WorkerHandle):
         from ray_tpu.exceptions import RayActorError
@@ -1184,14 +1324,17 @@ class Scheduler:
             if req_id is None:
                 # One-way submit: surface the failure through the task's
                 # return refs (nobody is waiting on an ack).
-                self._seal_submit_failure(payload, e)
+                self._seal_submit_failure(payload, e, holder=self._holder_of(wh))
             else:
                 self._respond(wh, req_id, False, e)
 
-    def _seal_submit_failure(self, payload, err: Exception) -> None:
+    def _seal_submit_failure(self, payload, err: Exception,
+                             holder: Optional[str] = None) -> None:
         """A fire-and-forget submit's handler raised: seal the error into the
         payload's return refs so the caller's get() raises instead of
-        hanging. Payloads without return refs just log."""
+        hanging. `holder` is the actual submitter (holder sets are
+        idempotent, so re-registering after a partial handler is safe).
+        Payloads without return refs just log."""
         import traceback
 
         traceback.print_exc()
@@ -1208,7 +1351,9 @@ class Scheduler:
             )
         if rec is not None and rec.return_ids:
             try:
-                self._register_return_holders(rec.return_ids, self._INPROC_DRIVER)
+                self._register_return_holders(
+                    rec.return_ids, holder or self._INPROC_DRIVER
+                )
                 self._store_error_results(rec, err)
             except Exception:
                 traceback.print_exc()
@@ -1843,6 +1988,7 @@ class Scheduler:
     # ------------------------------------------------------------------ commands (driver API)
     def _cmd_submit(self, payload):
         rec: TaskRecord = payload
+        rec.owner = self._INPROC_DRIVER
         self._register_return_holders(rec.return_ids, self._INPROC_DRIVER)
         if rec.spec.returns_mode is not None:
             rec.stream_owner = self._INPROC_DRIVER
@@ -2227,6 +2373,7 @@ class Scheduler:
     # ------------------------------------------------------------------ worker requests
     def _req_submit(self, wh: WorkerHandle, req_id: int, payload):
         rec: TaskRecord = payload
+        rec.owner = self._holder_of(wh)
         if rec.func_blob is not None:
             self.gcs.function_table.setdefault(rec.spec.func.function_id, rec.func_blob)
         self._register_return_holders(rec.return_ids, self._holder_of(wh))
@@ -3126,6 +3273,7 @@ class Scheduler:
             _acquire(node.available, rec.spec.resources)
         rec.acquired = dict(rec.spec.resources)
         rec.state = "RUNNING"
+        rec.running_since = time.time()
         rec.worker = wh.worker_id
         rec.node = node.node_id
         node.last_active = time.time()
@@ -3166,7 +3314,7 @@ class Scheduler:
             if not wh.send(msg):
                 self._on_worker_death(wh)
 
-    def _drop_lease(self, wh: WorkerHandle) -> None:
+    def _remove_from_lease_index(self, wh: WorkerHandle) -> None:
         if wh.lease_key is not None:
             lst = self._leases.get(wh.lease_key)
             if lst is not None:
@@ -3176,7 +3324,10 @@ class Scheduler:
                     pass
                 if not lst:
                     self._leases.pop(wh.lease_key, None)
-            wh.lease_key = None
+
+    def _drop_lease(self, wh: WorkerHandle) -> None:
+        self._remove_from_lease_index(wh)
+        wh.lease_key = None
         wh.inflight_tasks = []
 
     def _try_pipeline(self, rec: TaskRecord, metas, kw) -> bool:
@@ -3201,6 +3352,7 @@ class Scheduler:
             rec.acquired = {}
             rec.acquired_pg = None
             rec.state = "RUNNING"
+            rec.running_since = time.time()
             rec.worker = wh.worker_id
             rec.node = wh.node_id
             wh.inflight_tasks.append(spec.task_id)

@@ -64,6 +64,13 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The worker was killed by the memory monitor's OOM policy (reference:
+    `ray.exceptions.OutOfMemoryError` raised by the raylet's worker-killing
+    path, `src/ray/raylet/worker_killing_policy.h`). Retriable: the task is
+    resubmitted while retries remain."""
+
+
 class RayActorError(RayTpuError):
     """The actor died before or during this method call."""
 

@@ -1,0 +1,160 @@
+"""Memory monitor + OOM worker-killing tests (reference:
+`src/ray/common/memory_monitor.h`, `raylet/worker_killing_policy.h`,
+`python/ray/tests/test_memory_pressure.py`; VERDICT r3 ask #6).
+
+Pressure is injected through the RAY_TPU_FAKE_MEMORY_USAGE_FILE seam so the
+chaos path is deterministic and never risks the host.
+"""
+
+import os
+import time
+
+import pytest
+
+
+def _set_usage(path, text):
+    """Atomic replace: a torn read must never fabricate pressure."""
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import (
+    KillCandidate,
+    MemorySnapshot,
+    get_memory_snapshot,
+    process_rss_bytes,
+    select_worker_to_kill,
+)
+
+
+# ------------------------------------------------------------------ sampling
+def test_real_snapshot_sane():
+    snap = get_memory_snapshot()
+    assert snap.total_bytes > 0
+    assert 0 <= snap.used_bytes <= snap.total_bytes
+    assert 0.0 <= snap.used_fraction <= 1.0
+
+
+def test_fake_usage_file_overrides(tmp_path, monkeypatch):
+    fake = tmp_path / "mem"
+    fake.write_text("900 1000")
+    monkeypatch.setenv("RAY_TPU_FAKE_MEMORY_USAGE_FILE", str(fake))
+    snap = get_memory_snapshot()
+    assert (snap.used_bytes, snap.total_bytes) == (900, 1000)
+    assert snap.used_fraction == pytest.approx(0.9)
+
+
+def test_process_rss_self():
+    assert process_rss_bytes(os.getpid()) > 1024 * 1024  # >1MB for a python
+    assert process_rss_bytes(999999999) == 0
+
+
+# ------------------------------------------------------------------- policies
+def _cands():
+    return [
+        KillCandidate("w_old_retriable", True, 100.0, owner="a"),
+        KillCandidate("w_new_retriable", True, 300.0, owner="b"),
+        KillCandidate("w_old_final", False, 50.0, owner="a"),
+        KillCandidate("w_new_final", False, 400.0, owner="b"),
+    ]
+
+
+def test_policy_retriable_fifo_kills_oldest_retriable():
+    v = select_worker_to_kill(_cands(), "retriable_fifo")
+    assert v.worker_key == "w_old_retriable"
+
+
+def test_policy_retriable_lifo_kills_newest_retriable():
+    v = select_worker_to_kill(_cands(), "retriable_lifo")
+    assert v.worker_key == "w_new_retriable"
+
+
+def test_policy_falls_back_to_nonretriable():
+    only_final = [c for c in _cands() if not c.retriable]
+    assert select_worker_to_kill(only_final, "retriable_fifo").worker_key == "w_old_final"
+    assert select_worker_to_kill([], "retriable_fifo") is None
+
+
+def test_policy_group_by_owner_prefers_biggest_retriable_group():
+    cands = [
+        KillCandidate("a1", True, 1.0, owner="alice"),
+        KillCandidate("a2", True, 2.0, owner="alice"),
+        KillCandidate("a3", True, 3.0, owner="alice"),
+        KillCandidate("b1", True, 9.0, owner="bob"),
+        KillCandidate("c1", False, 9.9, owner="carol"),
+    ]
+    # alice's is the largest retriable group; her newest task dies.
+    assert select_worker_to_kill(cands, "group_by_owner").worker_key == "a3"
+
+
+def test_policy_unknown_raises():
+    with pytest.raises(ValueError, match="unknown"):
+        select_worker_to_kill(_cands(), "nope")
+
+
+# ---------------------------------------------------------------- chaos test
+def test_memory_hog_killed_retried_and_node_survives(tmp_path, monkeypatch):
+    """Under injected pressure the hog's worker is killed by policy, the task
+    retries once pressure clears, and unrelated work keeps flowing
+    (VERDICT done-criterion)."""
+    fake = tmp_path / "mem"
+    _set_usage(fake, "100 1000")  # calm
+    monkeypatch.setenv("RAY_TPU_FAKE_MEMORY_USAGE_FILE", str(fake))
+    monkeypatch.setenv("RAY_TPU_memory_monitor_refresh_ms", "100")
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote(max_retries=2)
+        def hog(path):
+            import time
+
+            # First attempt holds "memory" until killed; retries run calm.
+            time.sleep(8)
+            return "survived"
+
+        @ray_tpu.remote
+        def bystander(i):
+            return i
+
+        ref = hog.remote(str(fake))
+        time.sleep(1.0)  # hog is running
+        _set_usage(fake, "990 1000")  # pressure!
+        time.sleep(1.5)  # monitor tick kills the hog's worker
+        _set_usage(fake, "100 1000")  # calm again -> retry proceeds
+        # The node survives: other tasks complete while the hog retries.
+        assert ray_tpu.get(
+            [bystander.remote(i) for i in range(8)], timeout=60
+        ) == list(range(8))
+        # The retried hog eventually returns (its retry sleeps 8s calm).
+        assert ray_tpu.get(ref, timeout=60) == "survived"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_memory_hog_without_retries_raises_oom(tmp_path, monkeypatch):
+    fake = tmp_path / "mem"
+    _set_usage(fake, "100 1000")
+    monkeypatch.setenv("RAY_TPU_FAKE_MEMORY_USAGE_FILE", str(fake))
+    monkeypatch.setenv("RAY_TPU_memory_monitor_refresh_ms", "100")
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(max_retries=0)
+        def hog():
+            import time
+
+            time.sleep(15)
+            return "never"
+
+        ref = hog.remote()
+        time.sleep(1.0)
+        _set_usage(fake, "999 1000")
+        with pytest.raises(ray_tpu.exceptions.OutOfMemoryError):
+            ray_tpu.get(ref, timeout=30)
+        # OutOfMemoryError subclasses WorkerCrashedError (compat).
+        assert issubclass(
+            ray_tpu.exceptions.OutOfMemoryError,
+            ray_tpu.exceptions.WorkerCrashedError,
+        )
+    finally:
+        ray_tpu.shutdown()
