@@ -105,6 +105,8 @@ class Config:
     collective_timeout_s: float = 120.0
 
     # --- worker process ---
+    # Stream worker stdout/stderr to subscribed drivers (init(log_to_driver=)).
+    log_to_driver: bool = True
     worker_register_timeout_s: float = 60.0
     worker_nice: int = 0
 

@@ -24,13 +24,35 @@ from typing import List, Optional, Tuple
 FAKE_USAGE_ENV = "RAY_TPU_FAKE_MEMORY_USAGE_FILE"
 
 _CGROUP_PATHS = (
-    # (max/limit path, current-usage path) — v2 then v1, like the reference.
-    ("/sys/fs/cgroup/memory.max", "/sys/fs/cgroup/memory.current"),
+    # (limit, usage, stat file, inactive-file key) — v2 then v1, like the
+    # reference. Reclaimable page cache (inactive_file) is subtracted from
+    # usage: a streaming workload fills cache to the limit without real
+    # pressure, and counting it would shoot innocent workers.
+    (
+        "/sys/fs/cgroup/memory.max",
+        "/sys/fs/cgroup/memory.current",
+        "/sys/fs/cgroup/memory.stat",
+        "inactive_file",
+    ),
     (
         "/sys/fs/cgroup/memory/memory.limit_in_bytes",
         "/sys/fs/cgroup/memory/memory.usage_in_bytes",
+        "/sys/fs/cgroup/memory/memory.stat",
+        "total_inactive_file",
     ),
 )
+
+
+def _read_stat_key(path: str, key: str) -> int:
+    try:
+        with open(path) as fh:
+            for line in fh:
+                parts = line.split()
+                if len(parts) == 2 and parts[0] == key:
+                    return int(parts[1])
+    except (OSError, ValueError):
+        pass
+    return 0
 
 
 @dataclass
@@ -79,11 +101,12 @@ def get_memory_snapshot() -> MemorySnapshot:
         except (OSError, ValueError):
             pass  # fall through to real sampling
     used, total = _proc_meminfo()
-    for limit_path, usage_path in _CGROUP_PATHS:
+    for limit_path, usage_path, stat_path, inactive_key in _CGROUP_PATHS:
         limit = _read_int(limit_path)
         if limit is not None and 0 < limit < total:
             cg_used = _read_int(usage_path)
             if cg_used is not None:
+                cg_used = max(0, cg_used - _read_stat_key(stat_path, inactive_key))
                 return MemorySnapshot(cg_used, limit)
     return MemorySnapshot(used, total)
 

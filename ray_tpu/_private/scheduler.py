@@ -171,6 +171,8 @@ class WorkerHandle:
     # accounting transfers to the successor on completion).
     lease_key: Optional[tuple] = None
     inflight_tasks: List[TaskID] = field(default_factory=list)
+    # Why this worker is blocked ("dep" | "throttle"); see _mark_blocked.
+    blocked_kind: str = "dep"
 
     def send(self, msg) -> bool:
         data = serialization.dumps(msg)
@@ -485,6 +487,12 @@ class Scheduler:
         self._last_memory_check = 0.0
         # (when, rec) pairs re-queued after a delay (OOM retry backoff).
         self._delayed_retries: List[Tuple[float, TaskRecord]] = []
+        # Pubsub plane (reference: src/ray/pubsub/publisher.h — long-poll
+        # channels for logs/errors/locations; here channels push over the
+        # persistent driver conns): channel -> remote holder ids, and
+        # channel -> in-process callbacks (the in-proc driver's path).
+        self._subscriptions: Dict[str, set] = {}
+        self._inproc_subs: Dict[str, List[Callable]] = {}
         self._conn_to_worker: Dict[Any, WorkerHandle] = {}
         self._conn_to_daemon: Dict[Any, DaemonHandle] = {}
         self._conn_to_driver: Dict[Any, DriverHandle] = {}
@@ -659,8 +667,13 @@ class Scheduler:
                     wh.process.mark_dead()
             self._cmd_remove_node(daemon.node_id)
 
+    def _on_driver_death_cleanup_subs(self, dh: DriverHandle) -> None:
+        for holders in self._subscriptions.values():
+            holders.discard(dh.holder_id)
+
     def _on_driver_death(self, dh: DriverHandle):
         self._conn_to_driver.pop(dh.conn, None)
+        self._on_driver_death_cleanup_subs(dh)
         if dh.pull_node_id:
             self._pull_sources.pop(dh.pull_node_id, None)
             self._fail_pulls_from(dh.pull_node_id)
@@ -1034,6 +1047,7 @@ class Scheduler:
         envb = dict(os.environ)
         envb.update(env_vars or {})
         envb["RAY_TPU_AUTHKEY_HEX"] = self._authkey.hex()
+        envb["RAY_TPU_LOG_TO_DRIVER"] = "1" if self.config.log_to_driver else "0"
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         envb["PYTHONPATH"] = repo_root + os.pathsep + envb.get("PYTHONPATH", "")
         blob = base64.b64encode(pickle.dumps(args)).decode()
@@ -1162,6 +1176,12 @@ class Scheduler:
                     "unexpectedly (no retries left)."
                 )
             self._store_error_results(rec, err)
+            # Push to the errors channel too (reference: error messages reach
+            # the driver via GCS pubsub even before anyone get()s the ref).
+            self._publish(
+                "errors",
+                {"task": name, "message": str(err), "type": type(err).__name__},
+            )
 
     # -------------------------------------------------------------- OOM killer
     def _memory_monitor_tick(self, now: float) -> None:
@@ -1304,6 +1324,8 @@ class Scheduler:
         elif kind == "cmd":
             # One-way request (no ack): the pipelined submission path.
             self._on_worker_request(wh, None, msg[1], msg[2])
+        elif kind == "log":
+            self._on_worker_log(wh, msg)
         elif kind == "ref_ops":
             self._apply_ref_ops(msg[1], wh.worker_id.hex())
 
@@ -1357,6 +1379,48 @@ class Scheduler:
                 self._store_error_results(rec, err)
             except Exception:
                 traceback.print_exc()
+
+    # ------------------------------------------------------------------ pubsub
+    def _publish(self, channel: str, payload: dict) -> None:
+        """Deliver to every subscriber of `channel`: in-process callbacks
+        directly, remote drivers as a ("pub", channel, payload) push."""
+        for cb in self._inproc_subs.get(channel, ()):
+            try:
+                cb(payload)
+            except Exception:  # noqa: BLE001 — a bad printer must not kill the loop
+                pass
+        holders = self._subscriptions.get(channel)
+        if not holders:
+            return
+        for dh in list(self._conn_to_driver.values()):
+            if dh.holder_id in holders:
+                try:
+                    dh.send(("pub", channel, payload))
+                except (OSError, ValueError):
+                    pass
+
+    def _cmd_subscribe(self, payload):
+        channel, callback = payload
+        self._inproc_subs.setdefault(channel, []).append(callback)
+        return True
+
+    def _req_subscribe(self, wh, req_id: int, channel: str):
+        self._subscriptions.setdefault(channel, set()).add(self._holder_of(wh))
+        self._respond(wh, req_id, True, True)
+
+    def _on_worker_log(self, wh: WorkerHandle, msg) -> None:
+        _, worker_id_hex, pid, stream, task_name, lines = msg
+        self._publish(
+            "logs",
+            {
+                "worker_id": worker_id_hex,
+                "pid": pid,
+                "stream": stream,
+                "task": task_name,
+                "node_id": wh.node_id.hex(),
+                "lines": lines,
+            },
+        )
 
     def _on_task_done(self, wh: WorkerHandle, task_id: TaskID, ok: bool, metas: List[ObjectMeta]):
         rec = self.tasks.get(task_id)
@@ -1414,7 +1478,10 @@ class Scheduler:
                     wh.state = "busy"
             else:
                 self._release_task_resources(rec)
-                if wh.actor_id is None:
+                if wh.actor_id is None and wh.state != "dying":
+                    # Never re-idle a worker the OOM killer already
+                    # terminated — a late-buffered done must not put the
+                    # corpse back into dispatch rotation.
                     wh.state = "idle"
                     wh.current_task = None
                     self._drop_lease(wh)
@@ -1592,7 +1659,7 @@ class Scheduler:
         if rec.stream_requested >= threshold:
             self._respond(wh, req_id, True, "go")
             return
-        self._mark_blocked(wh)
+        self._mark_blocked(wh, kind="throttle")
 
         def respond(verdict):
             self._unmark_blocked(wh)
@@ -2710,17 +2777,42 @@ class Scheduler:
         for respond in waiters:
             respond(False, ObjectLostError(f"dependency unreconstructable: {err}"))
 
-    def _mark_blocked(self, wh: WorkerHandle):
+    def _mark_blocked(self, wh: WorkerHandle, kind: str = "dep"):
         """Release the CPU held by the task running on `wh` while it blocks in
         get/wait, so dependent tasks can run (prevents pool deadlock; mirrors the
-        reference's resource release on blocking `ray.get`)."""
+        reference's resource release on blocking `ray.get`).
+
+        kind="dep": blocked on work that may need a REPLACEMENT worker to
+        make progress (get/wait/stream-consume) — excluded from the pool cap.
+        kind="throttle": a generator paused by consumer backpressure — nothing
+        downstream needs a new worker, and excluding it would let a wide
+        throttled read fan-out spawn one replacement per paused producer
+        (a worker storm, each spawn ~1s on small hosts)."""
         if wh.state == "busy" and wh.current_task is not None:
             rec = self.tasks.get(wh.current_task)
             node = self.nodes.get(wh.node_id)
             if rec is not None and node is not None and rec.acquired.get("CPU"):
                 _release(node.available, {"CPU": rec.acquired["CPU"]})
                 rec.acquired["CPU"] = 0.0
-        wh.state = "blocked" if wh.state == "busy" else wh.state
+            # Evacuate lease-queued tasks: the head may be blocked on work
+            # that sits BEHIND it in this very queue (a child pipelined while
+            # the head was still running) — a self-deadlock no timeout
+            # breaks. Recall everything not yet started; the class queue
+            # re-places it on a live worker.
+            if len(wh.inflight_tasks) > 1:
+                queued, wh.inflight_tasks = wh.inflight_tasks[1:], wh.inflight_tasks[:1]
+                for tid in queued:
+                    wh.send(("cancel_queued", tid.binary()))
+                    qrec = self.tasks.get(tid)
+                    if qrec is not None and qrec.state == "RUNNING":
+                        qrec.state = "PENDING"
+                        qrec.worker = None
+                        qrec.node = None
+                        qrec.acquired = {}
+                        self.pending.push(qrec)
+        if wh.state == "busy":
+            wh.state = "blocked"
+            wh.blocked_kind = kind
 
     def _unmark_blocked(self, wh: WorkerHandle):
         if wh.state == "blocked":
@@ -3235,9 +3327,18 @@ class Scheduler:
             max_workers = int(node.resources.get("CPU", 1)) + self.config.maximum_startup_concurrency
             # Actor workers don't count against the stateless pool cap — but
             # only THIS node's actors (a cluster-wide count would inflate every
-            # node's cap by every other node's actors).
+            # node's cap by every other node's actors). BLOCKED workers don't
+            # count either: a worker parked in ray.get released its CPU, and
+            # its dependency chain needs replacement workers to make progress
+            # — capping them in would deadlock deep nesting (the reference
+            # raylet likewise starts replacements for blocked workers).
             node_actors = sum(1 for w in node.workers.values() if w.actor_id is not None)
-            if len(node.workers) >= max_workers + node_actors:
+            node_blocked = sum(
+                1
+                for w in node.workers.values()
+                if w.state == "blocked" and w.blocked_kind == "dep"
+            )
+            if len(node.workers) >= max_workers + node_actors + node_blocked:
                 # At cap with no matching worker: evict an idle worker of a
                 # different env hash to make room (the reference raylet kills
                 # idle workers to admit dedicated-env workers) — otherwise a

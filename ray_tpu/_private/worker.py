@@ -17,6 +17,7 @@ import concurrent.futures
 import hashlib
 import os
 import shutil
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -525,7 +526,13 @@ class RemoteDriverContext:
         wc.misc_handler = self._on_misc
 
     def _on_misc(self, msg):
-        if msg[0] == "read_object":
+        if msg[0] == "pub":
+            _, channel, payload = msg
+            if channel == "logs":
+                _print_worker_log(payload)
+            elif channel == "errors":
+                _print_worker_error(payload)
+        elif msg[0] == "read_object":
             _, token, path = msg
 
             def _read():
@@ -870,6 +877,7 @@ def init(
     resources: Optional[Dict[str, float]] = None,
     namespace: Optional[str] = None,
     ignore_reinit_error: bool = False,
+    log_to_driver: Optional[bool] = None,
     _system_config: Optional[dict] = None,
     **kwargs,
 ):
@@ -883,9 +891,17 @@ def init(
         raise RuntimeError("ray_tpu.init() called twice; use ignore_reinit_error=True")
 
     if address is not None:
-        return _init_client_mode(address, namespace=namespace)
+        return _init_client_mode(
+            address,
+            namespace=namespace,
+            log_to_driver=True if log_to_driver is None else log_to_driver,
+        )
 
     cfg = Config().apply_overrides(_system_config)
+    if log_to_driver is not None:
+        # Explicit kwarg wins; otherwise RAY_TPU_log_to_driver /
+        # _system_config (applied above) governs.
+        cfg.log_to_driver = bool(log_to_driver)
     set_config(cfg)
 
     from ray_tpu._private.accelerators import tpu as tpu_accel
@@ -925,11 +941,43 @@ def init(
     _ref_tracker.reset()
     _start_ref_flusher()
 
+    if cfg.log_to_driver:
+        # Worker prints + error pushes stream to this driver (reference:
+        # log_monitor -> GCS pubsub -> driver; here the scheduler publishes
+        # on the "logs"/"errors" channels).
+        scheduler.call("subscribe", ("logs", _print_worker_log)).result()
+        scheduler.call("subscribe", ("errors", _print_worker_error)).result()
+
     atexit.register(_atexit_shutdown)
     return RuntimeContext()
 
 
-def _init_client_mode(address: str, namespace: Optional[str]):
+def _print_worker_log(payload: dict) -> None:
+    """Render one worker log push like the reference driver output:
+    `(task_name pid=123) line`."""
+    try:
+        prefix = f"({payload.get('task') or 'worker'} pid={payload.get('pid')})"
+        out = sys.stderr
+        for line in payload.get("lines", ()):
+            out.write(f"{prefix} {line}\n")
+        out.flush()
+    except Exception:  # noqa: BLE001 — never let log rendering break anything
+        pass
+
+
+def _print_worker_error(payload: dict) -> None:
+    try:
+        sys.stderr.write(
+            f"({payload.get('type', 'Error')}) task {payload.get('task')}: "
+            f"{payload.get('message')}\n"
+        )
+        sys.stderr.flush()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _init_client_mode(address: str, namespace: Optional[str],
+                      log_to_driver: bool = True):
     """Connect this driver to an existing head server over TCP (`head.py`).
     The head's authkey must be in RAY_TPU_AUTHKEY_HEX (printed by the head on
     startup; `cluster_utils.Cluster(real=True)` wires it automatically)."""
@@ -978,6 +1026,10 @@ def _init_client_mode(address: str, namespace: Optional[str]):
     global_worker._session_gen += 1
     _ref_tracker.reset()
     _start_ref_flusher()
+
+    if log_to_driver:
+        wc.request("subscribe", "logs")
+        wc.request("subscribe", "errors")
 
     atexit.register(_atexit_shutdown)
     return RuntimeContext()

@@ -155,6 +155,121 @@ class WorkerConnection:
                 self._pending.clear()
 
 
+class _LogShipper:
+    """Out-of-band line shipper: a bounded queue drained by a daemon thread.
+
+    The task thread must NEVER write to the control pipe directly — while a
+    task runs, the worker's reader thread is the only drainer of head->worker
+    traffic, and a synchronous send from inside the task could deadlock
+    against a scheduler blocked writing to this same worker. Overflow drops
+    lines (counted) rather than blocking the printer.
+    """
+
+    MAX_LINES = 10_000
+
+    def __init__(self, wc: "WorkerConnection", worker_id_hex: str):
+        import collections
+
+        self._wc = wc
+        self._worker_id_hex = worker_id_hex
+        self._q: "collections.deque" = collections.deque(maxlen=self.MAX_LINES)
+        self._dropped = 0
+        self._event = threading.Event()
+        threading.Thread(target=self._drain, daemon=True, name="log-ship").start()
+
+    def enqueue(self, stream: str, task_name: str, lines) -> None:
+        if len(self._q) >= self.MAX_LINES:
+            self._dropped += len(lines)
+            return
+        self._q.append((stream, task_name, lines))
+        self._event.set()
+
+    def _drain(self) -> None:
+        while True:
+            self._event.wait()
+            self._event.clear()
+            while self._q:
+                try:
+                    stream, task_name, lines = self._q.popleft()
+                except IndexError:
+                    break
+                if self._dropped:
+                    lines = lines + [f"... ({self._dropped} log lines dropped)"]
+                    self._dropped = 0
+                try:
+                    self._wc.send(
+                        (
+                            "log",
+                            self._worker_id_hex,
+                            os.getpid(),
+                            stream,
+                            task_name,
+                            lines,
+                        )
+                    )
+                except Exception:  # noqa: BLE001 — head gone; logs die quietly
+                    return
+
+
+class _TeeStream:
+    """stdout/stderr wrapper: lines keep flowing to the worker's log file AND
+    stream to the head (via the out-of-band _LogShipper), which the scheduler
+    publishes on the "logs" pubsub channel to subscribed drivers.
+
+    Reference: `python/ray/_private/log_monitor.py:104` tails worker log
+    files into GCS pubsub; the single-owner redesign ships lines up the
+    control conn — no file tailing, no extra process.
+    """
+
+    MAX_TAIL = 8192  # newline-free output (progress bars) flushes in chunks
+
+    def __init__(self, orig, shipper: _LogShipper, rt: "WorkerRuntime",
+                 stream_name: str):
+        self._orig = orig
+        self._shipper = shipper
+        self._rt = rt
+        self._stream = stream_name
+        self._tail = ""
+
+    def write(self, data):
+        n = self._orig.write(data)
+        try:
+            self._tail += data
+            lines = []
+            if "\n" in self._tail:
+                *lines, self._tail = self._tail.split("\n")
+            if len(self._tail) > self.MAX_TAIL:
+                # No newline in sight (e.g. \r progress bars): ship the chunk
+                # rather than growing without bound.
+                lines.append(self._tail[: self.MAX_TAIL])
+                self._tail = self._tail[self.MAX_TAIL:]
+            lines = [l for l in lines if l.strip()]
+            if lines:
+                self._shipper.enqueue(
+                    self._stream, self._rt.current_task_name, lines
+                )
+        except Exception:  # noqa: BLE001 — a print must never kill a task
+            pass
+        return n
+
+    def writelines(self, lines):
+        for line in lines:
+            self.write(line)
+
+    def flush(self):
+        self._orig.flush()
+
+    def __getattr__(self, name):
+        return getattr(self._orig, name)
+
+
+def _install_output_tee(wc: "WorkerConnection", rt: "WorkerRuntime",
+                        worker_id_hex: str) -> None:
+    shipper = _LogShipper(wc, worker_id_hex)
+    sys.stdout = _TeeStream(sys.stdout, shipper, rt, "stdout")
+    sys.stderr = _TeeStream(sys.stderr, shipper, rt, "stderr")
+
+
 class WorkerRuntime:
     """Per-process runtime state: object store facade, function cache, actor."""
 
@@ -485,6 +600,8 @@ def worker_loop(conn, args: WorkerArgs):
             apply_runtime_env(args.runtime_env)
         except Exception as e:  # noqa: BLE001 — surfaced per-task as setup error
             rt.setup_error = e
+    if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+        _install_output_tee(wc, rt, args.worker_id_hex)
     wc.send(("register", args.worker_id_hex, os.getpid()))
     while True:
         # Flush batched completions on EVERY pass with an empty queue — a

@@ -1,0 +1,114 @@
+"""Pubsub channels + worker log/error streaming to the driver (reference:
+`python/ray/_private/log_monitor.py:104` tailing worker logs into GCS
+pubsub, `src/ray/pubsub/publisher.h`; VERDICT r3 ask #7)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _drain_until(capfd, needle: str, timeout: float = 20.0) -> str:
+    """Collect captured stderr until `needle` shows up (log pushes are
+    asynchronous w.r.t. task completion)."""
+    acc = ""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = capfd.readouterr()
+        acc += out.err + out.out
+        if needle in acc:
+            return acc
+        time.sleep(0.1)
+    return acc
+
+
+def test_remote_print_reaches_driver(ray_start_regular, capfd):
+    """The VERDICT done-criterion: a remote task's print arrives at the
+    driver, prefixed with the task name and worker pid."""
+
+    @ray_tpu.remote
+    def chatty():
+        print("hello from the worker side")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    acc = _drain_until(capfd, "hello from the worker side")
+    assert "hello from the worker side" in acc
+    # Prefix carries the task name and a pid.
+    line = next(l for l in acc.splitlines() if "hello from the worker side" in l)
+    assert "chatty" in line and "pid=" in line
+
+
+def test_actor_stderr_reaches_driver(ray_start_regular, capfd):
+    @ray_tpu.remote
+    class Noisy:
+        def speak(self):
+            sys.stderr.write("actor stderr line\n")
+            return "ok"
+
+    a = Noisy.remote()
+    assert ray_tpu.get(a.speak.remote(), timeout=60) == "ok"
+    acc = _drain_until(capfd, "actor stderr line")
+    assert "actor stderr line" in acc
+
+
+def test_worker_crash_pushes_error_channel(ray_start_regular, capfd):
+    """Terminal worker-death errors reach the driver's stderr even before
+    anyone get()s the failed ref (the errors channel)."""
+
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        import os
+
+        os._exit(1)
+
+    ref = die.remote()
+    with pytest.raises(ray_tpu.exceptions.WorkerCrashedError):
+        ray_tpu.get(ref, timeout=60)
+    acc = _drain_until(capfd, "WorkerCrashedError")
+    assert "die" in acc
+
+
+def test_log_to_driver_false_suppresses(tmp_path, capfd):
+    ray_tpu.init(num_cpus=2, log_to_driver=False)
+    try:
+        @ray_tpu.remote
+        def quiet_chatty():
+            print("this must stay in the worker log")
+            return 1
+
+        assert ray_tpu.get(quiet_chatty.remote(), timeout=60) == 1
+        time.sleep(1.0)
+        out = capfd.readouterr()
+        assert "this must stay in the worker log" not in out.err + out.out
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_custom_pubsub_channel_inproc(ray_start_regular):
+    """The generalized channel seam: subscribe a callback, publish from the
+    scheduler, observe delivery (the substrate logs/errors ride on)."""
+    from ray_tpu._private import worker as worker_mod
+
+    sched = worker_mod.global_worker.context.scheduler
+    got = []
+    sched.call("subscribe", ("custom", got.append)).result()
+    sched._publish("custom", {"x": 1})  # direct: runs on caller thread
+    deadline = time.time() + 10
+    while not got and time.time() < deadline:
+        time.sleep(0.05)
+    assert got == [{"x": 1}]
+
+
+def test_multiline_and_flush_batching(ray_start_regular, capfd):
+    @ray_tpu.remote
+    def multi():
+        print("alpha\nbeta\ngamma")
+        return 1
+
+    ray_tpu.get(multi.remote(), timeout=60)
+    acc = _drain_until(capfd, "gamma")
+    for word in ("alpha", "beta", "gamma"):
+        assert word in acc

@@ -136,3 +136,24 @@ def test_task_ids_unique_under_burst(ray_start_regular):
 
     ids = ray_tpu.get([tid.remote() for _ in range(200)], timeout=120)
     assert len(set(ids)) == 200
+
+
+def test_deeply_nested_submission_no_deadlock(ray_start_regular):
+    """Two levels of blocking nesting with a full pipeline: children queued
+    behind a to-be-blocked ancestor are evacuated on block (the self-deadlock
+    a queue timeout cannot break)."""
+
+    @ray_tpu.remote
+    def leaf(i):
+        return i
+
+    @ray_tpu.remote
+    def mid(i):
+        return ray_tpu.get(leaf.remote(i)) + 10
+
+    @ray_tpu.remote
+    def top(i):
+        return ray_tpu.get(mid.remote(i)) + 100
+
+    out = ray_tpu.get([top.remote(i) for i in range(8)], timeout=120)
+    assert out == [i + 110 for i in range(8)]
