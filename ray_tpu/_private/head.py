@@ -81,6 +81,16 @@ def main() -> None:
         for key in gcs.kv_keys(b"job::"):
             if key.endswith(b"::status") and gcs.kv_get(key) in (b"RUNNING", b"PENDING"):
                 gcs.kv_put(key, b"FAILED")
+                # Leave a queryable record of WHY (reference: GcsJobManager
+                # marks running jobs dead with a death cause on recovery).
+                from ray_tpu.job_submission.client import _message_key
+
+                job_id = key[len(b"job::"): -len(b"::status")].decode()
+                gcs.kv_put(
+                    _message_key(job_id),
+                    b"job was in flight when the head restarted; "
+                    b"state recovered from the GCS journal",
+                )
     scheduler = Scheduler(
         gcs, cfg, session_dir, tcp_port=ns.port, advertise_host=ns.host, bind_host=ns.bind_host
     )

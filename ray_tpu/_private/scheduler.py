@@ -2103,6 +2103,11 @@ class Scheduler:
 
     def _cmd_create_actor(self, payload, holder: Optional[str] = None):
         ar, info, name = payload
+        # Validate BEFORE registering: raising after the table inserts would
+        # leak a ghost PENDING record that pins its creator worker forever
+        # (_owns_live_actors).
+        if name and name in self.gcs.named_actors:
+            raise ValueError(f"Actor name '{name}' already taken")
         self.actors[ar.actor_id] = ar
         self.gcs.actors[ar.actor_id] = info
         if not ar.detached:
@@ -2110,10 +2115,12 @@ class Scheduler:
             # rules, `gcs_actor_manager.h:281`). Detached actors have no owner.
             ar.owner_holder = holder or self._INPROC_DRIVER
         if name:
-            if name in self.gcs.named_actors:
-                raise ValueError(f"Actor name '{name}' already taken")
             self.gcs.named_actors[name] = ar.actor_id
-        if ar.detached:
+        if ar.detached or name:
+            # Detached actors AND named owned actors persist: a head restart
+            # under --persist replays their creation so get_actor(name) keeps
+            # working (reference: GcsActorManager restores the actor table
+            # from Redis, gcs_actor_manager.h:281).
             self._persist_detached(ar, name)
         self._register_return_holders(
             ar.creation_req.return_ids, holder or self._INPROC_DRIVER
@@ -2146,6 +2153,7 @@ class Scheduler:
             "name": name,
             "class_name": info.class_name if info else "Actor",
             "actor_id": ar.actor_id,
+            "detached": ar.detached,
         })
         self.gcs.detached_actors[ar.actor_id.binary()] = blob
 
@@ -2161,12 +2169,18 @@ class Scheduler:
         actor_id = rec["actor_id"]
         if actor_id in self.actors:
             return False
+        # DELIBERATE divergence from the reference: it never restarts owned
+        # actors on GCS recovery because their worker processes SURVIVE a GCS
+        # restart (raylets reconnect). Here a head restart kills every
+        # worker, so name-reachability after restart requires creation
+        # replay. Restored owned actors come back OWNERLESS (the owner died
+        # with the old head) and live until killed explicitly.
         ar = ActorRecord(
             actor_id=actor_id,
             creation_req=rec["creation_req"],
             resources=rec["resources"],
             max_restarts=rec["max_restarts"],
-            detached=True,
+            detached=bool(rec.get("detached", True)),
         )
         info = ActorInfo(
             actor_id=actor_id,
@@ -2175,6 +2189,11 @@ class Scheduler:
             max_restarts=rec["max_restarts"],
         )
         name = rec["name"]
+        if name and name in self.gcs.named_actors:
+            # A client raced the restore window and took the name: the live
+            # actor wins; drop the stale record instead of clobbering.
+            self.gcs.detached_actors.pop(actor_id.binary(), None)
+            return False
         self.actors[actor_id] = ar
         self.gcs.actors[actor_id] = info
         if name:

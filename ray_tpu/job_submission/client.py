@@ -45,6 +45,10 @@ def _meta_key(job_id: str) -> bytes:
     return f"job::{job_id}::meta".encode()
 
 
+def _message_key(job_id: str) -> bytes:
+    return f"job::{job_id}::message".encode()
+
+
 @ray_tpu.remote(num_cpus=0.1, max_concurrency=2)
 class _JobSupervisor:
     """Runs one job's entrypoint; `stop()` kills it (threaded actor so stop()
@@ -222,6 +226,9 @@ class JobSubmissionClient:
             raise ValueError(f"no such job '{job_id}'")
         info = json.loads(raw)
         info["status"] = self.get_job_status(job_id)
+        msg = _kv().kv("get", _message_key(job_id))
+        if msg:
+            info["message"] = msg.decode()
         return info
 
     def list_jobs(self) -> Dict[str, str]:
