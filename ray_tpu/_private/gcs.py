@@ -121,10 +121,12 @@ class GCS:
     # `store_client/redis_store_client.h:28`, restore at `gcs_server.cc:59`) ---
     def snapshot_bytes(self) -> bytes:
         """Serialize the durable tables: the KV store (jobs/metrics/user data
-        ride it), the function table, and detached-actor records. Other live
-        entities (owned actors, nodes, task events) die with their processes
-        and are intentionally not persisted — the reference reconstructs
-        those from re-registration, not storage."""
+        ride it), the function table, and persisted actor records (detached
+        actors AND named owned actors — both replay their creation on head
+        restart; see scheduler._persist_detached). Other live entities
+        (anonymous owned actors, nodes, task events) die with their
+        processes and are intentionally not persisted — the reference
+        reconstructs those from re-registration, not storage."""
         import pickle
 
         with self.store._lock:

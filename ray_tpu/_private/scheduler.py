@@ -224,9 +224,17 @@ class NodeState:
     last_active: float = field(default_factory=time.time)
 
     def utilization(self) -> float:
-        total = sum(v for v in self.resources.values() if v > 0) or 1.0
-        avail = sum(max(self.available.get(k, 0.0), 0.0) for k in self.resources)
-        return 1.0 - avail / total
+        """Critical-resource utilization: the max used-fraction over resource
+        kinds (reference: hybrid_scheduling_policy.cc scores nodes the same
+        way). Summing kinds instead would let a huge mostly-idle denominator
+        (memory bytes) mask full CPU saturation."""
+        worst = 0.0
+        for k, total in self.resources.items():
+            if total <= 0:
+                continue
+            used = total - max(self.available.get(k, 0.0), 0.0)
+            worst = max(worst, used / total)
+        return worst
 
 
 @dataclass
@@ -1296,6 +1304,7 @@ class Scheduler:
             self._release_actor_resources(ar)
             self._release_actor_creation_pins(ar)
             self._drop_detached(ar.actor_id)
+            self._drop_actor_name(ar.actor_id)
             for req in ar.backlog:
                 rec = self.tasks.get(req.spec.task_id)
                 if rec is not None:
@@ -1529,6 +1538,7 @@ class Scheduler:
             self._release_actor_resources(ar)
             self._release_actor_creation_pins(ar)
             self._drop_detached(ar.actor_id)
+            self._drop_actor_name(ar.actor_id)
 
     # ------------------------------------------------------------------ generator streams
     # Reference semantics: `num_returns="dynamic"` / streaming generator tasks
@@ -2160,6 +2170,14 @@ class Scheduler:
     def _drop_detached(self, actor_id: ActorID) -> None:
         self.gcs.detached_actors.pop(actor_id.binary(), None)
 
+    def _drop_actor_name(self, actor_id: ActorID) -> None:
+        """Free a DEAD actor's registered name for reuse — every terminal
+        transition must do this or create-with-name rejects the name forever
+        while get_actor() already returns nothing."""
+        for name, aid in list(self.gcs.named_actors.items()):
+            if aid == actor_id:
+                del self.gcs.named_actors[name]
+
     def _cmd_restore_detached_actor(self, blob: bytes):
         """Head restart with --persist: re-create a persisted detached actor
         (fresh state — the creation task replays, like an actor restart)."""
@@ -2268,11 +2286,9 @@ class Scheduler:
                 except Exception:
                     pass
                 self._on_worker_death(wh)
-        # Drop the name so it can be reused.
-        for name, aid in list(self.gcs.named_actors.items()):
-            if aid == actor_id and ar.state == "DEAD":
-                del self.gcs.named_actors[name]
         if ar.state == "DEAD":
+            # Drop the name so it can be reused.
+            self._drop_actor_name(actor_id)
             self._drop_detached(actor_id)
         return True
 
@@ -3234,25 +3250,27 @@ class Scheduler:
                 return None
             self._rr_counter += 1
             return feasible[self._rr_counter % len(feasible)]
-        # Data locality (reference: `lease_policy.h:56 LocalityAwareLeasePolicy`):
-        # prefer the feasible node already holding the most argument bytes, so
-        # a task chases its data instead of pulling it across the wire. Small
-        # args don't drive placement (scheduler_locality_min_bytes).
+        # Data locality WEIGHED WITHIN the hybrid policy (reference:
+        # `lease_policy.h:56 LocalityAwareLeasePolicy` picks which raylet the
+        # lease request goes to, and that raylet's hybrid policy packs onto
+        # itself only while under the spread threshold, else spills). Here:
+        # argument-holding nodes go FIRST in the hybrid traversal, ranked by
+        # resident bytes — so locality wins while the holder is under the
+        # threshold, and a saturated magnet node yields to less-utilized
+        # nodes instead of starving them. Small args don't drive placement
+        # (scheduler_locality_min_bytes).
         loc = self._locality_bytes(rec)
+        order = list(self.node_order)
         if loc:
-            best_node, best_bytes = None, 0
-            for nid in self.node_order:
-                node = self.nodes[nid]
-                if not node.alive or not _fits(node.available, rec.spec.resources):
-                    continue
-                b = loc.get(nid.binary(), 0)
-                if b > best_bytes:
-                    best_node, best_bytes = node, b
-            if best_node is not None:
-                return best_node
+            ranked = sorted(
+                (nid for nid in order if loc.get(nid.binary())),
+                key=lambda nid: -loc[nid.binary()],
+            )
+            ranked_set = set(ranked)
+            order = ranked + [nid for nid in order if nid not in ranked_set]
         threshold = self.config.scheduler_spread_threshold
         best: Optional[NodeState] = None
-        for nid in self.node_order:
+        for nid in order:
             node = self.nodes[nid]
             if not node.alive or not _fits(node.available, rec.spec.resources):
                 continue

@@ -191,3 +191,36 @@ def test_threaded_actor_concurrency(ray_start_regular):
     assert ray_tpu.get(g.open.remote(), timeout=15) == "open"
     assert ray_tpu.get(blocked, timeout=15) == "unblocked"
     assert ray_tpu.get(g.async_mul.remote(6, 7), timeout=15) == 42
+
+
+def test_crashed_named_actor_frees_its_name(ray_start_regular):
+    """A named actor that dies out of restarts releases its name: get_actor
+    stops resolving it AND the name is reusable for a fresh actor (every
+    terminal transition cleans the name table, not just kill)."""
+    import time
+
+    @ray_tpu.remote(max_restarts=0)
+    class Fragile:
+        def seppuku(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    a = Fragile.options(name="phoenix").remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    a.seppuku.remote()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            ray_tpu.get_actor("phoenix")
+            time.sleep(0.2)
+        except ValueError:
+            break
+    else:
+        raise AssertionError("dead actor still resolvable by name")
+    # The name is free again.
+    b = Fragile.options(name="phoenix").remote()
+    assert ray_tpu.get(b.ping.remote()) == "pong"
