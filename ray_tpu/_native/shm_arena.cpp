@@ -166,12 +166,18 @@ uint64_t arena_alloc(void* handle, uint64_t size) {
     if (!b->free || b->size < size) continue;
     uint64_t remainder = b->size - size;
     if (remainder > kBlockSize + kAlign) {
-      // Split: carve the tail into a new free block.
-      b->size = size;
+      // Split: carve the tail into a new free block. CRASH-CONSISTENT
+      // ORDER (the robust mutex hands the table to a survivor if this
+      // process dies mid-split): (1) write the tail header while it is
+      // still invisible scribble inside b's payload, (2) shrink b — a walker
+      // now sees two valid free blocks, (3) only then claim b below. Any
+      // kill point leaves a walkable table; the old order (shrink first)
+      // lost everything past the split until the tail header existed.
       auto* tail = reinterpret_cast<Block*>(
           reinterpret_cast<uint8_t*>(b) + kBlockSize + size);
       tail->size = remainder - kBlockSize;
       tail->free = 1;
+      b->size = size;
     }
     b->free = 0;
     hd->used += b->size;

@@ -70,12 +70,16 @@ def _chain_task(block, chain):
     return out, _meta_of(out)
 
 
-def _read_stream(entries):
+def _read_stream(entries, chain=None):
     """Streaming read task: one (block, meta) pair of yields per entry.
     Runs with a producer-side backpressure window, so a fast reader cannot
-    flood the object store ahead of consumption."""
+    flood the object store ahead of consumption. A fused per-block transform
+    chain (read->map fusion) applies BEFORE the block ever hits the object
+    store — the block serializes once instead of write+read+write."""
     for fn, args in entries:
         block = fn(*args)
+        if chain:
+            block = _apply_chain(block, chain)
         yield block
         yield _meta_of(block)
 
@@ -190,6 +194,7 @@ class ReadOperator(PhysicalOperator):
     def __init__(self, entries: List[Tuple[Callable, tuple]], name: str = "Read"):
         super().__init__(name)
         self._entries = list(entries)
+        self._chain: List = []  # read->map fused per-block transforms
         self._gens: List[Optional[Any]] = []  # ObjectRefGenerator per group
         self._next_seq = 0  # next entry index to emit (input order preserved)
         # Block pulled but its meta sidecar not yet (transient stall): retried
@@ -197,6 +202,13 @@ class ReadOperator(PhysicalOperator):
         self._pending_block: Optional[Any] = None
         self._started = False
         self.inputs_done = True
+
+    def fuse_chain(self, segment: List, names: str) -> None:
+        """Read->map fusion (reference: OperatorFusionRule fusing Read into
+        the downstream map): the chain runs inside the read task, so blocks
+        serialize once instead of write+read+write at the boundary."""
+        self._chain = list(segment)
+        self.name = f"{self.name}->Map[{names}]"
 
     def start(self, ctx: DataContext) -> None:
         if self._started:
@@ -216,7 +228,7 @@ class ReadOperator(PhysicalOperator):
             self._gens.append(
                 read.options(
                     num_returns="streaming", generator_backpressure=window
-                ).remote(g)
+                ).remote(g, self._chain or None)
             )
             self.tasks_submitted += 1
 
@@ -627,7 +639,16 @@ def build_pipeline(source_op: PhysicalOperator, logical_ops: List) -> List[Physi
         nonlocal segment
         if segment:
             names = ",".join(k for k, _ in segment)
-            ops.append(MapOperator(segment, name=f"Map[{names}]"))
+            if (
+                len(ops) == 1
+                and isinstance(source_op, ReadOperator)
+                and not source_op._chain
+            ):
+                # Read->map fusion: the first per-block segment runs inside
+                # the read tasks themselves.
+                source_op.fuse_chain(segment, names)
+            else:
+                ops.append(MapOperator(segment, name=f"Map[{names}]"))
             segment = []
 
     i = 0

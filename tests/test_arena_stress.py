@@ -1,0 +1,178 @@
+"""Sanitizer + stress passes on the C++ shm arena (VERDICT r2 weak #9 /
+r3 ask #10: `_native/shm_arena.cpp` robust-mutex + coalescing allocator had
+no TSAN/stress coverage).
+
+Two layers:
+ - ThreadSanitizer harness (`_native/arena_stress.cpp`): 8 threads x N
+   alloc/fill/verify/free cycles; overlapping allocations surface as data
+   corruption, unsynchronized header access as TSAN reports.
+ - Multi-process fuzz through the real ctypes ABI: 4 processes hammer one
+   arena; a 5th is SIGKILLed mid-traffic to exercise robust-mutex owner
+   death (EOWNERDEAD -> pthread_mutex_consistent recovery).
+"""
+
+import ctypes
+import multiprocessing as mp
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+NATIVE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ray_tpu", "_native",
+)
+
+
+def _have_gxx() -> bool:
+    try:
+        subprocess.run(["g++", "--version"], capture_output=True, timeout=10)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+@pytest.mark.skipif(not _have_gxx(), reason="no g++ toolchain")
+def test_tsan_thread_stress(tmp_path):
+    """Compile the arena + harness under -fsanitize=thread and run it; any
+    data race or allocator overlap fails the run."""
+    binary = str(tmp_path / "arena_stress")
+    build = subprocess.run(
+        [
+            "g++", "-O1", "-g", "-fsanitize=thread", "-pthread",
+            os.path.join(NATIVE, "shm_arena.cpp"),
+            os.path.join(NATIVE, "arena_stress.cpp"),
+            "-o", binary,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"tsan build unavailable: {build.stderr[-200:]}")
+    env = dict(os.environ, TSAN_OPTIONS="halt_on_error=1")
+    run = subprocess.run(
+        [binary, str(tmp_path / "arena_tsan"), "150"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert run.returncode == 0, f"stress failed:\n{run.stdout}\n{run.stderr}"
+    assert "ok:" in run.stdout
+
+
+def _load_lib():
+    # The package loader builds/rebuilds the .so when shm_arena.cpp is newer
+    # than the binary — fuzzing a stale prebuilt library would test the
+    # wrong allocator.
+    from ray_tpu._native import load_arena_lib
+
+    lib = load_arena_lib()
+    if lib is None:
+        pytest.skip("native arena unavailable (no toolchain)")
+    return lib
+
+
+def _fuzz_proc(path: str, seed: int, iters: int, victim: bool, q):
+    """One fuzzer: alloc/fill/verify/free loop through the ctypes ABI.
+
+    A `victim` announces itself then loops FOREVER in lock-taking traffic —
+    the parent SIGKILLs it at a random moment, so the kill can land inside
+    arena_alloc/arena_free while the robust mutex is held (the EOWNERDEAD ->
+    pthread_mutex_consistent recovery in shm_arena.cpp)."""
+    import itertools
+    import random
+
+    lib = _load_lib()
+    h = lib.arena_attach(path.encode())
+    assert h
+    base = lib.arena_base(h)
+    rng = random.Random(seed)
+    held = []
+    fails = 0
+    if victim:
+        q.put(("running", os.getpid()))
+    for _ in (itertools.count() if victim else range(iters)):
+        size = rng.randrange(64, 128 * 1024)
+        off = lib.arena_alloc(h, size)
+        if off:
+            pat = (off ^ seed) & 0xFF
+            ctypes.memset(base + off, pat, size)
+            held.append((off, size, pat))
+        # Victims cap what they hold (~16 blocks): the point is dying with
+        # SOME live allocations, not leaking the whole arena.
+        if held and (rng.random() < 0.5 or not off or (victim and len(held) > 16)):
+            off, size, pat = held.pop(rng.randrange(len(held)))
+            buf = (ctypes.c_uint8 * size).from_address(base + off)
+            if any(b != pat for b in bytes(buf)[:: max(1, size // 64)]):
+                fails += 1
+            lib.arena_free(h, off)
+    for off, size, pat in held:
+        lib.arena_free(h, off)
+    q.put(("done", fails))
+
+
+def test_multiprocess_fuzz_with_kill(tmp_path):
+    """4 fuzzers through the real ABI + one process SIGKILLed mid-traffic:
+    survivors keep allocating/freeing correctly and the arena drains to
+    empty (robust mutex: a dead holder never wedges the lock)."""
+    lib = _load_lib()
+    path = str(tmp_path / "arena_fuzz")
+    assert lib.arena_create(path.encode(), 32 << 20) == 0
+
+    import random
+
+    ctx = mp.get_context("spawn")
+    result_q = ctx.Queue()
+    victim_q = ctx.Queue()
+    fuzzers = [
+        ctx.Process(target=_fuzz_proc, args=(path, i, 400, False, result_q))
+        for i in range(4)
+    ]
+    victims = []
+    try:
+        for p in fuzzers:
+            p.start()
+        # Three victims in sequence, each SIGKILLed at a random moment
+        # DURING its alloc/free loop — across attempts the kill lands inside
+        # the robust-mutex critical section with real probability.
+        rng = random.Random(0)
+        for v in range(3):
+            victim = ctx.Process(
+                target=_fuzz_proc, args=(path, 900 + v, 0, True, victim_q)
+            )
+            victims.append(victim)
+            victim.start()
+            kind, pid = victim_q.get(timeout=60)
+            assert kind == "running"
+            time.sleep(0.05 + rng.random() * 0.3)
+            os.kill(pid, signal.SIGKILL)
+            victim.join(timeout=30)
+        results = [result_q.get(timeout=180) for _ in range(4)]
+        for p in fuzzers:
+            p.join(timeout=30)
+            assert p.exitcode == 0
+        assert all(k == "done" and fails == 0 for k, fails in results), results
+    finally:
+        for p in fuzzers + victims:
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10)
+
+    # Survivors freed everything; the victims' leaked allocations remain and
+    # FRAGMENT the space (they died holding scattered blocks — by design, no
+    # journal reclaims them). The arena must still serve further allocations
+    # from the gaps: probe with the fuzzers' own working size.
+    h = lib.arena_attach(path.encode())
+    probes = []
+    for _ in range(8):
+        off = lib.arena_alloc(h, 64 * 1024)
+        assert off != 0, "arena cannot allocate between leaked blocks"
+        probes.append(off)
+    for off in probes:
+        lib.arena_free(h, off)

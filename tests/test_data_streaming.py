@@ -146,14 +146,18 @@ def test_early_abandon_stops_pipeline(ray_ctx, data_ctx):
     rows = ds.take(4)
     dt = time.time() - t0
     assert [r["id"] for r in rows] == [0, 1, 2, 3]
-    # Full execution is ~100 blocks x 0.1s / 8-way + per-worker spawn time
-    # (>10s on the 1-core CI box); early exit must beat it decisively.
-    assert dt < 7.0, dt
+    # The real property: abandonment must stop execution long before the
+    # 100-block pipeline finishes. Count work, not wall time — with
+    # read->map fusion the slow UDF runs inside the read tasks, and each
+    # generator front-runs only its backpressure window before the throttle
+    # parks it. (Wall clock keeps a loose bound: full execution is 100 x
+    # 0.1s of UDF alone plus spawns, >12s on the 1-core CI box.)
+    assert dt < 12.0, dt
     stats = ds._last_executor.stats()
     emitted = next(
-        o["blocks_emitted"] for o in stats["operators"] if o["name"].startswith("Map")
+        o["blocks_emitted"] for o in stats["operators"] if "Map" in o["name"]
     )
-    assert emitted < 100, stats
+    assert emitted < 40, stats
 
 
 def test_read_csv_streams(ray_ctx, tmp_path):

@@ -35,6 +35,25 @@ def _run_client(address, authkey_hex, body, timeout=120):
     return r.stdout + r.stderr
 
 
+def _wait_for_journal(persist: str, job_id: str, timeout: float = 30.0) -> None:
+    """Poll the GCS journal until it holds the named-actor record and the
+    RUNNING job status (the chaos kill must observe a captured state)."""
+    from ray_tpu._private.gcs import GCS
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        g = GCS()
+        try:
+            if g.load_from(persist):
+                status = g.kv_get(f"job::{job_id}::status".encode())
+                if g.detached_actors and status == b"RUNNING":
+                    return
+        except Exception:
+            pass  # torn read of a mid-write journal; retry
+        time.sleep(0.2)
+    raise AssertionError("journal never captured actor + running job")
+
+
 def test_head_restart_mid_job_and_named_actor(tmp_path):
     """The VERDICT done-criterion in one chaos pass: kill the head while a
     job is mid-flight and a named OWNED actor exists; after restart with the
@@ -76,6 +95,10 @@ time.sleep(1.0)  # a persist tick captures actor + job state
         job_id = next(
             l.split("=", 1)[1] for l in out.splitlines() if l.startswith("JOBID=")
         )
+        # Don't fire the kill until a persist tick has actually journaled the
+        # actor + running job (under full-suite load the head can be starved
+        # past the 0.2s interval for seconds).
+        _wait_for_journal(persist, job_id)
     finally:
         proc.kill()  # hard kill mid-job (chaos, not graceful shutdown)
         proc.wait(timeout=10)
