@@ -31,17 +31,169 @@ _SETUP_KEYS = ("pip", "pip_install_options", "working_dir", "py_modules")
 CACHE_ROOT = os.environ.get("RAY_TPU_RUNTIME_ENV_CACHE", "/tmp/ray_tpu_runtime_envs")
 
 
+class RuntimeEnvPlugin:
+    """Extension seam for runtime_env keys beyond the built-ins (reference:
+    `python/ray/_private/runtime_env/plugin.py` RuntimeEnvPlugin — conda and
+    container ship as plugins there too).
+
+    build() runs once per env hash while the cache dir is being provisioned;
+    activate() runs in every worker process adopting the env."""
+
+    def build(self, value: Any, env_dir: str) -> None:
+        pass
+
+    def activate(self, value: Any, env_dir: str) -> None:
+        pass
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+_PLUGINS_ENV = "RAY_TPU_RUNTIME_ENV_PLUGINS"
+_plugins_loaded = False
+
+
+def register_runtime_env_plugin(key: str, plugin: RuntimeEnvPlugin) -> None:
+    """Register in THIS process and, when the plugin class is importable,
+    record it in the environment so worker processes load it too (reference:
+    the RAY_RUNTIME_ENV_PLUGINS class-path mechanism). Plugins defined in
+    __main__ or test modules only exist driver-side — their build/activate
+    would silently no-op in workers, so importability matters.
+
+    TIMING: register BEFORE ray_tpu.init() — like the reference's env-var
+    mechanism, plugins are startup configuration. Processes already running
+    (a pre-started head, remote node daemons) captured their environment at
+    spawn; for multi-node clusters set RAY_TPU_RUNTIME_ENV_PLUGINS in every
+    node's environment instead."""
+    if key in _SETUP_KEYS or key == "env_vars":
+        raise ValueError(f"'{key}' is a built-in runtime_env key")
+    _PLUGINS[key] = plugin
+    cls = type(plugin)
+    mod = cls.__module__
+    if mod not in (__name__, "__main__") and not mod.startswith("test"):
+        entries = json.loads(os.environ.get(_PLUGINS_ENV, "[]"))
+        entry = {"key": key, "cls": f"{mod}:{cls.__qualname__}"}
+        if entry not in entries:
+            entries.append(entry)
+            os.environ[_PLUGINS_ENV] = json.dumps(entries)
+
+
+def _load_env_plugins() -> None:
+    """Import plugins advertised by the driver (workers inherit the env)."""
+    global _plugins_loaded
+    if _plugins_loaded:
+        return
+    _plugins_loaded = True
+    import importlib
+
+    for entry in json.loads(os.environ.get(_PLUGINS_ENV, "[]")):
+        key = entry.get("key")
+        if not key or key in _PLUGINS:
+            continue
+        try:
+            mod_name, qual = entry["cls"].split(":", 1)
+            obj = importlib.import_module(mod_name)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+            _PLUGINS[key] = obj()
+        except Exception:  # noqa: BLE001 — a broken plugin surfaces per task
+            pass
+
+
+def _plugin_keys(renv: Dict[str, Any]):
+    _load_env_plugins()
+    return [k for k in renv if k in _PLUGINS and renv.get(k)]
+
+
 def needs_isolated_worker(renv: Optional[Dict[str, Any]]) -> bool:
     """True if this runtime_env requires per-env worker pooling (anything
     beyond env_vars, which plain workers already apply per task)."""
-    return bool(renv) and any(renv.get(k) for k in _SETUP_KEYS)
+    if not renv:
+        return False
+    return any(renv.get(k) for k in _SETUP_KEYS) or bool(_plugin_keys(renv))
 
 
 def env_hash(renv: Optional[Dict[str, Any]]) -> str:
     if not needs_isolated_worker(renv):
         return ""
     payload = {k: renv.get(k) for k in _SETUP_KEYS if renv.get(k)}
+    for k in _plugin_keys(renv):
+        payload[k] = renv.get(k)
     return hashlib.sha1(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+
+
+# ----------------------------------------------------------- bundled plugins
+class CondaPlugin(RuntimeEnvPlugin):
+    """`conda: <env name>` or `conda: {dependencies: [...]}` (reference:
+    `_private/runtime_env/conda.py`). Gated on a conda binary: absence is a
+    RuntimeEnvSetupError at provision time, surfaced per task."""
+
+    def _conda(self) -> str:
+        import shutil as _shutil
+
+        exe = _shutil.which("conda") or _shutil.which("mamba")
+        if exe is None:
+            raise RuntimeError(
+                "runtime_env['conda'] requires a conda/mamba binary on the "
+                "node; none found on PATH"
+            )
+        return exe
+
+    def build(self, value: Any, env_dir: str) -> None:
+        exe = self._conda()
+        prefix = os.path.join(env_dir, "conda")
+        if isinstance(value, str):
+            # Named pre-existing env: cloned so the cache dir owns it.
+            cmd = [exe, "create", "--yes", "--prefix", prefix, "--clone", value]
+        else:
+            spec_path = os.path.join(env_dir, "conda_env.json")
+            with open(spec_path, "w") as f:
+                json.dump(value, f)
+            cmd = [exe, "env", "create", "--yes", "--prefix", prefix,
+                   "--file", spec_path]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"conda env create failed (rc={proc.returncode}):\n"
+                f"{proc.stderr[-4000:]}"
+            )
+
+    def activate(self, value: Any, env_dir: str) -> None:
+        prefix = os.path.join(env_dir, "conda")
+        bin_dir = os.path.join(prefix, "bin")
+        if os.path.isdir(bin_dir):
+            os.environ["PATH"] = bin_dir + os.pathsep + os.environ.get("PATH", "")
+            os.environ["CONDA_PREFIX"] = prefix
+        site = os.path.join(prefix, "lib")
+        if os.path.isdir(site):
+            for entry in sorted(os.listdir(site)):
+                sp = os.path.join(site, entry, "site-packages")
+                if entry.startswith("python") and os.path.isdir(sp):
+                    sys.path.insert(0, sp)
+
+
+class ContainerPlugin(RuntimeEnvPlugin):
+    """`container: {"image": ...}` (reference:
+    `_private/runtime_env/container.py` wraps the worker command in podman).
+    Gated: without a podman binary (this environment has none) provisioning
+    fails with a clear error; with one, the spawn-path integration still
+    has to be provided by the deployer via this plugin seam."""
+
+    def build(self, value: Any, env_dir: str) -> None:
+        import shutil as _shutil
+
+        if _shutil.which("podman") is None and _shutil.which("docker") is None:
+            raise RuntimeError(
+                "runtime_env['container'] requires podman or docker on the "
+                "node; neither found on PATH"
+            )
+        raise RuntimeError(
+            "container runtime_envs need a worker-spawn integration: "
+            "register a ContainerPlugin subclass that wraps the worker "
+            "command for your container runtime"
+        )
+
+
+register_runtime_env_plugin("conda", CondaPlugin())
+register_runtime_env_plugin("container", ContainerPlugin())
 
 
 def _install_pip(renv: Dict[str, Any], target: str) -> None:
@@ -122,6 +274,8 @@ def ensure_runtime_env(renv: Optional[Dict[str, Any]], timeout_s: float = 300.0)
             _install_pip(renv, pkg_dir)
             _copy_working_dir(renv, env_dir)
             _copy_py_modules(renv, pkg_dir)
+            for key in _plugin_keys(renv):
+                _PLUGINS[key].build(renv[key], env_dir)
             with open(done, "w") as f:
                 f.write("ok")
         except Exception as e:  # noqa: BLE001
@@ -161,3 +315,5 @@ def apply_runtime_env(renv: Optional[Dict[str, Any]]) -> None:
     if os.path.isdir(wd):
         os.chdir(wd)
         sys.path.insert(0, wd)
+    for key in _plugin_keys(renv or {}):
+        _PLUGINS[key].activate(renv[key], env_dir)

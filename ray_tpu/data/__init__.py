@@ -14,6 +14,7 @@ with a sliding prefetch window; `split` feeds per-host Train ingest
 
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.datasource import Datasource, ReadTask
 from ray_tpu.data.read_api import (
     from_arrow,
     from_items,
@@ -21,10 +22,14 @@ from ray_tpu.data.read_api import (
     from_pandas,
     range,  # noqa: A001 - parity with the reference API
     range_tensor,
+    read_binary_files,
     read_csv,
+    read_datasource,
     read_json,
+    read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
 )
 
 Datastream = Dataset  # the reference's short-lived rename (`dataset.py:169`)
@@ -39,8 +44,14 @@ __all__ = [
     "from_pandas",
     "range",
     "range_tensor",
+    "read_binary_files",
     "read_csv",
+    "read_datasource",
     "read_json",
+    "read_numpy",
     "read_parquet",
     "read_text",
+    "read_tfrecords",
+    "Datasource",
+    "ReadTask",
 ]

@@ -75,7 +75,15 @@ class BlockAccessor:
                 out[k] = v
             return out
         keys = blocks[0].keys()
-        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+        out = {}
+        for k in keys:
+            arr = np.concatenate([b[k] for b in blocks])
+            # Same contract as the single-block path: batches are read-only
+            # regardless of block layout, so consumer mutation fails
+            # deterministically instead of only when a batch spans blocks.
+            arr.flags.writeable = False
+            out[k] = arr
+        return out
 
     # ----------------------------------------------------------------- queries
     def num_rows(self) -> int:

@@ -551,6 +551,10 @@ class Dataset:
                 if v.dtype == object:
                     out[k] = v
                     continue
+                if isinstance(v, np.ndarray) and not v.flags.writeable:
+                    # Batches are read-only views (they may alias the shm
+                    # store); torch needs writable memory — copy here.
+                    v = v.copy()
                 t = torch.as_tensor(v)
                 if dtypes is not None:
                     # A dict maps column -> dtype; unlisted columns keep the

@@ -180,6 +180,52 @@ def read_text(paths: Union[str, List[str]], *, parallelism: int = -1,
     return _file_reader(_expand_paths(paths), parallelism, _read_text_files, encoding)
 
 
+def read_numpy(paths: Union[str, List[str]], *, parallelism: int = -1) -> Dataset:
+    """.npy files -> blocks with a "data" column (reference:
+    `data/datasource/numpy_datasource.py`)."""
+    from ray_tpu.data.datasource import _read_npy_files
+
+    return _file_reader(_expand_paths(paths, ".npy"), parallelism, _read_npy_files, None)
+
+
+def read_tfrecords(paths: Union[str, List[str]], *, parallelism: int = -1) -> Dataset:
+    """TFRecord files of tf.train.Example protos, parsed without tensorflow
+    (reference: `data/datasource/tfrecords_datasource.py`)."""
+    from ray_tpu.data.datasource import _read_tfrecord_files
+
+    return _file_reader(
+        _expand_paths(paths), parallelism, _read_tfrecord_files, None
+    )
+
+
+def read_binary_files(paths: Union[str, List[str]], *, parallelism: int = -1,
+                      include_paths: bool = False) -> Dataset:
+    """Whole files as a "bytes" column (+"path"), reference:
+    `data/datasource/binary_datasource.py`."""
+    from ray_tpu.data.datasource import _read_binary_files
+
+    return _file_reader(
+        _expand_paths(paths), parallelism, _read_binary_files, include_paths
+    )
+
+
+def read_datasource(datasource, *, parallelism: int = -1) -> Dataset:
+    """Run a custom `Datasource` plugin through the streaming read path
+    (reference: `read_api.py read_datasource`): its ReadTasks become
+    generator read entries, inheriting backpressure + read->map fusion."""
+    from ray_tpu.data.datasource import _run_read_task
+
+    tasks = datasource.get_read_tasks(
+        parallelism if parallelism and parallelism > 0 else _auto_parallelism(-1, 1 << 30)
+    )
+    if not tasks:
+        return Dataset([])
+    return Dataset(ReadSource(
+        [(_run_read_task, (t,)) for t in tasks],
+        name=f"Read[{datasource.name}]",
+    ))
+
+
 def _auto_parallelism(parallelism: int, n: int) -> int:
     if parallelism and parallelism > 0:
         return max(1, min(parallelism, max(n, 1)))

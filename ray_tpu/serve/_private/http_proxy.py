@@ -37,7 +37,7 @@ class ProxyRequest:
 
 
 class HTTPProxy:
-    def __init__(self, controller):
+    def __init__(self, controller, port: Optional[int] = None):
         self._controller = controller
         self._handles: Dict[str, Any] = {}
         self._routes: Dict[str, str] = {}
@@ -45,6 +45,27 @@ class HTTPProxy:
         self._port: Optional[int] = None
         self._started = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._start_error: Optional[str] = None
+        if port is not None:
+            # Bind during creation so a crash-restart (max_restarts replays
+            # the creation task) comes back LISTENING on the same port — the
+            # reference's controller reconciles dead proxies back up the
+            # same way (`_private/http_state.py`). A bind failure (port in
+            # use) is RECORDED, not raised: raising would fail the creation
+            # and restart-loop forever; port() surfaces the error instead.
+            try:
+                self.start(port=port)
+            except Exception as e:  # noqa: BLE001
+                self._start_error = repr(e)
+
+    def start_error(self):
+        return self._start_error
+
+    def pid(self) -> int:
+        """Worker pid (health checks + chaos tests)."""
+        import os
+
+        return os.getpid()
 
     # -------------------------------------------------------------- lifecycle
     def start(self, host: str = "127.0.0.1", port: int = 8000) -> int:

@@ -169,15 +169,34 @@ def _get_proxy(create: bool = True, port: int = DEFAULT_HTTP_PORT):
     except ValueError:
         if not create:
             return None
-        handle = (
-            ray_tpu.remote(HTTPProxy)
-            .options(
-                name=PROXY_NAME, num_cpus=0.1, get_if_exists=True,
-                lifetime="detached",
+        if port == 0:
+            # Ephemeral port: a crash-restart would rebind a DIFFERENT port
+            # and strand every client that cached http_port() — keep the
+            # explicit-start path (no auto-restart) for port=0.
+            handle = (
+                ray_tpu.remote(HTTPProxy)
+                .options(
+                    name=PROXY_NAME, num_cpus=0.1, get_if_exists=True,
+                    lifetime="detached",
+                )
+                .remote(controller)
             )
-            .remote(controller)
-        )
-        bound = ray_tpu.get(handle.start.remote(port=port))
+            bound = ray_tpu.get(handle.start.remote(port=0))
+        else:
+            handle = (
+                ray_tpu.remote(HTTPProxy)
+                .options(
+                    name=PROXY_NAME, num_cpus=0.1, get_if_exists=True,
+                    lifetime="detached", max_restarts=10,
+                )
+                .remote(controller, port)
+            )
+            # Binding happened in __init__ (crash-restarts rebind the same
+            # fixed port); a recorded bind failure surfaces here.
+            err = ray_tpu.get(handle.start_error.remote())
+            if err:
+                raise RuntimeError(f"HTTP proxy failed to bind port {port}: {err}")
+            bound = ray_tpu.get(handle.port.remote())
         _client["http_port"] = bound
     _client["proxy"] = handle
     return handle

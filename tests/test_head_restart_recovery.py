@@ -35,9 +35,13 @@ def _run_client(address, authkey_hex, body, timeout=120):
     return r.stdout + r.stderr
 
 
-def _wait_for_journal(persist: str, job_id: str, timeout: float = 30.0) -> None:
-    """Poll the GCS journal until it holds the named-actor record and the
-    RUNNING job status (the chaos kill must observe a captured state)."""
+def _wait_for_journal(
+    persist: str, job_id: str, actor_name: str, timeout: float = 30.0
+) -> None:
+    """Poll the GCS journal until it holds THE named-actor record (not just
+    any record — the job supervisor is also a persisted actor) and the
+    RUNNING job status: the chaos kill must observe a captured state."""
+    from ray_tpu._private import serialization
     from ray_tpu._private.gcs import GCS
 
     deadline = time.time() + timeout
@@ -46,7 +50,13 @@ def _wait_for_journal(persist: str, job_id: str, timeout: float = 30.0) -> None:
         try:
             if g.load_from(persist):
                 status = g.kv_get(f"job::{job_id}::status".encode())
-                if g.detached_actors and status == b"RUNNING":
+                names = set()
+                for blob in g.detached_actors.values():
+                    try:
+                        names.add(serialization.loads(blob).get("name"))
+                    except Exception:
+                        pass
+                if actor_name in names and status == b"RUNNING":
                     return
         except Exception:
             pass  # torn read of a mid-write journal; retry
@@ -98,7 +108,7 @@ time.sleep(1.0)  # a persist tick captures actor + job state
         # Don't fire the kill until a persist tick has actually journaled the
         # actor + running job (under full-suite load the head can be starved
         # past the 0.2s interval for seconds).
-        _wait_for_journal(persist, job_id)
+        _wait_for_journal(persist, job_id, "counter")
     finally:
         proc.kill()  # hard kill mid-job (chaos, not graceful shutdown)
         proc.wait(timeout=10)
