@@ -46,6 +46,21 @@ class RuntimeEnvPlugin:
         pass
 
 
+class _BrokenPlugin(RuntimeEnvPlugin):
+    """Stand-in for a plugin this process failed to import: provisioning
+    fails loudly instead of tasks silently running without their env."""
+
+    def __init__(self, cls_path: str, error: str):
+        self._cls_path = cls_path
+        self._error = error
+
+    def build(self, value, env_dir):
+        raise RuntimeError(
+            f"runtime_env plugin {self._cls_path!r} failed to import in this "
+            f"process: {self._error}"
+        )
+
+
 _PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
 _PLUGINS_ENV = "RAY_TPU_RUNTIME_ENV_PLUGINS"
 _plugins_loaded = False
@@ -68,7 +83,7 @@ def register_runtime_env_plugin(key: str, plugin: RuntimeEnvPlugin) -> None:
     _PLUGINS[key] = plugin
     cls = type(plugin)
     mod = cls.__module__
-    if mod not in (__name__, "__main__") and not mod.startswith("test"):
+    if mod not in (__name__, "__main__"):
         entries = json.loads(os.environ.get(_PLUGINS_ENV, "[]"))
         entry = {"key": key, "cls": f"{mod}:{cls.__qualname__}"}
         if entry not in entries:
@@ -94,8 +109,12 @@ def _load_env_plugins() -> None:
             for part in qual.split("."):
                 obj = getattr(obj, part)
             _PLUGINS[key] = obj()
-        except Exception:  # noqa: BLE001 — a broken plugin surfaces per task
-            pass
+        except Exception as e:  # noqa: BLE001
+            # Register a POISONED stand-in rather than skipping: skipping
+            # would make needs_isolated_worker() False and silently run the
+            # task with NO runtime env. This way the key still hashes and
+            # build() fails the task with the import error.
+            _PLUGINS[key] = _BrokenPlugin(entry.get("cls", key), repr(e))
 
 
 def _plugin_keys(renv: Dict[str, Any]):

@@ -23,9 +23,12 @@ import numpy as np
 
 
 class ReadTask:
-    """One unit of reading: a zero-arg callable producing a block, plus
-    optional size metadata for scheduling (reference: `datasource.py
-    ReadTask`)."""
+    """One unit of reading: a zero-arg callable producing a block.
+
+    num_rows/size_bytes are advisory ESTIMATES carried for reference-API
+    parity (`datasource.py ReadTask`); the streaming executor derives exact
+    metadata from the produced block after the read, so they do not steer
+    scheduling here."""
 
     def __init__(self, read_fn: Callable[[], Dict[str, np.ndarray]],
                  num_rows: Optional[int] = None,
@@ -194,8 +197,34 @@ def _read_tfrecord_files(files: List[str], _payload) -> Dict[str, np.ndarray]:
     return BlockAccessor.from_rows(rows)
 
 
+_CRC32C_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), table-driven — TFRecord framing checksums."""
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ 0x82F63B78 if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
 def write_tfrecords(rows: List[Dict[str, Any]], path: str) -> None:
-    """Minimal TFRecord+Example writer (tests + export parity)."""
+    """Minimal TFRecord+Example writer with real masked-crc32c framing, so
+    CRC-validating readers (tf.data.TFRecordDataset) accept the output."""
 
     def varint(n: int) -> bytes:
         # Negatives encode as the unsigned 64-bit two's-complement pattern
@@ -236,7 +265,8 @@ def write_tfrecords(rows: List[Dict[str, Any]], path: str) -> None:
                 entry = field(1, 2, name.encode()) + field(2, 2, feature(value))
                 entries += field(1, 2, entry)
             example = field(1, 2, entries)
-            fh.write(struct.pack("<Q", len(example)))
-            fh.write(b"\x00\x00\x00\x00")  # length crc (not validated)
+            length = struct.pack("<Q", len(example))
+            fh.write(length)
+            fh.write(struct.pack("<I", _masked_crc(length)))
             fh.write(example)
-            fh.write(b"\x00\x00\x00\x00")  # payload crc
+            fh.write(struct.pack("<I", _masked_crc(example)))

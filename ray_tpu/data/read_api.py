@@ -215,9 +215,7 @@ def read_datasource(datasource, *, parallelism: int = -1) -> Dataset:
     generator read entries, inheriting backpressure + read->map fusion."""
     from ray_tpu.data.datasource import _run_read_task
 
-    tasks = datasource.get_read_tasks(
-        parallelism if parallelism and parallelism > 0 else _auto_parallelism(-1, 1 << 30)
-    )
+    tasks = datasource.get_read_tasks(_auto_parallelism(parallelism, 1 << 30))
     if not tasks:
         return Dataset([])
     return Dataset(ReadSource(

@@ -19,15 +19,19 @@ from ray_tpu.rllib.env.env_runner import EnvRunner
 from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv, make_multi_agent
 from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, Impala, ImpalaConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.pg import PG, PGConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = [
+    "A2C",
+    "A2CConfig",
     "APPO",
     "APPOConfig",
     "Algorithm",
@@ -48,6 +52,8 @@ __all__ = [
     "MLPModule",
     "MultiAgentEnv",
     "MultiAgentEnvRunner",
+    "PG",
+    "PGConfig",
     "PPO",
     "PPOConfig",
     "RLModule",

@@ -1,0 +1,95 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference: `rllib/algorithms/a2c/a2c.py` (A2CConfig — synchronous rollout
+gather + one SGD step per iteration on the plain actor-critic loss;
+`a3c_torch_policy.py` loss: -logp * advantage + vf_coeff * value_error -
+entropy_coeff * entropy, with GAE advantages from postprocessing).
+
+TPU-first: same jitted-single-update shape as PPO minus the surrogate
+machinery — one gradient step per batch of gathered rollouts, GAE on the
+host, the loss a pure function the learner jits with donated state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import _flatten, compute_gae
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.lambda_ = 1.0  # reference A2C default: plain returns
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 40.0
+        self._algo_cls = A2C
+
+
+def make_a2c_loss(config: "A2CConfig") -> Callable:
+    """Pure (module, params, batch) -> (loss, aux) for JaxLearner.jit."""
+    vf_coeff = config.vf_loss_coeff
+    ent_coeff = config.entropy_coeff
+
+    def loss(module, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        logits, values = module.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        adv = jax.lax.stop_gradient(batch["advantages"])
+        pi_loss = -jnp.mean(logp * adv)
+        vf_loss = jnp.mean(jnp.square(values - batch["value_targets"]))
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return total, {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+    return loss
+
+
+class A2C(Algorithm):
+    # Like PPO: truncations bootstrap through runner-side values.
+    _record_final_obs = False
+
+    def make_loss(self) -> Callable:
+        return make_a2c_loss(self.config)
+
+    def make_optimizer(self):
+        import optax
+
+        return optax.chain(
+            optax.clip_by_global_norm(self.config.grad_clip),
+            optax.adam(self.config.lr),
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.config
+        weights = self.learner_group.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.env_runners])
+        rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
+        flats: List[Dict[str, np.ndarray]] = []
+        for ro in rollouts:
+            ro = dict(ro)
+            ro.update(compute_gae(ro, cfg.gamma, cfg.lambda_))
+            flats.append(_flatten(ro))
+        keys = ("obs", "actions", "advantages", "value_targets")
+        batch = {k: np.concatenate([f[k] for f in flats]) for k in keys}
+        a = batch["advantages"]
+        batch["advantages"] = (a - a.mean()) / max(1e-4, a.std())
+        out = dict(self.learner_group.update(batch))
+        out["num_env_steps_sampled"] = len(batch["advantages"])
+        return self.collect_episode_metrics(out)

@@ -57,6 +57,26 @@ class HTTPProxy:
                 self.start(port=port)
             except Exception as e:  # noqa: BLE001
                 self._start_error = repr(e)
+                # The common cause during a crash-restart is the dead
+                # proxy's socket still draining: keep retrying the SAME
+                # port in the background instead of sitting dead forever.
+                threading.Thread(
+                    target=self._retry_bind, args=(port,), daemon=True,
+                    name="proxy-rebind",
+                ).start()
+
+    def _retry_bind(self, port: int) -> None:
+        import time
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            time.sleep(2.0)
+            try:
+                self.start(port=port)
+                self._start_error = None
+                return
+            except Exception as e:  # noqa: BLE001
+                self._start_error = repr(e)
 
     def start_error(self):
         return self._start_error
