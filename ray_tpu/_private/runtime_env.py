@@ -54,11 +54,19 @@ class _BrokenPlugin(RuntimeEnvPlugin):
         self._cls_path = cls_path
         self._error = error
 
-    def build(self, value, env_dir):
+    def _raise(self):
         raise RuntimeError(
             f"runtime_env plugin {self._cls_path!r} failed to import in this "
             f"process: {self._error}"
         )
+
+    def build(self, value, env_dir):
+        self._raise()
+
+    def activate(self, value, env_dir):
+        # A cache hit skips build(): activation must fail just as loudly or
+        # the task would run with the plugin's per-worker setup missing.
+        self._raise()
 
 
 _PLUGINS: Dict[str, RuntimeEnvPlugin] = {}

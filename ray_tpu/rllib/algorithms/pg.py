@@ -22,6 +22,12 @@ class PGConfig(AlgorithmConfig):
         super().__init__()
         self.lr = 4e-3
         self.entropy_coeff = 0.0
+        # REINFORCE consumes COMPLETE episodes (the reference uses
+        # batch_mode="complete_episodes"); with fixed-fragment runners the
+        # fragment must cover the env's episode length or long (good!)
+        # episodes get discarded and training plateaus near the fragment
+        # size. Default high; match it to your env's time limit.
+        self.rollout_fragment_length = 512
         self._algo_cls = PG
 
 

@@ -46,6 +46,8 @@ class HTTPProxy:
         self._started = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._start_error: Optional[str] = None
+        self._bind_error: Optional[str] = None
+        self._routes_thread_started = False
         if port is not None:
             # Bind during creation so a crash-restart (max_restarts replays
             # the creation task) comes back LISTENING on the same port — the
@@ -94,11 +96,20 @@ class HTTPProxy:
             target=self._serve_thread, args=(host, port), daemon=True, name="http"
         )
         t.start()
-        threading.Thread(
-            target=self._routes_listen_loop, daemon=True, name="routes-listen"
-        ).start()
-        if not self._started.wait(timeout=30):
-            raise RuntimeError("HTTP proxy failed to start in 30s")
+        # Wait for bind FIRST: a failed bind must raise promptly (the serve
+        # thread signals failure) and must not leak a routes-listen long-poll
+        # thread per attempt — retry loops would stack immortal pollers.
+        while not self._started.wait(timeout=0.2):
+            if self._bind_error is not None:
+                err, self._bind_error = self._bind_error, None
+                raise RuntimeError(f"HTTP proxy failed to bind: {err}")
+            if not t.is_alive():
+                raise RuntimeError("HTTP proxy serve thread died before binding")
+        if not self._routes_thread_started:
+            self._routes_thread_started = True
+            threading.Thread(
+                target=self._routes_listen_loop, daemon=True, name="routes-listen"
+            ).start()
         return self._port
 
     def port(self) -> Optional[int]:
@@ -116,7 +127,11 @@ class HTTPProxy:
         runner = web.AppRunner(app, access_log=None)
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, host, port)
-        loop.run_until_complete(site.start())
+        try:
+            loop.run_until_complete(site.start())
+        except Exception as e:  # noqa: BLE001 — surfaced by start()'s wait loop
+            self._bind_error = repr(e)
+            return
         self._port = site._server.sockets[0].getsockname()[1]
         self._started.set()
         loop.run_forever()

@@ -35,8 +35,33 @@ def _run_client(address, authkey_hex, body, timeout=120):
     return r.stdout + r.stderr
 
 
+def _read_line_until(proc, prefix: str, timeout: float) -> str:
+    """Read the child's stdout until a line with `prefix` appears; select()
+    keeps the deadline real (a bare readline() would block forever if the
+    child wedges before printing — exactly what chaos tests provoke)."""
+    import select
+
+    deadline = time.time() + timeout
+    buf = ""
+    while time.time() < deadline:
+        r, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if not r:
+            if proc.poll() is not None:
+                raise AssertionError("phase-1 client died early")
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise AssertionError("phase-1 client died early")
+            continue
+        buf += line
+        if line.startswith(prefix):
+            return line.strip()
+    raise AssertionError(f"client never printed {prefix!r}; output so far:\n{buf}")
+
+
 def _wait_for_journal(
-    persist: str, job_id: str, actor_name: str, timeout: float = 30.0
+    persist: str, actor_name: str, job_id: str = None, timeout: float = 120.0
 ) -> None:
     """Poll the GCS journal until it holds THE named-actor record (not just
     any record — the job supervisor is also a persisted actor) and the
@@ -49,14 +74,17 @@ def _wait_for_journal(
         g = GCS()
         try:
             if g.load_from(persist):
-                status = g.kv_get(f"job::{job_id}::status".encode())
                 names = set()
                 for blob in g.detached_actors.values():
                     try:
                         names.add(serialization.loads(blob).get("name"))
                     except Exception:
                         pass
-                if actor_name in names and status == b"RUNNING":
+                job_ok = (
+                    job_id is None
+                    or g.kv_get(f"job::{job_id}::status".encode()) == b"RUNNING"
+                )
+                if actor_name in names and job_ok:
                     return
         except Exception:
             pass  # torn read of a mid-write journal; retry
@@ -74,13 +102,21 @@ def test_head_restart_mid_job_and_named_actor(tmp_path):
         num_cpus=4, num_tpus=0, timeout_s=60,
         extra_args=("--persist", persist, "--persist-interval", "0.2"),
     )
+    client_proc = None
     try:
-        out = _run_client(info["address"], info["authkey_hex"], """
+        # The phase-1 client must STAY ALIVE until the head dies: an owned
+        # actor is killed (and its journal record dropped) the moment its
+        # owner driver disconnects — the scenario is "head dies under a live
+        # driver", not "driver leaves, then head dies".
+        env = dict(os.environ)
+        env["RAY_TPU_AUTHKEY_HEX"] = info["authkey_hex"]
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        script = f"""import sys; sys.path.insert(0, {REPO!r})
 import time
 import ray_tpu
+ray_tpu.init(address={info["address"]!r})
 from ray_tpu.job_submission import JobSubmissionClient
 
-# A named OWNED (non-detached) actor.
 @ray_tpu.remote
 class Counter:
     def __init__(self, start):
@@ -91,27 +127,33 @@ class Counter:
 c = Counter.options(name="counter").remote(41)
 assert ray_tpu.get(c.value.remote()) == 41
 
-# A job that outlives this script (killed with the head).
 client = JobSubmissionClient()
-job_id = client.submit_job(entrypoint="python -c 'import time; time.sleep(120)'")
-for _ in range(60):
+job_id = client.submit_job(entrypoint="python -c 'import time; time.sleep(600)'")
+for _ in range(240):
     if client.get_job_status(job_id) == "RUNNING":
         break
     time.sleep(0.5)
 assert client.get_job_status(job_id) == "RUNNING"
-print("JOBID=" + job_id)
-time.sleep(1.0)  # a persist tick captures actor + job state
-""")
-        job_id = next(
-            l.split("=", 1)[1] for l in out.splitlines() if l.startswith("JOBID=")
+print("JOBID=" + job_id, flush=True)
+time.sleep(600)  # hold the actor's ownership until the parent kills us
+"""
+        client_proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
         )
+        job_id = _read_line_until(client_proc, "JOBID=", timeout=180).split("=", 1)[1]
         # Don't fire the kill until a persist tick has actually journaled the
-        # actor + running job (under full-suite load the head can be starved
-        # past the 0.2s interval for seconds).
-        _wait_for_journal(persist, job_id, "counter")
+        # actor + running job.
+        _wait_for_journal(persist, "counter", job_id=job_id)
     finally:
         proc.kill()  # hard kill mid-job (chaos, not graceful shutdown)
         proc.wait(timeout=10)
+        if client_proc is not None:
+            client_proc.kill()
+            client_proc.wait(timeout=10)
 
     proc2, info2 = spawn_head(
         num_cpus=4, num_tpus=0, timeout_s=60,
@@ -150,21 +192,39 @@ def test_restored_owned_actor_is_killable_and_record_dropped(tmp_path):
         num_cpus=2, num_tpus=0, timeout_s=60,
         extra_args=("--persist", persist, "--persist-interval", "0.2"),
     )
+    client_proc = None
     try:
-        _run_client(info["address"], info["authkey_hex"], """
+        # Keep the owner ALIVE while the head dies (an exiting owner kills
+        # the owned actor and drops its journal record first).
+        env = dict(os.environ)
+        env["RAY_TPU_AUTHKEY_HEX"] = info["authkey_hex"]
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        script = f"""import sys; sys.path.insert(0, {REPO!r})
 import time
 import ray_tpu
+ray_tpu.init(address={info["address"]!r})
 @ray_tpu.remote
 class A:
     def ping(self):
         return "pong"
 a = A.options(name="mortal").remote()
 assert ray_tpu.get(a.ping.remote()) == "pong"
-time.sleep(1.0)
-""")
+print("READY", flush=True)
+time.sleep(600)
+"""
+        client_proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        _read_line_until(client_proc, "READY", timeout=120)
+        # Wait for a persist tick to journal the record.
+        _wait_for_journal(persist, "mortal")
     finally:
         proc.kill()
         proc.wait(timeout=10)
+        if client_proc is not None:
+            client_proc.kill()
+            client_proc.wait(timeout=10)
 
     proc2, info2 = spawn_head(
         num_cpus=2, num_tpus=0, timeout_s=60,

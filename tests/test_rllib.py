@@ -660,7 +660,7 @@ def test_pg_cartpole_improves(ray_start_regular):
         PGConfig()
         .environment("CartPole-v1")
         .env_runners(
-            num_env_runners=2, num_envs_per_runner=8, rollout_fragment_length=128
+            num_env_runners=2, num_envs_per_runner=8, rollout_fragment_length=512
         )
         .training(lr=4e-3, entropy_coeff=0.005)
         .build()
