@@ -40,7 +40,9 @@ def _rand(n: int) -> bytes:
     global _entropy_buf, _entropy_off
     with _lock:
         if _entropy_off + n > len(_entropy_buf):
-            _entropy_buf = os.urandom(16384)
+            # max() so a request larger than the refill size still gets its
+            # full n bytes rather than a silently-short slice.
+            _entropy_buf = os.urandom(max(16384, n))
             _entropy_off = 0
         out = _entropy_buf[_entropy_off:_entropy_off + n]
         _entropy_off += n

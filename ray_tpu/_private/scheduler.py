@@ -1216,9 +1216,48 @@ class Scheduler:
         from ray_tpu._private import memory_monitor as mm
 
         candidates = []
+        actor_candidates = []
         for node in nodes:
             for wh in node.workers.values():
-                if wh.actor_id is not None or wh.current_task is None:
+                if wh.state == "dying":
+                    continue
+                if wh.actor_id is not None:
+                    # Restartable actors are retriable in the reference
+                    # worker-killing sense — lower priority than stateless
+                    # tasks (in-flight calls fail with RayActorError), but
+                    # killing one beats falling through to the kernel OOM
+                    # killer when actor memory is what's growing. Per-actor
+                    # cooldown (the task path's oom retry delay): without it,
+                    # sustained pressure re-kills the restarted actor every
+                    # monitor tick and burns its whole max_restarts budget in
+                    # ~a second.
+                    ar = self.actors.get(wh.actor_id)
+                    if (
+                        ar is not None
+                        and ar.num_restarts < ar.max_restarts
+                        and time.monotonic()
+                        - getattr(ar, "last_oom_kill", 0.0)
+                        > 10 * self.config.task_oom_retry_delay_ms / 1000.0
+                    ):
+                        rec = (
+                            self.tasks.get(wh.current_task)
+                            if wh.current_task is not None
+                            else None
+                        )
+                        actor_candidates.append(
+                            mm.KillCandidate(
+                                worker_key=wh,
+                                retriable=True,
+                                started_at=(
+                                    rec.running_since
+                                    if rec is not None and rec.state == "RUNNING"
+                                    else 0.0
+                                ),
+                                owner=rec.owner if rec is not None else "",
+                            )
+                        )
+                    continue
+                if wh.current_task is None:
                     continue
                 rec = self.tasks.get(wh.current_task)
                 if rec is None or rec.state != "RUNNING":
@@ -1235,8 +1274,34 @@ class Scheduler:
             candidates, self.config.worker_killing_policy
         )
         if victim is None:
+            victim = mm.select_worker_to_kill(
+                actor_candidates, self.config.worker_killing_policy
+            )
+        if victim is None:
+            # Persistent pressure with nothing eligible must be visible to
+            # operators — otherwise the node quietly drifts into the kernel
+            # OOM killer with no record of why the framework stood by.
+            now = time.monotonic()
+            if now - getattr(self, "_last_no_victim_log", 0.0) > 30.0:
+                self._last_no_victim_log = now
+                self._publish(
+                    "errors",
+                    {
+                        "task": "memory_monitor",
+                        "message": (
+                            f"memory pressure at {snap.used_fraction:.0%} but no "
+                            "eligible worker to kill (no running stateless tasks, "
+                            "no restartable actors)"
+                        ),
+                        "type": "MemoryPressureNoVictim",
+                    },
+                )
             return
         wh = victim.worker_key
+        if wh.actor_id is not None:
+            ar = self.actors.get(wh.actor_id)
+            if ar is not None:
+                ar.last_oom_kill = time.monotonic()
         detail = (
             f" (node at {snap.used_fraction:.0%} of "
             f"{snap.total_bytes >> 20}MB, policy "

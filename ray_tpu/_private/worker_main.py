@@ -59,8 +59,16 @@ class WorkerConnection:
         self._closed = threading.Event()
         # Task ids the scheduler cancelled while they were lease-queued here:
         # the dispatch loop drops them unrun (the scheduler already sealed
-        # their results; no "done" is expected).
-        self.cancelled: set = set()
+        # their results; no "done" is expected). Insertion-ordered and bounded:
+        # a cancel_queued can race a task this worker already popped and ran
+        # (the scheduler's current_task view lags batched dones), in which case
+        # the entry never matches and would otherwise pin memory forever —
+        # task ids are unique, so evicting stale entries is always safe.
+        # _cancelled_lock guards mutation from both the reader thread
+        # (add + evict) and the dispatch loop (pop on match) — an unlocked
+        # evict's next(iter(...)) can see the dict resize mid-iteration.
+        self.cancelled: Dict[bytes, None] = {}
+        self._cancelled_lock = threading.Lock()
         # Batched "done" payloads from the serial dispatch loop: flushed when
         # the local queue drains, so a pipelined burst pays one send per
         # batch instead of per task.
@@ -136,7 +144,10 @@ class WorkerConnection:
                     if q is not None:
                         q.put((ok, payload))
                 elif kind == "cancel_queued":
-                    self.cancelled.add(msg[1])
+                    with self._cancelled_lock:
+                        self.cancelled[msg[1]] = None
+                        while len(self.cancelled) > 1024:
+                            self.cancelled.pop(next(iter(self.cancelled)), None)
                 elif kind == "shutdown":
                     self.task_queue.put(None)
                     return
@@ -616,7 +627,8 @@ def worker_loop(conn, args: WorkerArgs):
         if req.spec.task_id.binary() in wc.cancelled:
             # Cancelled while lease-queued: the scheduler already sealed the
             # result; drop without executing or replying.
-            wc.cancelled.discard(req.spec.task_id.binary())
+            with wc._cancelled_lock:
+                wc.cancelled.pop(req.spec.task_id.binary(), None)
             continue
         if (
             rt.concurrency > 1

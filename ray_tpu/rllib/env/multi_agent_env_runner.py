@@ -182,8 +182,18 @@ class MultiAgentEnvRunner:
                 continue
             obs, rews, terms, truncs, infos = env.step(actions[e])
             for aid, r in rews.items():
-                if aid in self._traj[e] and len(self._traj[e][aid]):
-                    self._traj[e][aid].rewards.append(float(r))
+                # An action opens a pending reward slot (len(rewards) ==
+                # len(actions) - 1). Rewards reported on steps where the agent
+                # did NOT act (turn-based envs: agent absent from obs is "not
+                # ready") accumulate into the last acted step instead of
+                # appending — appending would desynchronize rewards[i] from
+                # actions[i] and misattribute credit in GAE.
+                tr = self._traj[e].get(aid)
+                if tr is not None and len(tr.actions):
+                    if len(tr.rewards) < len(tr.actions):
+                        tr.rewards.append(float(r))
+                    else:
+                        tr.rewards[-1] += float(r)
                 self._episode_return[e] += float(r)
             self._episode_len[e] += 1
             next_obs = dict(self._obs[e])
@@ -253,9 +263,18 @@ class MultiAgentEnvRunner:
         tr = self._traj[e].pop(aid, None)
         if tr is None or len(tr) == 0:
             return
-        n = min(len(tr.rewards), len(tr.actions))
-        rewards = np.asarray(tr.rewards[:n], np.float32)
-        values = np.asarray(tr.values[:n], np.float32)
+        # A trailing action whose reward was never reported (episode ended via
+        # __all__ before the env credited it) earns 0. Rewards can never
+        # exceed actions: inter-action rewards fold into the last acted step.
+        if len(tr.rewards) < len(tr.actions):
+            tr.rewards.extend([0.0] * (len(tr.actions) - len(tr.rewards)))
+        assert len(tr.rewards) == len(tr.actions), (
+            f"trajectory desync for {aid}: "
+            f"{len(tr.rewards)} rewards vs {len(tr.actions)} actions"
+        )
+        n = len(tr.actions)
+        rewards = np.asarray(tr.rewards, np.float32)
+        values = np.asarray(tr.values, np.float32)
         adv, targets = _segment_gae(
             rewards, values, bootstrap, self.gamma, self.lambda_
         )

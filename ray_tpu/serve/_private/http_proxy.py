@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
@@ -99,12 +100,18 @@ class HTTPProxy:
         # Wait for bind FIRST: a failed bind must raise promptly (the serve
         # thread signals failure) and must not leak a routes-listen long-poll
         # thread per attempt — retry loops would stack immortal pollers.
+        # Deadline-bounded: a serve thread that hangs before bind (e.g. in
+        # runner.setup()) without recording an error must not block the
+        # caller (actor creation) forever.
+        deadline = time.monotonic() + 60.0
         while not self._started.wait(timeout=0.2):
             if self._bind_error is not None:
                 err, self._bind_error = self._bind_error, None
                 raise RuntimeError(f"HTTP proxy failed to bind: {err}")
             if not t.is_alive():
                 raise RuntimeError("HTTP proxy serve thread died before binding")
+            if time.monotonic() > deadline:
+                raise RuntimeError("HTTP proxy did not bind within 60s")
         if not self._routes_thread_started:
             self._routes_thread_started = True
             threading.Thread(
