@@ -166,13 +166,20 @@ class NodeDaemon:
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         os.makedirs(self.log_dir, exist_ok=True)
         out = open(os.path.join(self.log_dir, f"worker-{worker_id_hex[:8]}.log"), "wb")
+        cmd = [
+            sys.executable, "-m", "ray_tpu._private.worker_entry",
+            "--address", f"tcp://{self.head_host}:{self.head_port}",
+            "--args", info["args_blob"],
+        ]
+        if info.get("container_env"):
+            from ray_tpu._private.runtime_env import wrap_worker_command
+
+            cmd = wrap_worker_command(
+                info["container_env"], cmd, env, [self.shm_dir, repo_root]
+            )
         try:
             popen = subprocess.Popen(
-                [
-                    sys.executable, "-m", "ray_tpu._private.worker_entry",
-                    "--address", f"tcp://{self.head_host}:{self.head_port}",
-                    "--args", info["args_blob"],
-                ],
+                cmd,
                 env=env,
                 stdout=out,
                 stderr=subprocess.STDOUT,

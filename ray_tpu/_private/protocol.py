@@ -71,6 +71,13 @@ class TaskSpec:
     # `transport/concurrency_group_manager.h`); async def methods additionally
     # interleave on the actor's event loop.
     max_concurrency: int = 1
+    # Named concurrency groups on the creation spec: {"io": 2, "compute": 4}
+    # gives each group its own bounded call-thread pool, isolated from the
+    # default pool (reference: `transport/concurrency_group_manager.h` —
+    # a saturated group must not block calls routed to another).
+    concurrency_groups: Optional[Dict[str, int]] = None
+    # On a method-call spec: route this call to the named group's pool.
+    concurrency_group: Optional[str] = None
     # Scheduling
     scheduling_strategy: Any = None
     placement_group_id: Optional[PlacementGroupID] = None

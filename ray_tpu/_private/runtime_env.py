@@ -198,25 +198,84 @@ class CondaPlugin(RuntimeEnvPlugin):
 
 
 class ContainerPlugin(RuntimeEnvPlugin):
-    """`container: {"image": ...}` (reference:
+    """`container: {"image": ..., "run_options": [...]}` (reference:
     `_private/runtime_env/container.py` wraps the worker command in podman).
-    Gated: without a podman binary (this environment has none) provisioning
-    fails with a clear error; with one, the spawn-path integration still
-    has to be provided by the deployer via this plugin seam."""
+
+    The real work happens on the SPAWN path, not here: the node spawning a
+    worker for this env wraps the worker command via `wrap_worker_command`
+    (podman/docker run with the session/shm dir, framework source, and env
+    cache mounted, env forwarded, host network). build() runs inside the
+    worker — i.e. inside the container when wrapping succeeded — so it only
+    validates that the wrap actually happened and fails the task with a clear
+    error when the node had no container binary."""
 
     def build(self, value: Any, env_dir: str) -> None:
-        import shutil as _shutil
+        image = value.get("image") if isinstance(value, dict) else value
+        if not image:
+            raise RuntimeError(
+                "runtime_env['container'] needs an 'image' "
+                '(e.g. {"image": "rayproject/ray:latest"})'
+            )
+        self.activate(value, env_dir)
 
-        if _shutil.which("podman") is None and _shutil.which("docker") is None:
+    def activate(self, value: Any, env_dir: str) -> None:
+        # Validated in activate() — i.e. in EVERY worker adopting the env —
+        # not just build(): with a shared env cache a later worker can find
+        # .DONE already written, skip build(), and still have been launched
+        # unwrapped by a node without a container binary.
+        if os.environ.get("RAY_TPU_IN_CONTAINER") != "1":
             raise RuntimeError(
                 "runtime_env['container'] requires podman or docker on the "
-                "node; neither found on PATH"
+                "node spawning the worker; neither was found, so the worker "
+                "was launched unwrapped"
             )
-        raise RuntimeError(
-            "container runtime_envs need a worker-spawn integration: "
-            "register a ContainerPlugin subclass that wraps the worker "
-            "command for your container runtime"
-        )
+
+
+def container_binary() -> Optional[str]:
+    """The container runtime to wrap worker commands with.
+    RAY_TPU_CONTAINER_BINARY overrides discovery (tests point it at a shim)."""
+    exe = os.environ.get("RAY_TPU_CONTAINER_BINARY")
+    if exe:
+        return exe
+    return shutil.which("podman") or shutil.which("docker")
+
+
+def wrap_worker_command(
+    renv: Optional[Dict[str, Any]],
+    cmd: list,
+    env: Dict[str, str],
+    mounts: list,
+) -> list:
+    """Wrap a worker spawn command in `podman/docker run` when the task's
+    runtime_env requests a container (reference:
+    `_private/runtime_env/container.py` — the worker process itself runs
+    inside the container). Mounts carry the shm/session dir (object arena +
+    control socket), the framework source, and the runtime-env cache; env
+    vars the worker needs are forwarded explicitly (a container does not
+    inherit host env). Returns `cmd` unchanged when no container is requested
+    or no binary exists — in the latter case ContainerPlugin.build fails the
+    task with the real reason from inside the unwrapped worker."""
+    value = (renv or {}).get("container")
+    if not value:
+        return cmd
+    image = value.get("image") if isinstance(value, dict) else str(value)
+    exe = container_binary()
+    if exe is None or not image:
+        return cmd
+    env["RAY_TPU_IN_CONTAINER"] = "1"
+    wrapped = [exe, "run", "--rm", "--network=host"]
+    seen = set()
+    for m in list(mounts) + [CACHE_ROOT]:
+        if m and m not in seen:
+            seen.add(m)
+            wrapped += ["-v", f"{m}:{m}"]
+    for k, v in env.items():
+        if k.startswith(("RAY_TPU_", "PYTHON", "JAX_", "XLA_")):
+            wrapped += ["--env", f"{k}={v}"]
+    if isinstance(value, dict):
+        wrapped += [str(o) for o in (value.get("run_options") or [])]
+    wrapped.append(image)
+    return wrapped + cmd
 
 
 register_runtime_env_plugin("conda", CondaPlugin())

@@ -1060,9 +1060,17 @@ class Scheduler:
         envb["PYTHONPATH"] = repo_root + os.pathsep + envb.get("PYTHONPATH", "")
         blob = base64.b64encode(pickle.dumps(args)).decode()
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:8]}.log"), "wb")
+        cmd = [sys.executable, "-m", "ray_tpu._private.worker_entry",
+               "--address", self._sock_path, "--args", blob]
+        if runtime_env and runtime_env.get("container"):
+            from ray_tpu._private.runtime_env import wrap_worker_command
+
+            cmd = wrap_worker_command(
+                runtime_env, cmd, envb,
+                [node.shm_dir, self.session_dir, repo_root],
+            )
         popen = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_entry",
-             "--address", self._sock_path, "--args", blob],
+            cmd,
             env=envb,
             stdout=out,
             stderr=subprocess.STDOUT,
@@ -1118,7 +1126,12 @@ class Scheduler:
         if actor_id is None:
             node.idle.append(worker_id)
         blob = base64.b64encode(pickle.dumps(args)).decode()
-        if not node.daemon.send(("spawn_worker", {"worker_id_hex": worker_id.hex(), "args_blob": blob})):
+        info = {"worker_id_hex": worker_id.hex(), "args_blob": blob}
+        if runtime_env and runtime_env.get("container"):
+            # The daemon wraps the worker command on ITS host (binary
+            # discovery and mounts are node-local decisions).
+            info["container_env"] = runtime_env
+        if not node.daemon.send(("spawn_worker", info)):
             # Daemon unreachable: the health/reap path collects this handle and
             # the daemon-EOF path removes the node.
             wh.process.mark_dead()

@@ -299,6 +299,11 @@ class WorkerRuntime:
         # actors, `transport/concurrency_group_manager.h`).
         self.concurrency: int = 1
         self._call_queue = None
+        # Named concurrency groups: group name -> its own SimpleQueue, each
+        # drained by that group's dedicated threads. Isolation is the point:
+        # a saturated group must never block another group's calls
+        # (reference: `transport/concurrency_group_manager.h`).
+        self._group_queues: Dict[str, Any] = {}
         # Lazily-started event loop for `async def` actor methods (reference:
         # asyncio actors, `core_worker/fiber.h`).
         self._aio_loop = None
@@ -314,25 +319,36 @@ class WorkerRuntime:
         self._put_counter += 1
         return self._put_counter
 
-    def enable_concurrency(self, n: int) -> None:
+    def enable_concurrency(self, n: int, groups: Optional[Dict[str, int]] = None) -> None:
         self.concurrency = n
-        if n > 1:
+        if n > 1 or groups:
             # n daemon threads draining one queue: bounded concurrency without
             # spawning a thread per queued call, and the dispatch loop never
             # blocks (a stdlib ThreadPoolExecutor's non-daemon threads would
             # also stall interpreter exit while calls are parked in long polls).
-            self._call_queue: "queue.SimpleQueue" = queue.SimpleQueue()
+            self._call_queue = self._start_pool("default", max(1, n))
+            for gname, limit in (groups or {}).items():
+                self._group_queues[gname] = self._start_pool(gname, max(1, int(limit)))
 
-            def drain():
-                while True:
-                    fn = self._call_queue.get()
-                    fn()
+    def _start_pool(self, label: str, n: int) -> "queue.SimpleQueue":
+        q: "queue.SimpleQueue" = queue.SimpleQueue()
 
-            for i in range(n):
-                threading.Thread(target=drain, daemon=True, name=f"actor-call-{i}").start()
+        def drain():
+            while True:
+                fn = q.get()
+                fn()
 
-    def submit_call(self, fn) -> None:
-        self._call_queue.put(fn)
+        for i in range(n):
+            threading.Thread(
+                target=drain, daemon=True, name=f"actor-call-{label}-{i}"
+            ).start()
+        return q
+
+    def submit_call(self, fn, group: Optional[str] = None) -> None:
+        # Unknown group names fall back to the default pool rather than
+        # erroring inside the dispatch loop; the call still runs.
+        q = self._group_queues.get(group, self._call_queue) if group else self._call_queue
+        q.put(fn)
 
     def run_coroutine(self, coro):
         """Drive an async actor method to completion on this actor's event
@@ -481,7 +497,10 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
             cls = rt.load_function(spec.func.function_id, req.func_blob)
             rt.actor_instance = cls(*args, **kwargs)
             rt.actor_id = spec.actor_id
-            rt.enable_concurrency(getattr(spec, "max_concurrency", 1))
+            rt.enable_concurrency(
+                getattr(spec, "max_concurrency", 1),
+                getattr(spec, "concurrency_groups", None),
+            )
             worker_mod._set_current_actor_id(spec.actor_id)
             results = [None] * spec.num_returns if spec.num_returns else []
             out = None
@@ -631,7 +650,7 @@ def worker_loop(conn, args: WorkerArgs):
                 wc.cancelled.pop(req.spec.task_id.binary(), None)
             continue
         if (
-            rt.concurrency > 1
+            (rt.concurrency > 1 or rt._group_queues)
             and req.spec.actor_id is not None
             and not req.spec.is_actor_creation
             and req.spec.method_name != "__ray_terminate__"
@@ -639,7 +658,10 @@ def worker_loop(conn, args: WorkerArgs):
             # Threaded actor: bounded out-of-order execution on the actor's
             # call-thread pool (a blocked long-poll call must not stall other
             # methods; __ray_terminate__ stays on the dispatch loop).
-            rt.submit_call(lambda r=req: _execute(rt, r))
+            rt.submit_call(
+                lambda r=req: _execute(rt, r),
+                group=getattr(req.spec, "concurrency_group", None),
+            )
         else:
             # Serial dispatch: batch completion messages while more work is
             # queued locally (lease pipelining; flushed at loop top when the

@@ -28,6 +28,7 @@ _VALID_ACTOR_OPTIONS = {
     "max_restarts",
     "max_task_retries",
     "max_concurrency",
+    "concurrency_groups",
     "name",
     "namespace",
     "lifetime",
@@ -40,24 +41,31 @@ _VALID_ACTOR_OPTIONS = {
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
-                 generator_backpressure: Optional[int] = None):
+                 generator_backpressure: Optional[int] = None,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
         self._generator_backpressure = generator_backpressure
+        self._concurrency_group = concurrency_group
 
     def options(self, **opts) -> "ActorMethod":
+        # Unspecified options keep their declared (decorator) values — an
+        # .options(concurrency_group=...) call must not silently reset a
+        # @method(num_returns=2) declaration back to 1.
         return ActorMethod(
             self._handle,
             self._name,
-            opts.get("num_returns", 1),
-            opts.get("generator_backpressure"),
+            opts.get("num_returns", self._num_returns),
+            opts.get("generator_backpressure", self._generator_backpressure),
+            opts.get("concurrency_group", self._concurrency_group),
         )
 
     def remote(self, *args, **kwargs):
         return self._handle._actor_method_call(
             self._name, args, kwargs, self._num_returns,
             self._generator_backpressure,
+            concurrency_group=self._concurrency_group,
         )
 
     def __call__(self, *args, **kwargs):
@@ -69,22 +77,33 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str = "Actor",
-                 method_meta: Optional[Dict[str, int]] = None):
+                 method_meta: Optional[Dict[str, int]] = None,
+                 method_groups: Optional[Dict[str, str]] = None):
         self._actor_id = actor_id
         self._class_name = class_name
         # method name -> num_returns, collected from @ray_tpu.method decorators.
         self._method_meta = method_meta or {}
+        # method name -> declared concurrency group (@ray_tpu.method(
+        # concurrency_group=...)); .options() on the call site overrides.
+        self._method_groups = method_groups or {}
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name, self._method_meta.get(name, 1))
+        return ActorMethod(
+            self, name, self._method_meta.get(name, 1),
+            concurrency_group=self._method_groups.get(name),
+        )
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._class_name, self._method_meta))
+        return (
+            ActorHandle,
+            (self._actor_id, self._class_name, self._method_meta,
+             self._method_groups),
+        )
 
     def __hash__(self):
         return hash(self._actor_id)
@@ -93,7 +112,8 @@ class ActorHandle:
         return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
 
     def _actor_method_call(self, method_name: str, args, kwargs, num_returns,
-                           generator_backpressure: Optional[int] = None):
+                           generator_backpressure: Optional[int] = None,
+                           concurrency_group: Optional[str] = None):
         from ray_tpu.remote_function import _resolve_backpressure
 
         returns_mode = None
@@ -117,6 +137,7 @@ class ActorHandle:
             actor_id=self._actor_id,
             method_name=method_name,
             name=f"{self._class_name}.{method_name}",
+            concurrency_group=concurrency_group,
         )
         from ray_tpu.util import tracing
 
@@ -210,6 +231,11 @@ class ActorClass:
             is_actor_creation=True,
             name=f"{self._cls.__name__}.__init__",
             max_concurrency=max(1, int(opts.get("max_concurrency", 1))),
+            concurrency_groups=(
+                {str(g): int(n) for g, n in opts["concurrency_groups"].items()}
+                if opts.get("concurrency_groups")
+                else None
+            ),
             env_vars=dict(renv.get("env_vars") or {}),
             runtime_env={k: v for k, v in renv.items() if k != "env_vars"} or None,
         )
@@ -242,14 +268,22 @@ class ActorClass:
             for n, m in vars(self._cls).items()
             if callable(m) and hasattr(m, "__ray_tpu_num_returns__")
         }
-        return ActorHandle(actor_id, self._cls.__name__, method_meta)
+        method_groups = {
+            n: getattr(m, "__ray_tpu_concurrency_group__")
+            for n, m in vars(self._cls).items()
+            if callable(m) and getattr(m, "__ray_tpu_concurrency_group__", None)
+        }
+        return ActorHandle(actor_id, self._cls.__name__, method_meta, method_groups)
 
 
 def method(**opts):
-    """`@ray_tpu.method(num_returns=n)` decorator for actor methods."""
+    """`@ray_tpu.method(num_returns=n, concurrency_group="io")` decorator for
+    actor methods (reference: `python/ray/actor.py` `@ray.method`)."""
 
     def decorator(fn):
         fn.__ray_tpu_num_returns__ = opts.get("num_returns", 1)
+        if opts.get("concurrency_group"):
+            fn.__ray_tpu_concurrency_group__ = str(opts["concurrency_group"])
         return fn
 
     return decorator

@@ -170,17 +170,35 @@ class HTTPProxy:
                 version, routes = updates["routes"]
                 self._routes = routes
 
-    def _route_table(self) -> Dict[str, str]:
-        import time
-
+    def _refresh_routes(self) -> None:
+        """Pull the route table directly from the controller (the long-poll
+        push keeps it fresh in steady state; this covers the windows)."""
         import ray_tpu
+
+        self._routes = ray_tpu.get(self._controller.get_routes.remote())
+        self._routes_fetched = time.time()
+
+    def has_route(self, prefix: str) -> bool:
+        """True once this proxy's route table includes `prefix`. serve.run's
+        readiness barrier polls this so it never returns before every proxy
+        can route the new app (reference: serve.run blocks until replicas AND
+        routes are ready, `serve/api.py:460`). Misses fall through to a direct
+        controller fetch so readiness doesn't wait a long-poll round trip."""
+        if prefix in self._routes:
+            return True
+        try:
+            self._refresh_routes()
+        except Exception:
+            return False
+        return prefix in self._routes
+
+    def _route_table(self) -> Dict[str, str]:
 
         # Push keeps this fresh; the fallback fetch covers the pre-first-push
         # window, rate-limited so a legitimately empty table (no routed
         # deployments) doesn't turn every 404 into a controller round trip.
         if not self._routes and time.time() - self._routes_fetched > 2.0:
-            self._routes = ray_tpu.get(self._controller.get_routes.remote())
-            self._routes_fetched = time.time()
+            self._refresh_routes()
         return self._routes
 
     def _match(self, path: str) -> Optional[Tuple[str, bool, str]]:
@@ -188,14 +206,9 @@ class HTTPProxy:
         if match is None:
             # Miss may be push lag for a just-deployed route: refetch once,
             # rate-limited so real 404 traffic can't hammer the controller.
-            import time
-
-            import ray_tpu
-
             if time.time() - self._routes_fetched > 0.5:
                 try:
-                    self._routes = ray_tpu.get(self._controller.get_routes.remote())
-                    self._routes_fetched = time.time()
+                    self._refresh_routes()
                     match = self._match_in(path, self._routes)
                 except Exception:
                     pass

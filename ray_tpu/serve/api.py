@@ -307,6 +307,7 @@ def run(
     controller = _get_controller()
     order: List[Application] = []
     _collect_apps(target, order)
+    routed_prefixes: List[str] = []
     for app in order:
         dep = app.deployment
         resolved_args = tuple(
@@ -339,10 +340,53 @@ def run(
             is_ingress=is_ingress,
             is_asgi=hasattr(dep._target, "__serve_asgi_app__"),
         )
+        if info.route_prefix:
+            # EVERY routed deployment in this run is awaited, not just the
+            # ingress — a child with its own route_prefix is routable the
+            # moment run() returns too.
+            routed_prefixes.append(info.route_prefix)
         ray_tpu.get(controller.deploy.remote(info))
     if _blocking_http:
         _get_proxy(create=True, port=port)
+    # Readiness barrier: replicas are already live (controller.deploy blocks
+    # on __ray_ready__ per replica), but the route table reaches proxies via
+    # an async long-poll push — returning before every proxy has the route
+    # lets an immediate request 404 (reference: serve.run blocks until
+    # deployments AND routes are ready, serve/api.py:460).
+    for prefix in routed_prefixes:
+        _wait_routes_live(prefix)
     return DeploymentHandle(target.deployment.name, controller)
+
+
+def _wait_routes_live(prefix: str, timeout: float = 30.0) -> None:
+    """Block until every responsive proxy (head + per-node) can route
+    `prefix`. A proxy that never answers within the deadline (dead node,
+    crash-looping restart) is pruned from the per-node registry rather than
+    failing the deploy — the app IS live on every proxy that can serve it."""
+    named = [("head", h) for h in ([_client["proxy"]] if "proxy" in _client else [])]
+    named += [(nid, h) for nid, (h, _p) in _client.get("node_proxies", {}).items()]
+    deadline = time.time() + timeout
+    for nid, h in named:
+        responded = False
+        while True:
+            try:
+                if ray_tpu.get(h.has_route.remote(prefix)):
+                    break
+                responded = True
+            except Exception:
+                # Proxy mid-restart or dead: keep polling until the deadline.
+                pass
+            if time.time() > deadline:
+                if responded:
+                    # Reachable but still missing the route: a real push
+                    # failure the caller must hear about.
+                    raise TimeoutError(
+                        f"route {prefix!r} was not live at proxy {nid} "
+                        f"within {timeout}s"
+                    )
+                _client.get("node_proxies", {}).pop(nid, None)
+                break
+            time.sleep(0.05)
 
 
 def _coerce_autoscaling(cfg) -> Optional[AutoscalingConfig]:

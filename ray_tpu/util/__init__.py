@@ -1,3 +1,4 @@
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     PlacementGroup,
     placement_group,
@@ -10,6 +11,7 @@ from ray_tpu.util.scheduling_strategies import (
 )
 
 __all__ = [
+    "ActorPool",
     "PlacementGroup",
     "placement_group",
     "remove_placement_group",

@@ -1,0 +1,3 @@
+from ray_tpu.util.multiprocessing.pool import AsyncResult, Pool, TimeoutError
+
+__all__ = ["Pool", "AsyncResult", "TimeoutError"]

@@ -224,3 +224,45 @@ def test_crashed_named_actor_frees_its_name(ray_start_regular):
     # The name is free again.
     b = Fragile.options(name="phoenix").remote()
     assert ray_tpu.get(b.ping.remote()) == "pong"
+
+
+def test_concurrency_groups(ray_start_regular):
+    """Named concurrency groups: a saturated group must not block calls
+    routed to another group or to the default pool (reference:
+    `transport/concurrency_group_manager.h`, `@ray.method(concurrency_group)`).
+    """
+
+    @ray_tpu.remote(concurrency_groups={"slow": 1, "fast": 2})
+    class Svc:
+        def __init__(self):
+            import threading
+
+            self.ev = threading.Event()
+
+        @ray_tpu.method(concurrency_group="slow")
+        def block(self):
+            self.ev.wait(30)
+            return "unblocked"
+
+        @ray_tpu.method(concurrency_group="fast")
+        def ping(self):
+            return "pong"
+
+        def default_ping(self):
+            return "default"
+
+        def release(self):
+            self.ev.set()
+            return True
+
+    s = Svc.remote()
+    ray_tpu.get(s.__ray_ready__.remote(), timeout=30)
+    # Saturate the 1-thread "slow" group (first call runs, second queues).
+    blocked = [s.block.remote() for _ in range(2)]
+    t0 = time.time()
+    # Other groups and the default pool stay responsive.
+    assert ray_tpu.get(s.ping.remote(), timeout=10) == "pong"
+    assert ray_tpu.get(s.default_ping.remote(), timeout=10) == "default"
+    assert time.time() - t0 < 20
+    ray_tpu.get(s.release.options(concurrency_group="fast").remote(), timeout=10)
+    assert ray_tpu.get(blocked, timeout=30) == ["unblocked", "unblocked"]

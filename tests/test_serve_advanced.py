@@ -248,3 +248,22 @@ def test_streaming_http_incremental_arrival(serve_ctx):
         f"first chunk arrived at {first_t:.2f}s of {total_t:.2f}s — body was "
         "buffered, not streamed"
     )
+
+
+def test_route_live_immediately_after_run(serve_ctx):
+    """serve.run's readiness barrier: a request issued the instant run()
+    returns must never 404 — the route push to the proxy may otherwise lag
+    the deploy (reference: serve.run blocks until routes are ready)."""
+
+    @serve.deployment
+    class Hi:
+        def __call__(self, request):
+            return "hi"
+
+    for i in range(5):
+        name = f"Hi{i}"
+        serve.run(Hi.options(name=name).bind(), route_prefix=f"/hi{i}")
+        port = serve.http_port()
+        status, _body = _get(f"http://127.0.0.1:{port}/hi{i}")
+        assert status == 200
+        serve.delete(name)

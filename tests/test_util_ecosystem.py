@@ -1,0 +1,217 @@
+"""ray_tpu.util conveniences: Queue, ActorPool, multiprocessing.Pool, joblib.
+
+Reference: `python/ray/util/queue.py`, `util/actor_pool.py`,
+`util/multiprocessing/pool.py`, `util/joblib/` and their tests
+(`python/ray/tests/test_queue.py`, `test_actor_pool.py`,
+`test_multiprocessing.py`, `test_joblib.py`).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+# ---------------------------------------------------------------------- Queue
+def test_queue_basic(ray_start_regular):
+    q = Queue()
+    assert q.empty() and len(q) == 0
+    q.put(1)
+    q.put("two")
+    assert q.qsize() == 2 and not q.empty()
+    assert q.get() == 1
+    assert q.get() == "two"
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_queue_maxsize_and_batches(ray_start_regular):
+    q = Queue(maxsize=3)
+    q.put_nowait_batch([1, 2, 3])
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(4)
+    with pytest.raises(Full):
+        q.put(4, timeout=0.2)
+    with pytest.raises(Full):
+        q.put_nowait_batch([4, 5])
+    assert q.get_nowait_batch(3) == [1, 2, 3]
+    with pytest.raises(Empty):
+        q.get_nowait_batch(1)
+    q.shutdown()
+
+
+def test_queue_across_tasks(ray_start_regular):
+    """The queue handle pickles; producer and consumer tasks share state."""
+    q = Queue()
+
+    @ray_tpu.remote
+    def produce(queue, n):
+        for i in range(n):
+            queue.put(i)
+        return "done"
+
+    @ray_tpu.remote
+    def consume(queue, n):
+        return [queue.get(timeout=30) for _ in range(n)]
+
+    p = produce.remote(q, 5)
+    c = consume.remote(q, 5)
+    assert ray_tpu.get(p, timeout=60) == "done"
+    assert ray_tpu.get(c, timeout=60) == [0, 1, 2, 3, 4]
+    q.shutdown()
+
+
+def test_queue_blocking_get_unblocks_on_put(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def blocked_get(queue):
+        return queue.get(timeout=30)
+
+    ref = blocked_get.remote(q)
+    time.sleep(0.5)
+    q.put("payload")
+    assert ray_tpu.get(ref, timeout=60) == "payload"
+    q.shutdown()
+
+
+# ------------------------------------------------------------------ ActorPool
+@pytest.fixture
+def pool_actors(ray_start_regular):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, v, delay=0.0):
+            if delay:
+                time.sleep(delay)
+            return 2 * v
+
+    actors = [Doubler.remote() for _ in range(2)]
+    ray_tpu.get([a.__ray_ready__.remote() for a in actors])
+    return actors
+
+
+def test_actor_pool_map_ordered(pool_actors):
+    pool = ActorPool(pool_actors)
+    assert list(pool.map(lambda a, v: a.double.remote(v), range(8))) == [
+        2 * i for i in range(8)
+    ]
+    # The pool is reusable after a full drain.
+    assert list(pool.map(lambda a, v: a.double.remote(v), [10, 20])) == [20, 40]
+
+
+def test_actor_pool_map_unordered(pool_actors):
+    pool = ActorPool(pool_actors)
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v), range(8)))
+    assert sorted(out) == [2 * i for i in range(8)]
+
+
+def test_actor_pool_submit_get_next(pool_actors):
+    pool = ActorPool(pool_actors)
+    # Saturate beyond pool size: pending work queues and keeps indices.
+    for v in range(5):
+        pool.submit(lambda a, v: a.double.remote(v), v)
+    results = []
+    while pool.has_next():
+        results.append(pool.get_next())
+    assert results == [0, 2, 4, 6, 8]
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_actor_pool_ordered_despite_straggler(pool_actors):
+    pool = ActorPool(pool_actors)
+    # First item is slow; ordered map must still yield it first.
+    delays = [0.8, 0.0, 0.0, 0.0]
+    for i, d in enumerate(delays):
+        pool.submit(lambda a, v: a.double.remote(v[0], delay=v[1]), (i, d))
+    assert [pool.get_next(timeout=30) for _ in range(4)] == [0, 2, 4, 6]
+
+
+def test_actor_pool_push_pop(pool_actors):
+    pool = ActorPool([pool_actors[0]])
+    assert pool.has_free()
+    a = pool.pop_idle()
+    assert a is not None and not pool.has_free()
+    pool.push(a)
+    assert pool.has_free()
+    with pytest.raises(ValueError):
+        pool.push(a)
+    pool.push(pool_actors[1])
+    assert sorted(
+        pool.map(lambda a, v: a.double.remote(v), [1, 2, 3])
+    ) == [2, 4, 6]
+
+
+def test_actor_pool_get_next_timeout(pool_actors):
+    pool = ActorPool(pool_actors)
+    pool.submit(lambda a, v: a.double.remote(v, delay=5.0), 1)
+    with pytest.raises(TimeoutError):
+        pool.get_next(timeout=0.2)
+    # ignore_if_timedout swallows the timeout and returns None.
+    assert pool.get_next(timeout=0.2, ignore_if_timedout=True) is None
+    assert pool.get_next(timeout=30) == 2
+
+
+# --------------------------------------------------------- multiprocessing.Pool
+def test_mp_pool_map_apply(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(lambda x: x * x, range(10)) == [x * x for x in range(10)]
+        assert pool.apply(lambda a, b: a + b, (3, 4)) == 7
+        ar = pool.apply_async(lambda: "async")
+        assert ar.get(timeout=30) == "async" and ar.successful()
+        assert pool.starmap(lambda a, b: a * b, [(1, 2), (3, 4)]) == [2, 12]
+
+
+def test_mp_pool_imap(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert list(pool.imap(lambda x: -x, range(6), chunksize=2)) == [
+            0, -1, -2, -3, -4, -5
+        ]
+        assert sorted(pool.imap_unordered(lambda x: -x, range(6), chunksize=2)) == [
+            -5, -4, -3, -2, -1, 0
+        ]
+
+
+def test_mp_pool_initializer_and_errors(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def init(tag):
+        import os
+
+        os.environ["POOL_TAG"] = tag
+
+    with Pool(processes=2, initializer=init, initargs=("tagged",)) as pool:
+        tags = pool.map(
+            lambda _: __import__("os").environ.get("POOL_TAG"), range(4)
+        )
+        assert tags == ["tagged"] * 4
+        ar = pool.apply_async(lambda: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            ar.get(timeout=30)
+        assert ar.ready() and not ar.successful()
+    with pytest.raises(ValueError):
+        pool.map(lambda x: x, [1])  # terminated pool rejects new work
+
+
+# --------------------------------------------------------------------- joblib
+def test_joblib_backend(ray_start_regular):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=2):
+        out = joblib.Parallel()(
+            joblib.delayed(lambda x: x * 3)(i) for i in range(10)
+        )
+    assert out == [3 * i for i in range(10)]
