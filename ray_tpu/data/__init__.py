@@ -15,6 +15,7 @@ with a sliding prefetch window; `split` feeds per-host Train ingest
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
     from_arrow,
     from_items,
@@ -36,6 +37,7 @@ Datastream = Dataset  # the reference's short-lived rename (`dataset.py:169`)
 
 __all__ = [
     "DataContext",
+    "DataIterator",
     "Dataset",
     "Datastream",
     "from_arrow",

@@ -477,6 +477,26 @@ class Dataset:
             got += take
         return Dataset(out)
 
+    def streaming_split(
+        self,
+        n: int,
+        *,
+        equal: bool = False,
+        locality_hints: Optional[List[Any]] = None,
+    ) -> List["DataIterator"]:
+        """n pipelined iterators over ONE executing stream (reference:
+        `python/ray/data/dataset.py:1134 streaming_split`): blocks are
+        assigned to consumers on demand AS PRODUCED, so training overlaps
+        ingest and peak resident blocks stays bounded by the executor's
+        backpressure budgets — unlike `split`, nothing materializes up
+        front. Each iterator supports one `iter_batches()` pass per epoch;
+        epochs re-execute the plan behind an all-consumer barrier."""
+        from ray_tpu.data.iterator import make_streaming_split
+
+        return make_streaming_split(
+            self, n, equal=equal, locality_hints=locality_hints
+        )
+
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
         if equal:
             total = self.count()

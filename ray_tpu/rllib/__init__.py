@@ -12,7 +12,12 @@ function over a device mesh — grads sync via the mesh's data axis inside XLA
 (vectorized gymnasium envs); only the learner touches accelerator devices.
 """
 
-from ray_tpu.rllib.core.rl_module import MLPModule, RLModule, SquashedGaussianModule
+from ray_tpu.rllib.core.rl_module import (
+    DeterministicContinuousModule,
+    MLPModule,
+    RLModule,
+    SquashedGaussianModule,
+)
 from ray_tpu.rllib.core.learner import JaxLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.env.env_runner import EnvRunner
@@ -22,12 +27,24 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, Impala, ImpalaConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.pg import PG, PGConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.td3 import DDPGConfig, TD3, TD3Config
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    NormalizeObs,
+    UnsquashActions,
+)
+from ray_tpu.rllib.models import MODEL_DEFAULTS, ModelCatalog, register_custom_module
 
 __all__ = [
     "A2C",
@@ -38,9 +55,18 @@ __all__ = [
     "AlgorithmConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
+    "ClipActions",
+    "ClipObs",
+    "Connector",
+    "ConnectorPipeline",
+    "DDPGConfig",
     "DQN",
     "DQNConfig",
+    "DeterministicContinuousModule",
     "EnvRunner",
+    "FlattenObs",
     "IMPALA",
     "IMPALAConfig",
     "Impala",
@@ -50,8 +76,11 @@ __all__ = [
     "MARWIL",
     "MARWILConfig",
     "MLPModule",
+    "MODEL_DEFAULTS",
+    "ModelCatalog",
     "MultiAgentEnv",
     "MultiAgentEnvRunner",
+    "NormalizeObs",
     "PG",
     "PGConfig",
     "PPO",
@@ -60,5 +89,9 @@ __all__ = [
     "SAC",
     "SACConfig",
     "SquashedGaussianModule",
+    "TD3",
+    "TD3Config",
+    "UnsquashActions",
     "make_multi_agent",
+    "register_custom_module",
 ]

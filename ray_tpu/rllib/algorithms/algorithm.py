@@ -42,6 +42,20 @@ class AlgorithmConfig:
         # list of JSON-lines files, a ray_tpu.data.Dataset, an InputReader,
         # or a zero-arg callable returning an InputReader.
         self.input_: Any = None
+        # Connector specs (reference `rllib/connectors/`): a Connector
+        # instance, a factory callable, or a list of either — built fresh
+        # inside each runner actor.
+        self.env_to_module_connector: Any = None
+        self.module_to_env_connector: Any = None
+        # Evaluation (reference `.evaluation(...)`,
+        # `algorithm.py:847 evaluate()`): a dedicated eval-runner fleet
+        # sampling with its own explore setting every `evaluation_interval`
+        # training iterations for `evaluation_duration` episodes/timesteps.
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_duration: int = 10
+        self.evaluation_duration_unit: str = "episodes"
+        self.evaluation_num_env_runners: int = 1
+        self.evaluation_explore: bool = False
 
     # ------------------------------------------------------------ fluent API
     def environment(self, env=None, *, env_config: Optional[dict] = None) -> "AlgorithmConfig":
@@ -63,6 +77,8 @@ class AlgorithmConfig:
         num_env_runners: Optional[int] = None,
         num_envs_per_runner: Optional[int] = None,
         rollout_fragment_length: Optional[int] = None,
+        env_to_module_connector: Any = None,
+        module_to_env_connector: Any = None,
     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -70,6 +86,36 @@ class AlgorithmConfig:
             self.num_envs_per_runner = num_envs_per_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
+        return self
+
+    def evaluation(
+        self,
+        evaluation_interval: Optional[int] = None,
+        evaluation_duration: Optional[int] = None,
+        evaluation_duration_unit: Optional[str] = None,
+        evaluation_num_env_runners: Optional[int] = None,
+        evaluation_explore: Optional[bool] = None,
+    ) -> "AlgorithmConfig":
+        """Configure the dedicated evaluation pass (reference:
+        `AlgorithmConfig.evaluation`)."""
+        if evaluation_interval is not None:
+            self.evaluation_interval = int(evaluation_interval)
+        if evaluation_duration is not None:
+            self.evaluation_duration = int(evaluation_duration)
+        if evaluation_duration_unit is not None:
+            if evaluation_duration_unit not in ("episodes", "timesteps"):
+                raise ValueError(
+                    "evaluation_duration_unit must be 'episodes' or 'timesteps'"
+                )
+            self.evaluation_duration_unit = evaluation_duration_unit
+        if evaluation_num_env_runners is not None:
+            self.evaluation_num_env_runners = int(evaluation_num_env_runners)
+        if evaluation_explore is not None:
+            self.evaluation_explore = bool(evaluation_explore)
         return self
 
     def learners(self, num_learners: Optional[int] = None) -> "AlgorithmConfig":
@@ -209,19 +255,30 @@ class Algorithm:
             # env exists only for spaces + evaluation.
             self.env_runners = []
             return
+        self.env_runners: List[Any] = self._make_env_runners(
+            creator, config.num_env_runners, seed_base=config.seed
+        )
+
+    def _make_env_runners(self, creator, n: int, seed_base: int) -> List[Any]:
+        import ray_tpu
+        from ray_tpu.rllib.env.env_runner import EnvRunner
+
+        config = self.config
         runner_cls = ray_tpu.remote(EnvRunner)
-        self.env_runners: List[Any] = [
+        return [
             runner_cls.options(num_cpus=1).remote(
                 creator,
                 self.module,
                 num_envs=config.num_envs_per_runner,
                 rollout_length=config.rollout_fragment_length,
-                seed=config.seed + 1000 * (i + 1),
+                seed=seed_base + 1000 * (i + 1),
                 gamma=config.gamma,
                 record_final_obs=self._record_final_obs,
                 record_value_extras=self._record_value_extras,
+                obs_connector=config.env_to_module_connector,
+                action_connector=config.module_to_env_connector,
             )
-            for i in range(config.num_env_runners)
+            for i in range(n)
         ]
 
     # ------------------------------------------------------------- multi-agent
@@ -283,11 +340,16 @@ class Algorithm:
             for pid, aid in agent_of.items():
                 act_space = act_spaces[aid]
                 obs_dim = int(np.prod(obs_spaces[aid].shape))
-                if not isinstance(act_space, gym.spaces.Discrete):
-                    raise NotImplementedError(
-                        f"multi-agent supports Discrete actions; got {act_space}"
+                if isinstance(act_space, gym.spaces.Discrete):
+                    self.modules[pid] = self.make_module(obs_dim, int(act_space.n))
+                elif isinstance(act_space, gym.spaces.Box):
+                    self.modules[pid] = self.make_module_continuous(
+                        obs_dim, act_space
                     )
-                self.modules[pid] = self.make_module(obs_dim, int(act_space.n))
+                else:
+                    raise NotImplementedError(
+                        f"unsupported multi-agent action space {act_space}"
+                    )
         finally:
             probe.close()
         self.module = None
@@ -324,13 +386,22 @@ class Algorithm:
         return self.config.is_multi_agent
 
     # -------------------------------------------------------------- interface
-    def make_module(self, obs_dim: int, num_actions: int):
-        """The RLModule for this algorithm (policy-gradient default; value-
-        based algorithms override, e.g. DQN's Q-network)."""
-        from ray_tpu.rllib.core.rl_module import MLPModule
+    # What the base module kind is for Discrete action spaces; value-based
+    # algorithms (DQN) override to "q". Routed through the ModelCatalog so
+    # `config.model` (hiddens/activation/custom_module) drives architecture
+    # (reference: `rllib/models/catalog.py:197`).
+    _module_kind = "pi_vf"
 
-        return MLPModule(
-            obs_dim, num_actions, hiddens=tuple(self.config.model.get("hiddens", (64, 64)))
+    def make_module(self, obs_dim: int, num_actions: int):
+        """The RLModule for this algorithm, built by the catalog from
+        `config.model`."""
+        import gymnasium as gym
+
+        from ray_tpu.rllib.models.catalog import ModelCatalog
+
+        return ModelCatalog.get_module(
+            self._module_kind, obs_dim, gym.spaces.Discrete(num_actions),
+            self.config.model,
         )
 
     def make_module_continuous(self, obs_dim: int, act_space):
@@ -378,9 +449,140 @@ class Algorithm:
         t0 = time.time()
         self.iteration += 1
         metrics = self.training_step()
+        cfg = self.config
+        if (
+            cfg.evaluation_interval
+            and self.iteration % cfg.evaluation_interval == 0
+        ):
+            metrics["evaluation"] = self.evaluate()["evaluation"]
         metrics["training_iteration"] = self.iteration
         metrics["time_this_iter_s"] = time.time() - t0
         return metrics
+
+    # ------------------------------------------------------------- evaluation
+    def _ensure_eval_runners(self) -> List[Any]:
+        """Dedicated eval-runner fleet, built lazily on first evaluate()
+        (reference: `Algorithm.evaluate` + `evaluation_num_env_runners` —
+        evaluation never samples through the training runners)."""
+        if getattr(self, "_eval_runners", None):
+            return self._eval_runners
+        import ray_tpu
+        from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+
+        config = self.config
+        creator = config.env_creator()
+        n = max(1, config.evaluation_num_env_runners)
+        if self.is_multi_agent:
+            runner_cls = ray_tpu.remote(MultiAgentEnvRunner)
+            self._eval_runners = [
+                runner_cls.options(num_cpus=1).remote(
+                    creator,
+                    self.modules,
+                    config.policy_mapping_fn,
+                    num_envs=config.num_envs_per_runner,
+                    rollout_length=config.rollout_fragment_length,
+                    seed=config.seed + 555_000 + 1000 * i,
+                    gamma=config.gamma,
+                    lambda_=getattr(config, "lambda_", 0.95),
+                )
+                for i in range(n)
+            ]
+        else:
+            self._eval_runners = self._make_env_runners(
+                creator, n, seed_base=config.seed + 555_000
+            )
+        return self._eval_runners
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Run a dedicated evaluation pass and return {"evaluation": metrics}
+        (reference: `rllib/algorithms/algorithm.py:847 def evaluate`).
+        Samples `evaluation_duration` episodes (or timesteps) on the eval
+        fleet with `evaluation_explore` (deterministic by default), entirely
+        separate from training rollouts."""
+        import ray_tpu
+
+        cfg = self.config
+        runners = self._ensure_eval_runners()
+        if self.is_multi_agent:
+            weights = {
+                pid: lg.get_weights() for pid, lg in self.learner_groups.items()
+            }
+        else:
+            weights = self.learner_group.get_weights()
+        sync = [r.set_weights.remote(weights) for r in runners]
+        # Exploration schedules live in the driver (DQN epsilon): push the
+        # current value so evaluation_explore=True measures the schedule's
+        # policy, not a fresh runner's epsilon=1.0 uniform-random default.
+        if cfg.evaluation_explore and callable(getattr(self, "epsilon", None)):
+            sync += [r.set_exploration.remote(self.epsilon()) for r in runners]
+        # Eval runners adopt the training runners' connector state, frozen,
+        # so normalization matches training without polluting its stats.
+        if not self.is_multi_agent and self.env_runners and cfg.env_to_module_connector:
+            state = ray_tpu.get(self.env_runners[0].get_connector_state.remote())
+            sync += [
+                r.set_connector_state.remote(state, freeze=True) for r in runners
+            ]
+        ray_tpu.get(sync)
+        # Drop episodes left over from a previous evaluate() round.
+        ray_tpu.get([r.episode_stats.remote(clear=True) for r in runners])
+
+        episodes = 0
+        steps = 0
+        ret_sum = 0.0
+        len_sum = 0.0
+        ret_min, ret_max = float("inf"), float("-inf")
+        target = max(1, cfg.evaluation_duration)
+        by_episodes = cfg.evaluation_duration_unit == "episodes"
+        rounds = 0
+        while True:
+            rounds += 1
+            samples = ray_tpu.get(
+                [r.sample.remote(explore=cfg.evaluation_explore) for r in runners]
+            )
+            stats = ray_tpu.get([r.episode_stats.remote(clear=True) for r in runners])
+            for ro in samples:
+                if "rewards" in ro and not isinstance(ro.get("rewards"), dict):
+                    steps += int(np.asarray(ro["rewards"]).size)
+                else:
+                    # Multi-agent: per-policy column dicts. PG maps carry
+                    # advantages; replay maps carry rewards — count whichever
+                    # exists.
+                    steps += sum(
+                        int(
+                            np.asarray(
+                                cols["rewards"] if "rewards" in cols
+                                else cols["advantages"]
+                            ).size
+                        )
+                        for cols in ro.values()
+                    )
+            for s in stats:
+                n = int(s.get("episodes", 0))
+                if n:
+                    episodes += n
+                    ret_sum += s["episode_return_mean"] * n
+                    len_sum += s.get("episode_len_mean", 0.0) * n
+                    ret_min = min(ret_min, s.get("episode_return_min", s["episode_return_mean"]))
+                    ret_max = max(ret_max, s.get("episode_return_max", s["episode_return_mean"]))
+            if by_episodes:
+                if episodes >= target:
+                    break
+            elif steps >= target:
+                break
+            if rounds >= 100:
+                # A degenerate env that never finishes an episode must not
+                # hang evaluation forever.
+                break
+        metrics: Dict[str, Any] = {
+            "num_episodes": episodes,
+            "num_env_steps_sampled": steps,
+        }
+        if episodes:
+            metrics["episode_return_mean"] = ret_sum / episodes
+            metrics["episode_len_mean"] = len_sum / episodes
+            metrics["episode_return_min"] = ret_min
+            metrics["episode_return_max"] = ret_max
+        return {"evaluation": metrics}
 
     # ------------------------------------------------------------ checkpoints
     def _extra_state(self) -> Dict[str, Any]:
@@ -423,7 +625,7 @@ class Algorithm:
     def stop(self) -> None:
         import ray_tpu
 
-        for r in self.env_runners:
+        for r in list(self.env_runners) + list(getattr(self, "_eval_runners", [])):
             try:
                 ray_tpu.kill(r)
             except Exception:

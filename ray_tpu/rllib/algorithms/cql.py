@@ -1,0 +1,222 @@
+"""CQL: conservative Q-learning — offline continuous-control RL.
+
+Reference: `rllib/algorithms/cql/cql.py` (CQLConfig over SAC:
+`min_q_weight=5.0, bc_iters=20000, temperature=1.0, num_actions=10`,
+offline-only input) and the loss in `cql_torch_policy.py` (SAC objectives +
+the CQL(H) regularizer: logsumexp over Q at sampled actions minus Q at the
+dataset action, pushing Q down on out-of-distribution actions so the policy
+can't exploit extrapolation error — the reason vanilla SAC diverges offline).
+
+TPU-first shape: one pure jitted loss = SAC critic/actor/temperature terms +
+the conservative penalty. The penalty's action samples (uniform random and
+fresh policy samples at s and s') are PRE-DRAWN on the host and ride the
+batch as (B, R, act_dim) tensors, so the jitted program stays RNG-free and
+shards over remote learners exactly like every other loss here. Q towers
+evaluate the (B, R) sample fan with one broadcast matmul — MXU-friendly,
+no python loop over samples.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac import SACConfig, make_sac_loss
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.min_q_weight = 5.0
+        self.cql_num_actions = 4  # R samples per source (random/pi/pi')
+        self.train_batch_size = 256
+        self.updates_per_iteration = 16
+        self.num_env_runners = 0
+        self._algo_cls = CQL
+
+
+def make_cql_loss(config: CQLConfig, target_entropy: float) -> Callable:
+    sac_loss = make_sac_loss(config, target_entropy)
+    min_q_weight = float(config.min_q_weight)
+
+    def loss(module, params, batch, extra):
+        import jax
+        import jax.numpy as jnp
+
+        total, aux = sac_loss(module, params, batch, extra)
+
+        # --- conservative penalty (CQL(H)) ---------------------------------
+        # Q over the sample fan: uniform-random actions plus fresh policy
+        # samples at s and s', importance-corrected (uniform density for the
+        # random fan, policy logp for the sampled fans — `cql_torch_policy`).
+        B, R, act_dim = batch["cql_random_actions"].shape
+        obs_fan = jnp.broadcast_to(
+            batch["obs"][:, None, :], (B, R, batch["obs"].shape[-1])
+        )
+        next_fan = jnp.broadcast_to(
+            batch["next_obs"][:, None, :], (B, R, batch["next_obs"].shape[-1])
+        )
+        a_rand = batch["cql_random_actions"]
+        a_pi, logp_pi = module.sample(params, obs_fan, batch["cql_noise_pi"])
+        a_next, logp_next = module.sample(params, next_fan, batch["cql_noise_next"])
+        # log-density of uniform over the action box.
+        log_unif = -float(np.sum(np.log(module.act_high - module.act_low + 1e-8)))
+        sg = jax.lax.stop_gradient
+        penalties = {}
+        for tower in ("q1", "q2"):
+            q_rand = module.q_values(params[tower], obs_fan, a_rand)
+            q_pi = module.q_values(params[tower], obs_fan, sg(a_pi))
+            q_next = module.q_values(params[tower], obs_fan, sg(a_next))
+            cat = jnp.concatenate(
+                [
+                    q_rand - log_unif,
+                    q_pi - sg(logp_pi),
+                    q_next - sg(logp_next),
+                ],
+                axis=1,
+            )
+            lse = jax.scipy.special.logsumexp(cat, axis=1) - jnp.log(3.0 * R)
+            q_data = module.q_values(params[tower], batch["obs"], batch["actions"])
+            penalties[tower] = jnp.mean(lse - q_data)
+        cql_term = min_q_weight * (penalties["q1"] + penalties["q2"])
+        aux = dict(aux)
+        aux["cql_penalty"] = (penalties["q1"] + penalties["q2"]) / 2.0
+        return total + cql_term, aux
+
+    return loss
+
+
+class CQL(Algorithm):
+    """Offline: batches come from `config.offline_data(input_=...)` with
+    obs/actions/rewards/next_obs (or new_obs)/dones columns; no sampling
+    actors are built. `evaluate()` (base Algorithm) rolls the learned policy
+    in the config env with dedicated eval runners."""
+
+    _needs_env_runners = False
+
+    def __init__(self, config: CQLConfig):
+        super().__init__(config)
+        self.reader = config.build_input_reader(
+            batch_size=config.train_batch_size, seed=config.seed
+        )
+        self.num_updates = 0
+        self._rng = np.random.default_rng(config.seed)
+        w = self.learner_group.get_weights()
+        self.learner_group.set_extra({"q1": w["q1"], "q2": w["q2"]})
+
+    def make_module_continuous(self, obs_dim: int, act_space):
+        from ray_tpu.rllib.models.catalog import ModelCatalog
+
+        self._target_entropy = (
+            self.config.target_entropy
+            if self.config.target_entropy is not None
+            else -float(np.prod(act_space.shape))
+        )
+        return ModelCatalog.get_module(
+            "squashed_gaussian", obs_dim, act_space, self.config.model
+        )
+
+    def make_module(self, obs_dim: int, num_actions: int):
+        raise NotImplementedError("CQL targets continuous (Box) action spaces")
+
+    def make_loss(self) -> Callable:
+        return make_cql_loss(self.config, self._target_entropy)
+
+    def make_optimizer(self):
+        import optax
+
+        return optax.chain(
+            optax.clip_by_global_norm(self.config.grad_clip),
+            optax.adam(self.config.lr),
+        )
+
+    def make_extra_update(self) -> Callable:
+        tau = self.config.tau
+
+        def polyak(new_params, extra):
+            import jax
+
+            online = {"q1": new_params["q1"], "q2": new_params["q2"]}
+            return jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o, extra, online
+            )
+
+        return polyak
+
+    # ----------------------------------------------------------- one iteration
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        act_dim = self.module.act_dim
+        low, high = self.module.act_low, self.module.act_high
+        R = int(cfg.cql_num_actions)
+        metrics_acc: List[Dict[str, float]] = []
+        for _ in range(max(1, cfg.updates_per_iteration)):
+            raw = dict(self.reader.next())
+            batch = self._prep_batch(raw, cfg.train_batch_size)
+            B = len(batch["rewards"])
+            batch["noise_next"] = self._rng.standard_normal(
+                (B, act_dim)
+            ).astype(np.float32)
+            batch["noise_pi"] = self._rng.standard_normal(
+                (B, act_dim)
+            ).astype(np.float32)
+            batch["cql_random_actions"] = self._rng.uniform(
+                low, high, (B, R, act_dim)
+            ).astype(np.float32)
+            batch["cql_noise_pi"] = self._rng.standard_normal(
+                (B, R, act_dim)
+            ).astype(np.float32)
+            batch["cql_noise_next"] = self._rng.standard_normal(
+                (B, R, act_dim)
+            ).astype(np.float32)
+            metrics_acc.append(self.learner_group.update(batch))
+            self.num_updates += 1
+        out = {
+            k: float(np.mean([m[k] for m in metrics_acc])) for k in metrics_acc[0]
+        }
+        out["num_updates"] = self.num_updates
+        out["num_env_steps_trained"] = (
+            max(1, cfg.updates_per_iteration) * cfg.train_batch_size
+        )
+        return out
+
+    @staticmethod
+    def _prep_batch(raw: Dict[str, np.ndarray], batch_size: int) -> Dict[str, np.ndarray]:
+        next_obs = raw.get("next_obs", raw.get("new_obs"))
+        if next_obs is None:
+            raise ValueError(
+                "CQL needs next_obs (or new_obs) in the offline data"
+            )
+        dones = raw.get("terminateds", raw.get("dones"))
+        if dones is None:
+            raise ValueError("CQL needs terminateds/dones in the offline data")
+        batch = {
+            "obs": np.asarray(raw["obs"], np.float32),
+            "actions": np.asarray(raw["actions"], np.float32),
+            "rewards": np.asarray(raw["rewards"], np.float32),
+            "next_obs": np.asarray(next_obs, np.float32),
+            "terminateds": np.asarray(dones, np.float32),
+        }
+        n = len(batch["rewards"])
+        if n > batch_size:
+            batch = {k: v[:batch_size] for k, v in batch.items()}
+        return batch
+
+    # -------------------------------------------------------------- checkpoint
+    def _extra_state(self) -> Dict[str, Any]:
+        import jax
+
+        return {
+            "targets": jax.tree.map(
+                lambda x: np.asarray(x), self.learner_group.get_extra()
+            ),
+            "num_updates": self.num_updates,
+        }
+
+    def _load_extra_state(self, state: Dict[str, Any]) -> None:
+        if state.get("targets") is not None:
+            self.learner_group.set_extra(state["targets"])
+        self.num_updates = int(state.get("num_updates", 0))

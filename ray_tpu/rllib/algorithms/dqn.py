@@ -16,7 +16,7 @@ schedule held by the driver and pushed to runners as a traced scalar.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -111,26 +111,92 @@ def make_dqn_loss(config: DQNConfig) -> Callable:
     return loss
 
 
+def replay_ma_training_step(
+    algo: Algorithm,
+    *,
+    exploration: Optional[float] = None,
+    batch_extras: Optional[Callable[[str, Dict[str, np.ndarray]], None]] = None,
+    after_update: Optional[Callable[[], None]] = None,
+) -> Dict[str, Any]:
+    """Shared multi-agent replay iteration for value-based algorithms
+    (DQN, SAC): per-policy transition batches from the runners' replay mode
+    feed per-policy buffers and learner updates. `exploration` pushes a
+    driver-held schedule value (DQN epsilon); `batch_extras(pid, batch)`
+    injects per-update columns (SAC noise); `after_update()` runs after each
+    learner update (DQN target sync)."""
+    import ray_tpu
+
+    cfg = algo.config
+    weights = {pid: lg.get_weights() for pid, lg in algo.learner_groups.items()}
+    sync = [r.set_weights.remote(weights) for r in algo.env_runners]
+    if exploration is not None:
+        sync += [r.set_exploration.remote(exploration) for r in algo.env_runners]
+    ray_tpu.get(sync)
+    samples = ray_tpu.get([r.sample.remote() for r in algo.env_runners])
+    for s in samples:
+        for pid, cols in s.items():
+            algo.buffers[pid].add(
+                {
+                    k: np.asarray(
+                        v, None if k == "actions" else np.float32
+                    )
+                    for k, v in cols.items()
+                }
+            )
+            algo.env_steps += int(np.asarray(cols["rewards"]).size)
+    out: Dict[str, Any] = {"num_env_steps_sampled": algo.env_steps}
+    if exploration is not None:
+        out["epsilon"] = exploration
+    train_set = cfg.policies_to_train or list(algo.learner_groups)
+    for pid, lg in algo.learner_groups.items():
+        buf = algo.buffers[pid]
+        out[f"policy_{pid}/buffer_size"] = buf.size
+        if pid not in train_set or buf.size < cfg.learning_starts:
+            continue
+        acc: List[Dict[str, float]] = []
+        for _ in range(cfg.updates_per_iteration):
+            batch = buf.sample(cfg.train_batch_size, algo._rng)
+            if batch_extras is not None:
+                batch_extras(pid, batch)
+            acc.append(lg.update(batch))
+            algo.num_updates += 1
+            if after_update is not None:
+                after_update()
+        for k in acc[0]:
+            out[f"policy_{pid}/{k}"] = float(np.mean([m[k] for m in acc]))
+    return algo.collect_episode_metrics(out)
+
+
 class DQN(Algorithm):
+    # Policy-map training via MultiAgentEnvRunner's replay mode (per-policy
+    # transition batches -> per-policy buffers/targets).
+    _supports_multi_agent = True
+
     def __init__(self, config: DQNConfig):
         super().__init__(config)
-        self.buffer = ReplayBuffer(config.buffer_capacity)
+        if self.is_multi_agent:
+            self.buffers = {
+                pid: ReplayBuffer(config.buffer_capacity) for pid in self.modules
+            }
+        else:
+            self.buffer = ReplayBuffer(config.buffer_capacity)
         self.num_updates = 0
         self.env_steps = 0
         self._rng = np.random.default_rng(config.seed)
         self._sync_target()
 
     def _sync_target(self) -> None:
+        if self.is_multi_agent:
+            self.target_params = {}
+            for pid, lg in self.learner_groups.items():
+                self.target_params[pid] = lg.get_weights()
+                lg.set_extra({"target_params": self.target_params[pid]})
+            return
         self.target_params = self.learner_group.get_weights()
         self.learner_group.set_extra({"target_params": self.target_params})
 
-    def make_module(self, obs_dim: int, num_actions: int):
-        from ray_tpu.rllib.core.rl_module import QMLPModule
-
-        return QMLPModule(
-            obs_dim, num_actions,
-            hiddens=tuple(self.config.model.get("hiddens", (64, 64))),
-        )
+    # Q-network module from the catalog (epsilon-greedy exploration).
+    _module_kind = "q"
 
     def make_loss(self) -> Callable:
         return make_dqn_loss(self.config)
@@ -150,9 +216,20 @@ class DQN(Algorithm):
         return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
 
     # ----------------------------------------------------------- one iteration
+    def _training_step_multi_agent(self) -> Dict[str, Any]:
+        def sync_on_schedule():
+            if self.num_updates % self.config.target_network_update_freq == 0:
+                self._sync_target()
+
+        return replay_ma_training_step(
+            self, exploration=self.epsilon(), after_update=sync_on_schedule
+        )
+
     def training_step(self) -> Dict[str, Any]:
         import ray_tpu
 
+        if self.is_multi_agent:
+            return self._training_step_multi_agent()
         cfg = self.config
         weights = self.learner_group.get_weights()
         eps = self.epsilon()
@@ -225,6 +302,10 @@ class DQN(Algorithm):
     def _load_extra_state(self, state: Dict[str, Any]) -> None:
         if "target_params" in state:
             self.target_params = state["target_params"]
-            self.learner_group.set_extra({"target_params": self.target_params})
+            if self.is_multi_agent:
+                for pid, lg in self.learner_groups.items():
+                    lg.set_extra({"target_params": self.target_params[pid]})
+            else:
+                self.learner_group.set_extra({"target_params": self.target_params})
         self.num_updates = int(state.get("num_updates", 0))
         self.env_steps = int(state.get("env_steps", 0))

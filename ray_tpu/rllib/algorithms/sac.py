@@ -94,29 +94,41 @@ def make_sac_loss(config: SACConfig, target_entropy: float) -> Callable:
 
 
 class SAC(Algorithm):
+    # Policy-map training via MultiAgentEnvRunner's replay mode (continuous
+    # Box agents; per-policy buffers/targets).
+    _supports_multi_agent = True
+
     def __init__(self, config: SACConfig):
         super().__init__(config)
-        self.buffer = ReplayBuffer(config.buffer_capacity)
         self.num_updates = 0
         self.env_steps = 0
         self._rng = np.random.default_rng(config.seed)
         # Target twins start as copies of the online critics.
-        w = self.learner_group.get_weights()
-        self.learner_group.set_extra({"q1": w["q1"], "q2": w["q2"]})
+        if self.is_multi_agent:
+            self.buffers = {
+                pid: ReplayBuffer(config.buffer_capacity) for pid in self.modules
+            }
+            for lg in self.learner_groups.values():
+                w = lg.get_weights()
+                lg.set_extra({"q1": w["q1"], "q2": w["q2"]})
+        else:
+            self.buffer = ReplayBuffer(config.buffer_capacity)
+            w = self.learner_group.get_weights()
+            self.learner_group.set_extra({"q1": w["q1"], "q2": w["q2"]})
 
     def make_module_continuous(self, obs_dim: int, act_space):
-        from ray_tpu.rllib.core.rl_module import SquashedGaussianModule
+        from ray_tpu.rllib.models.catalog import ModelCatalog
 
+        # Multi-agent note: make_loss() reads the LAST value set here; with
+        # heterogeneous Box shapes across policies, pass an explicit
+        # config.target_entropy.
         self._target_entropy = (
             self.config.target_entropy
             if self.config.target_entropy is not None
             else -float(np.prod(act_space.shape))
         )
-        return SquashedGaussianModule(
-            obs_dim,
-            act_space.low,
-            act_space.high,
-            hiddens=tuple(self.config.model.get("hiddens", (256, 256))),
+        return ModelCatalog.get_module(
+            "squashed_gaussian", obs_dim, act_space, self.config.model
         )
 
     def make_module(self, obs_dim: int, num_actions: int):
@@ -149,9 +161,26 @@ class SAC(Algorithm):
         return polyak
 
     # ----------------------------------------------------------- one iteration
+    def _training_step_multi_agent(self) -> Dict[str, Any]:
+        from ray_tpu.rllib.algorithms.dqn import replay_ma_training_step
+
+        def add_noise(pid: str, batch: Dict[str, np.ndarray]) -> None:
+            act_dim = self.modules[pid].act_dim
+            B = len(batch["rewards"])
+            batch["noise_next"] = self._rng.standard_normal(
+                (B, act_dim)
+            ).astype(np.float32)
+            batch["noise_pi"] = self._rng.standard_normal(
+                (B, act_dim)
+            ).astype(np.float32)
+
+        return replay_ma_training_step(self, batch_extras=add_noise)
+
     def training_step(self) -> Dict[str, Any]:
         import ray_tpu
 
+        if self.is_multi_agent:
+            return self._training_step_multi_agent()
         cfg = self.config
         weights = self.learner_group.get_weights()
         ray_tpu.get([r.set_weights.remote(weights) for r in self.env_runners])
@@ -187,16 +216,27 @@ class SAC(Algorithm):
     def _extra_state(self) -> Dict[str, Any]:
         import jax
 
-        return {
-            "targets": jax.tree.map(
+        if self.is_multi_agent:
+            targets = {
+                pid: jax.tree.map(lambda x: np.asarray(x), lg.get_extra())
+                for pid, lg in self.learner_groups.items()
+            }
+        else:
+            targets = jax.tree.map(
                 lambda x: np.asarray(x), self.learner_group.get_extra()
-            ),
+            )
+        return {
+            "targets": targets,
             "num_updates": self.num_updates,
             "env_steps": self.env_steps,
         }
 
     def _load_extra_state(self, state: Dict[str, Any]) -> None:
         if state.get("targets") is not None:
-            self.learner_group.set_extra(state["targets"])
+            if self.is_multi_agent:
+                for pid, lg in self.learner_groups.items():
+                    lg.set_extra(state["targets"][pid])
+            else:
+                self.learner_group.set_extra(state["targets"])
         self.num_updates = int(state.get("num_updates", 0))
         self.env_steps = int(state.get("env_steps", 0))

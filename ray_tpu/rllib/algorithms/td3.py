@@ -1,0 +1,219 @@
+"""TD3: twin-delayed deep deterministic policy gradient (continuous control).
+
+Reference: `rllib/algorithms/td3/td3.py` (TD3Config over DDPG:
+`twin_q=True, policy_delay=2, smooth_target_policy=True,
+target_noise=0.2, target_noise_clip=0.5, critic_lr=1e-3, actor_lr=1e-3,
+tau=5e-3`) and the loss in `ddpg_torch_policy.py` (critic: mse on
+Q(s,a) - y with y = r + gamma * min twin target Q(s', pi_t(s') + clipped
+noise); actor: -Q1(s, pi(s)); delayed policy updates). DDPG is the
+degenerate config (policy_delay=1, no smoothing, single Q).
+
+TPU-first shape: both objectives are ONE pure jitted loss with
+stop-gradients carving the actor/critic split; the delayed policy update
+rides as a 0/1 `actor_weight` batch column (shape-stable — no recompile on
+the delay schedule); target policy smoothing noise is pre-drawn on the host
+and clipped inside the jitted loss; all three target nets live in the
+learner's replicated extra state with the polyak blend in `extra_update_fn`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, ReplayBuffer
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.tau = 5e-3
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1_000
+        self.train_batch_size = 128
+        self.updates_per_iteration = 64
+        self.policy_delay = 2
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+        self.explore_noise = 0.1
+        self.grad_clip = 10.0
+        self.model = {"hiddens": (256, 256)}
+        self._algo_cls = TD3
+
+    def training(self, **kwargs) -> "TD3Config":
+        aliases = {"smooth_target_policy": None}  # accepted, always on
+        kwargs = {k: v for k, v in kwargs.items() if k not in aliases}
+        super().training(**kwargs)
+        return self
+
+
+def make_td3_loss(config: TD3Config) -> Callable:
+    gamma = config.gamma
+    noise_clip = float(config.target_noise_clip)
+
+    def loss(module, params, batch, extra):
+        import jax
+        import jax.numpy as jnp
+
+        sg = jax.lax.stop_gradient
+
+        # --- critic: smoothed deterministic target action ------------------
+        smooth = jnp.clip(batch["target_noise"], -noise_clip, noise_clip)
+        # `extra` is params-shaped ({"pi","q1","q2"}): module.pi reads its
+        # "pi" tower directly.
+        a_next = jnp.clip(
+            module.pi(extra, batch["next_obs"]) + smooth * module.scale,
+            module.act_low,
+            module.act_high,
+        )
+        q1t = module.q_values(extra["q1"], batch["next_obs"], a_next)
+        q2t = module.q_values(extra["q2"], batch["next_obs"], a_next)
+        y = sg(
+            batch["rewards"]
+            + gamma * (1.0 - batch["terminateds"]) * jnp.minimum(q1t, q2t)
+        )
+        q1 = module.q_values(params["q1"], batch["obs"], batch["actions"])
+        q2 = module.q_values(params["q2"], batch["obs"], batch["actions"])
+        critic_loss = jnp.mean(jnp.square(q1 - y)) + jnp.mean(jnp.square(q2 - y))
+
+        # --- actor: through frozen critics, gated by the delay column ------
+        a_pi = module.pi(params, batch["obs"])
+        actor_obj = -jnp.mean(module.q_values(sg(params["q1"]), batch["obs"], a_pi))
+        # actor_weight is all-ones on policy-update rounds, all-zeros
+        # otherwise (a per-row column so remote-learner batch slicing works).
+        actor_gate = jnp.mean(batch["actor_weight"])
+        total = critic_loss + actor_gate * actor_obj
+        aux = {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_obj,
+            "q_mean": jnp.mean(q1),
+            "td_error_mean": jnp.mean(jnp.abs(q1 - y)),
+        }
+        return total, aux
+
+    return loss
+
+
+class TD3(Algorithm):
+    def __init__(self, config: TD3Config):
+        super().__init__(config)
+        self.buffer = ReplayBuffer(config.buffer_capacity)
+        self.num_updates = 0
+        self.env_steps = 0
+        self._rng = np.random.default_rng(config.seed)
+        # Targets start as copies of the online nets (all three towers).
+        w = self.learner_group.get_weights()
+        self.learner_group.set_extra(
+            {"pi": w["pi"], "q1": w["q1"], "q2": w["q2"]}
+        )
+
+    def make_module_continuous(self, obs_dim: int, act_space):
+        from ray_tpu.rllib.models.catalog import ModelCatalog
+
+        module = ModelCatalog.get_module(
+            "deterministic_continuous", obs_dim, act_space, self.config.model
+        )
+        module.explore_noise = float(self.config.explore_noise)
+        return module
+
+    def make_module(self, obs_dim: int, num_actions: int):
+        raise NotImplementedError(
+            "TD3 targets continuous (Box) action spaces"
+        )
+
+    def make_loss(self) -> Callable:
+        return make_td3_loss(self.config)
+
+    def make_optimizer(self):
+        import optax
+
+        return optax.chain(
+            optax.clip_by_global_norm(self.config.grad_clip),
+            optax.adam(self.config.lr),
+        )
+
+    def make_extra_update(self) -> Callable:
+        tau = self.config.tau
+
+        def polyak(new_params, extra):
+            import jax
+
+            online = {
+                "pi": new_params["pi"],
+                "q1": new_params["q1"],
+                "q2": new_params["q2"],
+            }
+            return jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o, extra, online
+            )
+
+        return polyak
+
+    # ----------------------------------------------------------- one iteration
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.config
+        weights = self.learner_group.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.env_runners])
+        rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
+        for ro in rollouts:
+            self.buffer.add(DQN._transitions(ro))
+            self.env_steps += int(ro["rewards"].size)
+
+        out: Dict[str, Any] = {
+            "buffer_size": self.buffer.size,
+            "num_env_steps_sampled": self.env_steps,
+        }
+        act_dim = self.module.act_dim
+        if self.buffer.size >= cfg.learning_starts:
+            metrics_acc: List[Dict[str, float]] = []
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size, self._rng)
+                B = len(batch["rewards"])
+                batch["target_noise"] = (
+                    self._rng.standard_normal((B, act_dim)).astype(np.float32)
+                    * cfg.target_noise
+                )
+                gate = 1.0 if self.num_updates % cfg.policy_delay == 0 else 0.0
+                batch["actor_weight"] = np.full(B, gate, np.float32)
+                metrics_acc.append(self.learner_group.update(batch))
+                self.num_updates += 1
+            out.update(
+                {k: float(np.mean([m[k] for m in metrics_acc])) for k in metrics_acc[0]}
+            )
+        return self.collect_episode_metrics(out)
+
+    # -------------------------------------------------------------- checkpoint
+    def _extra_state(self) -> Dict[str, Any]:
+        import jax
+
+        return {
+            "targets": jax.tree.map(
+                lambda x: np.asarray(x), self.learner_group.get_extra()
+            ),
+            "num_updates": self.num_updates,
+            "env_steps": self.env_steps,
+        }
+
+    def _load_extra_state(self, state: Dict[str, Any]) -> None:
+        if state.get("targets") is not None:
+            self.learner_group.set_extra(state["targets"])
+        self.num_updates = int(state.get("num_updates", 0))
+        self.env_steps = int(state.get("env_steps", 0))
+
+
+class DDPGConfig(TD3Config):
+    """DDPG as the degenerate TD3 (reference: `rllib/algorithms/ddpg/` —
+    TD3 is DDPG + twin critics + delay + smoothing; running TD3's machinery
+    with policy_delay=1 and no smoothing noise recovers DDPG's update)."""
+
+    def __init__(self):
+        super().__init__()
+        self.policy_delay = 1
+        self.target_noise = 0.0
+        self.target_noise_clip = 0.0

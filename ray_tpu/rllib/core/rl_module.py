@@ -36,14 +36,39 @@ def mlp_init(key, sizes, final_scale: float = 1.0):
     return layers
 
 
-def mlp_forward(layers, x):
-    """Run an mlp_init tower: tanh between layers, linear final layer."""
-    import jax.numpy as jnp
+_ACTIVATIONS = {}
 
+
+def _activation(name: str):
+    """Resolve an activation name to a jax fn (cached; import-light)."""
+    fn = _ACTIVATIONS.get(name)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        table = {
+            "tanh": jnp.tanh,
+            "relu": jax.nn.relu,
+            "silu": jax.nn.silu,
+            "swish": jax.nn.silu,
+            "elu": jax.nn.elu,
+            "gelu": jax.nn.gelu,
+        }
+        if name not in table:
+            raise ValueError(
+                f"unknown activation {name!r}; one of {sorted(table)}"
+            )
+        fn = _ACTIVATIONS[name] = table[name]
+    return fn
+
+
+def mlp_forward(layers, x, activation: str = "tanh"):
+    """Run an mlp_init tower: `activation` between layers, linear final."""
+    act = _activation(activation)
     for i, lyr in enumerate(layers):
         x = x @ lyr["w"] + lyr["b"]
         if i < len(layers) - 1:
-            x = jnp.tanh(x)
+            x = act(x)
     return x
 
 
@@ -88,16 +113,18 @@ class QMLPModule(RLModule):
     # Replay-trained: the runner skips logp/value/dist buffers entirely.
     off_policy = True
 
-    def __init__(self, obs_dim: int, num_actions: int, hiddens: Sequence[int] = (64, 64)):
+    def __init__(self, obs_dim: int, num_actions: int, hiddens: Sequence[int] = (64, 64),
+                 activation: str = "tanh"):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
         self.hiddens = tuple(hiddens)
+        self.activation = activation
 
     def init(self, key):
         return {"q": mlp_init(key, (self.obs_dim, *self.hiddens, self.num_actions))}
 
     def forward(self, params, obs):
-        q = mlp_forward(params["q"], obs)
+        q = mlp_forward(params["q"], obs, self.activation)
         return q, q.max(axis=-1)
 
     def epsilon_greedy(self, params, obs, key, explore: bool, epsilon):
@@ -121,10 +148,11 @@ class MLPModule(RLModule):
     """Policy + value MLP with shared-nothing towers (categorical actions)."""
 
     def __init__(self, obs_dim: int, num_actions: int,
-                 hiddens: Sequence[int] = (64, 64)):
+                 hiddens: Sequence[int] = (64, 64), activation: str = "tanh"):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
         self.hiddens = tuple(hiddens)
+        self.activation = activation
 
     def init(self, key):
         import jax
@@ -137,8 +165,8 @@ class MLPModule(RLModule):
         }
 
     def forward(self, params, obs):
-        logits = mlp_forward(params["pi"], obs)
-        value = mlp_forward(params["vf"], obs)[..., 0]
+        logits = mlp_forward(params["pi"], obs, self.activation)
+        value = mlp_forward(params["vf"], obs, self.activation)[..., 0]
         return logits, value
 
 
@@ -156,7 +184,7 @@ class SquashedGaussianModule(RLModule):
     LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
 
     def __init__(self, obs_dim: int, act_low, act_high,
-                 hiddens: Sequence[int] = (256, 256)):
+                 hiddens: Sequence[int] = (256, 256), activation: str = "tanh"):
         self.obs_dim = obs_dim
         self.act_low = np.asarray(act_low, np.float32)
         self.act_high = np.asarray(act_high, np.float32)
@@ -164,6 +192,7 @@ class SquashedGaussianModule(RLModule):
         self.center = (self.act_high + self.act_low) / 2.0
         self.scale = (self.act_high - self.act_low) / 2.0
         self.hiddens = tuple(hiddens)
+        self.activation = activation
 
     def init(self, key):
         import jax
@@ -181,7 +210,7 @@ class SquashedGaussianModule(RLModule):
     def dist_params(self, params, obs):
         import jax.numpy as jnp
 
-        out = mlp_forward(params["pi"], obs)
+        out = mlp_forward(params["pi"], obs, self.activation)
         mean, log_std = jnp.split(out, 2, axis=-1)
         log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
         return mean, log_std
@@ -211,7 +240,7 @@ class SquashedGaussianModule(RLModule):
 
         a = (action_env - self.center) / self.scale
         x = jnp.concatenate([obs, a], axis=-1)
-        return mlp_forward(q_params, x)[..., 0]
+        return mlp_forward(q_params, x, self.activation)[..., 0]
 
     # ----------------------------------------------------------- runner hooks
     def forward(self, params, obs):
@@ -237,3 +266,73 @@ class SquashedGaussianModule(RLModule):
         dist = jnp.concatenate([mean, log_std], axis=-1)
         value = self.q_values(params["q1"], obs, action)
         return action, logp, value, dist
+
+
+class DeterministicContinuousModule(RLModule):
+    """Deterministic continuous-control actor-critic: tanh policy mapped to
+    the Box bounds + twin Q towers (TD3's module; DDPG uses one tower of it).
+
+    Reference: `rllib/algorithms/ddpg/ddpg_torch_model.py` (deterministic
+    policy net + twin Q-nets with `twin_q`). One pytree {"pi", "q1", "q2"}
+    so the learner jits the combined TD3 objective; exploration is Gaussian
+    noise on the env-scale action, clipped to bounds, with the noise scale
+    fixed at construction (the reference's `exploration_config` sigma).
+    """
+
+    off_policy = True
+
+    def __init__(self, obs_dim: int, act_low, act_high,
+                 hiddens: Sequence[int] = (256, 256), activation: str = "tanh",
+                 explore_noise: float = 0.1):
+        self.obs_dim = obs_dim
+        self.act_low = np.asarray(act_low, np.float32)
+        self.act_high = np.asarray(act_high, np.float32)
+        self.act_dim = int(self.act_low.size)
+        self.center = (self.act_high + self.act_low) / 2.0
+        self.scale = (self.act_high - self.act_low) / 2.0
+        self.hiddens = tuple(hiddens)
+        self.activation = activation
+        self.explore_noise = float(explore_noise)
+
+    def init(self, key):
+        import jax
+
+        kp, k1, k2 = jax.random.split(key, 3)
+        return {
+            "pi": mlp_init(kp, (self.obs_dim, *self.hiddens, self.act_dim)),
+            "q1": mlp_init(k1, (self.obs_dim + self.act_dim, *self.hiddens, 1)),
+            "q2": mlp_init(k2, (self.obs_dim + self.act_dim, *self.hiddens, 1)),
+        }
+
+    def pi(self, params, obs):
+        """Deterministic env-scale action."""
+        import jax.numpy as jnp
+
+        raw = mlp_forward(params["pi"], obs, self.activation)
+        return self.center + self.scale * jnp.tanh(raw)
+
+    def q_values(self, q_params, obs, action_env):
+        import jax.numpy as jnp
+
+        a = (action_env - self.center) / self.scale
+        x = jnp.concatenate([obs, a], axis=-1)
+        return mlp_forward(q_params, x, self.activation)[..., 0]
+
+    def forward(self, params, obs):
+        a = self.pi(params, obs)
+        return a, self.q_values(params["q1"], obs, a)
+
+    def action_dist(self, params, obs, key, explore: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        a = self.pi(params, obs)
+        if explore:
+            noise = jax.random.normal(key, a.shape) * (
+                self.explore_noise * self.scale
+            )
+            a = jnp.clip(a + noise, self.act_low, self.act_high)
+        value = self.q_values(params["q1"], obs, a)
+        # logp slot unused for deterministic policies; action rides the
+        # logits slot for diagnostics.
+        return a, jnp.zeros(a.shape[:-1], jnp.float32), value, a

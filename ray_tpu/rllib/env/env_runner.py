@@ -27,9 +27,13 @@ class EnvRunner:
         gamma: float = 0.99,
         record_final_obs: bool = True,
         record_value_extras: bool = True,
+        obs_connector: Any = None,
+        action_connector: Any = None,
     ):
         import gymnasium as gym
         import jax
+
+        from ray_tpu.rllib.connectors.connector import build_connector
 
         # gymnasium >=1.0 defaults vector envs to NEXT_STEP autoreset, where
         # the step after done ignores the action and returns the reset obs —
@@ -64,9 +68,19 @@ class EnvRunner:
         # Algorithms whose loss recomputes values under current params
         # (IMPALA/V-trace) skip value/dist buffers and bootstrap forwards.
         self.record_value_extras = record_value_extras
+        # Connector seams (reference: `rllib/connectors/`): obs transforms
+        # run before the jitted forward, action transforms before env.step.
+        # Built HERE (each runner actor owns fresh connector state; specs
+        # pickle, stateful instances would alias across runners otherwise).
+        self._obs_conn = build_connector(obs_connector)
+        self._act_conn = build_connector(action_connector)
         self._key = jax.random.PRNGKey(seed)
         self._params = module.init(jax.random.PRNGKey(seed))
         self._obs, _ = self._envs.reset(seed=seed)
+        # Each raw obs batch is preprocessed EXACTLY once (stateful
+        # connectors like NormalizeObs accumulate per call — re-preprocessing
+        # a fragment-boundary batch would double-count its moments).
+        self._obs_in = self._preprocess(self._obs)
         self._episode_returns = np.zeros(num_envs)
         self._episode_lengths = np.zeros(num_envs, dtype=np.int64)
         self._completed: list = []
@@ -104,6 +118,27 @@ class EnvRunner:
         """Exploration state push (DQN epsilon schedule lives in the driver)."""
         self._epsilon = float(epsilon)
 
+    # ------------------------------------------------------------- connectors
+    def _preprocess(self, obs) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        return self._obs_conn(obs) if self._obs_conn is not None else obs
+
+    def get_connector_state(self):
+        return self._obs_conn.state() if self._obs_conn is not None else {}
+
+    def set_connector_state(self, state, freeze: bool = False) -> None:
+        """Adopt another runner's connector state (evaluation runners run on
+        the training runners' normalization stats, frozen so eval batches
+        don't pollute them — reference: `MeanStdFilter` sync semantics)."""
+        if self._obs_conn is None:
+            return
+        self._obs_conn.set_state(state)
+        if freeze and hasattr(self._obs_conn, "frozen"):
+            self._obs_conn.frozen = True
+        for c in getattr(self._obs_conn, "connectors", []):
+            if freeze and hasattr(c, "frozen"):
+                c.frozen = True
+
     def sample(self, explore: bool = True) -> Dict[str, np.ndarray]:
         """One rollout fragment: (T*num_envs) flat transition batch."""
         import jax
@@ -112,7 +147,11 @@ class EnvRunner:
         value_based = self._value_based
         need_logp = not value_based
         need_values = not value_based and self.record_value_extras
-        obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
+        # The train batch records the CONNECTED obs — the loss must see
+        # exactly what the policy forward saw. Carried from the previous
+        # fragment (preprocessed once there).
+        obs_in = self._obs_in
+        obs_buf = np.zeros((T, N) + obs_in.shape[1:], np.float32)
         act_buf = np.zeros((T, N) + self._act_shape, self._act_dtype)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
@@ -129,7 +168,7 @@ class EnvRunner:
         # replaces next_obs with the reset obs there); value-based algorithms
         # bootstrap their TD targets through these rows.
         final_obs_buf = (
-            np.zeros((T, N) + self._obs.shape[1:], np.float32)
+            np.zeros((T, N) + obs_in.shape[1:], np.float32)
             if self.record_final_obs
             else None
         )
@@ -138,7 +177,7 @@ class EnvRunner:
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
             action, logp, value, logits = self._act(
-                self._params, self._obs.astype(np.float32), sub, explore
+                self._params, obs_in, sub, explore
             )
             action = np.asarray(action)
             if need_logp:
@@ -148,24 +187,35 @@ class EnvRunner:
                     logits_buf = np.zeros((T, N) + np.shape(logits)[1:], np.float32)
                 logits_buf[t] = np.asarray(logits)
                 val_buf[t] = np.asarray(value)
-            obs_buf[t] = self._obs
+            obs_buf[t] = obs_in
             act_buf[t] = action
-            nxt, rew, term, trunc, infos = self._envs.step(action)
+            env_action = (
+                self._act_conn(action) if self._act_conn is not None else action
+            )
+            nxt, rew, term, trunc, infos = self._envs.step(env_action)
             done = np.logical_or(term, trunc)
             rew_buf[t] = rew
             done_buf[t] = done.astype(np.float32)
             term_buf[t] = np.asarray(term, np.float32)
             trunc_only = np.logical_and(trunc, np.logical_not(term))
             if trunc_only.any():
-                final_obs = self._final_observations(infos, nxt)
                 idx = np.nonzero(trunc_only)[0]
+                raw_final = self._final_observations(infos, nxt)
+                # Connect ONLY the truly-final rows (the rest are next-step
+                # obs that will be preprocessed at loop end — connecting
+                # them here would double-count their normalization moments),
+                # then scatter into a full batch so the jitted forward keeps
+                # one shape. Non-idx rows are zero and never read.
+                pf_rows = self._preprocess(raw_final[idx])
+                final_obs = np.zeros_like(obs_in)
+                final_obs[idx] = pf_rows
                 trunc_buf[t, idx] = 1.0
                 if final_obs_buf is not None:
-                    final_obs_buf[t, idx] = final_obs[idx].astype(np.float32)
+                    final_obs_buf[t, idx] = pf_rows
                 if need_values:
                     self._key, sub = jax.random.split(self._key)
                     _, _, fvals, _ = self._act(
-                        self._params, final_obs.astype(np.float32), sub, False
+                        self._params, final_obs, sub, False
                     )
                     boot_buf[t, idx] = np.asarray(fvals, np.float32)[idx]
             self._episode_returns += rew
@@ -177,6 +227,7 @@ class EnvRunner:
                 self._episode_returns[i] = 0.0
                 self._episode_lengths[i] = 0
             self._obs = nxt
+            self._obs_in = obs_in = self._preprocess(self._obs)
         out = {
             "obs": obs_buf,
             "actions": act_buf,
@@ -186,7 +237,7 @@ class EnvRunner:
             "truncateds": trunc_buf,
             # Final observations (value-based algorithms build next_obs by
             # shifting obs and closing the tail with these).
-            "last_obs": self._obs.astype(np.float32),
+            "last_obs": obs_in,
         }
         if final_obs_buf is not None:
             out["final_obs"] = final_obs_buf
@@ -195,9 +246,7 @@ class EnvRunner:
         if need_values:
             # Bootstrap value for the final observation of each env.
             self._key, sub = jax.random.split(self._key)
-            _, _, last_val, _ = self._act(
-                self._params, self._obs.astype(np.float32), sub, explore
-            )
+            _, _, last_val, _ = self._act(self._params, obs_in, sub, explore)
             out.update(
                 behavior_logits=logits_buf,
                 values=val_buf,

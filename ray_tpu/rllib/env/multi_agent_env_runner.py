@@ -80,13 +80,36 @@ class MultiAgentEnvRunner:
             pid: m.init(jax.random.PRNGKey(seed + i))
             for i, (pid, m) in enumerate(modules.items())
         }
-        self._act = {
-            pid: jax.jit(
-                (lambda mod: lambda p, o, k, explore: mod.action_dist(p, o, k, explore))(m),
-                static_argnums=(3,),
-            )
-            for pid, m in modules.items()
-        }
+        # Replay-trained policy maps (multi-agent DQN/SAC): trajectories
+        # close into flat (s, a, r, s', terminated) transition batches per
+        # policy instead of GAE columns, and Q modules act epsilon-greedily
+        # with a driver-pushed schedule (same contract as EnvRunner).
+        self.value_based = any(
+            getattr(m, "off_policy", False) or hasattr(m, "epsilon_greedy")
+            for m in modules.values()
+        )
+        self._epsilon = 1.0
+        self._act = {}
+        for pid, m in modules.items():
+            if hasattr(m, "epsilon_greedy"):
+                jitted = jax.jit(
+                    (lambda mod: lambda p, o, k, explore, eps: mod.epsilon_greedy(
+                        p, o, k, explore, eps
+                    ))(m),
+                    static_argnums=(3,),
+                )
+                self._act[pid] = (
+                    lambda p, o, k, explore, _j=jitted: _j(
+                        p, o, k, explore, np.float32(self._epsilon)
+                    )
+                )
+            else:
+                self._act[pid] = jax.jit(
+                    (lambda mod: lambda p, o, k, explore: mod.action_dist(
+                        p, o, k, explore
+                    ))(m),
+                    static_argnums=(3,),
+                )
         # Live episode state per env.
         self._obs: List[Dict[str, Any]] = []
         self._done_agents: List[set] = []
@@ -106,33 +129,49 @@ class MultiAgentEnvRunner:
         for pid, w in weights.items():
             self._params[pid] = w
 
+    def set_exploration(self, epsilon: float) -> None:
+        """Epsilon push for Q policies (schedule lives in the driver)."""
+        self._epsilon = float(epsilon)
+
     # ------------------------------------------------------------------ sample
     def sample(self, explore: bool = True) -> Dict[str, Dict[str, np.ndarray]]:
-        """Collect `rollout_length` env steps; returns per-policy flat batches
-        with advantages/value_targets already attached."""
+        """Collect `rollout_length` env steps; returns per-policy flat batches:
+        GAE columns (advantages/value_targets) for policy-gradient maps, or
+        (s, a, r, s', terminated) transitions for replay-trained maps."""
+        if self.value_based:
+            keys = (
+                "obs", "actions", "rewards", "next_obs",
+                "terminateds", "loss_weight",
+            )
+        else:
+            keys = (
+                "obs", "actions", "logp", "behavior_logits",
+                "advantages", "value_targets",
+            )
         out: Dict[str, Dict[str, List[np.ndarray]]] = {
-            pid: {
-                k: []
-                for k in (
-                    "obs", "actions", "logp", "behavior_logits",
-                    "advantages", "value_targets",
-                )
-            }
-            for pid in self.modules
+            pid: {k: [] for k in keys} for pid in self.modules
         }
         for _ in range(self.rollout_length):
             self._step_once(out, explore)
-        # Close out still-open trajectories, bootstrapping through the value
-        # of the CURRENT obs (episode continues next fragment).
+        # Close out still-open trajectories (episode continues next fragment):
+        # PG bootstraps through V(current obs); replay transitions tail with
+        # s' = current obs, terminated=0 (the target net bootstraps).
         for e in range(len(self._envs)):
             open_agents = list(self._traj[e].keys())
             if not open_agents:
                 continue
-            boots = self._values_for(
-                {aid: self._obs[e][aid] for aid in open_agents if aid in self._obs[e]}
-            )
-            for aid in open_agents:
-                self._close_trajectory(out, e, aid, boots.get(aid, 0.0))
+            if self.value_based:
+                for aid in open_agents:
+                    self._close_trajectory(
+                        out, e, aid,
+                        close_obs=self._obs[e].get(aid), terminated=False,
+                    )
+            else:
+                boots = self._values_for(
+                    {aid: self._obs[e][aid] for aid in open_agents if aid in self._obs[e]}
+                )
+                for aid in open_agents:
+                    self._close_trajectory(out, e, aid, boots.get(aid, 0.0))
         return {
             pid: {k: _stack(v) for k, v in cols.items()}
             for pid, cols in out.items()
@@ -172,9 +211,10 @@ class MultiAgentEnvRunner:
                 tr = self._traj[e].setdefault(aid, _Trajectory())
                 tr.obs.append(obs_batch[j])
                 tr.actions.append(a[j])
-                tr.logp.append(float(logp[j]))
-                tr.logits.append(logits[j])
-                tr.values.append(float(value[j]))
+                if not self.value_based:
+                    tr.logp.append(float(logp[j]))
+                    tr.logits.append(logits[j])
+                    tr.values.append(float(value[j]))
                 actions[e][aid] = a[j]
         for e, env in enumerate(self._envs):
             if not actions[e]:
@@ -203,10 +243,16 @@ class MultiAgentEnvRunner:
                 truncated = bool(truncs.get(aid, False))
                 if terminated or truncated:
                     self._done_agents[e].add(aid)
-                    boot = 0.0
-                    if truncated and not terminated and aid in obs:
-                        boot = self._values_for({aid: obs[aid]}).get(aid, 0.0)
-                    self._close_trajectory(out, e, aid, boot)
+                    if self.value_based:
+                        self._close_trajectory(
+                            out, e, aid,
+                            close_obs=obs.get(aid), terminated=terminated,
+                        )
+                    else:
+                        boot = 0.0
+                        if truncated and not terminated and aid in obs:
+                            boot = self._values_for({aid: obs[aid]}).get(aid, 0.0)
+                        self._close_trajectory(out, e, aid, boot)
             self._obs[e] = next_obs
             if terms.get("__all__") or truncs.get("__all__"):
                 # Close any trajectories still open (an env may end the whole
@@ -216,19 +262,28 @@ class MultiAgentEnvRunner:
                 # not leak into the next episode.
                 open_agents = list(self._traj[e].keys())
                 if open_agents:
-                    boots = (
-                        self._values_for(
-                            {
-                                aid: next_obs[aid]
-                                for aid in open_agents
-                                if aid in next_obs
-                            }
+                    if self.value_based:
+                        terminated_all = bool(terms.get("__all__"))
+                        for aid in open_agents:
+                            self._close_trajectory(
+                                out, e, aid,
+                                close_obs=next_obs.get(aid),
+                                terminated=terminated_all,
+                            )
+                    else:
+                        boots = (
+                            self._values_for(
+                                {
+                                    aid: next_obs[aid]
+                                    for aid in open_agents
+                                    if aid in next_obs
+                                }
+                            )
+                            if truncs.get("__all__")
+                            else {}
                         )
-                        if truncs.get("__all__")
-                        else {}
-                    )
-                    for aid in open_agents:
-                        self._close_trajectory(out, e, aid, boots.get(aid, 0.0))
+                        for aid in open_agents:
+                            self._close_trajectory(out, e, aid, boots.get(aid, 0.0))
                 self._completed.append(
                     (self._episode_return[e], self._episode_len[e])
                 )
@@ -259,7 +314,10 @@ class MultiAgentEnvRunner:
                 vals[a] = float(v)
         return vals
 
-    def _close_trajectory(self, out, e: int, aid: str, bootstrap: float) -> None:
+    def _close_trajectory(
+        self, out, e: int, aid: str, bootstrap: float = 0.0,
+        close_obs: Any = None, terminated: bool = False,
+    ) -> None:
         tr = self._traj[e].pop(aid, None)
         if tr is None or len(tr) == 0:
             return
@@ -274,12 +332,41 @@ class MultiAgentEnvRunner:
         )
         n = len(tr.actions)
         rewards = np.asarray(tr.rewards, np.float32)
+        pid = self.policy_mapping_fn(aid)
+        cols = out[pid]
+        if self.value_based:
+            # Flat replay transitions: s'[i] is the agent's NEXT observation
+            # (consecutive within the trajectory; skipped turn-based steps
+            # collapse into one transition). The tail's s' is `close_obs`
+            # (the final/current obs); terminated marks only the tail row —
+            # a fragment-end close bootstraps through the target net.
+            obs_arr = np.stack(tr.obs)
+            weight = np.ones(n, np.float32)
+            if close_obs is not None:
+                last_next = np.asarray(close_obs, np.float32).ravel()
+            else:
+                # No final obs for the tail. Terminated rows never read s'
+                # (the TD target zeroes it); a TRUNCATED/fragment close
+                # without an obs would bootstrap through its own source
+                # state — exclude that row instead (same rule as the
+                # single-agent fallback in DQN._transitions).
+                last_next = obs_arr[-1]
+                if not terminated:
+                    weight[-1] = 0.0
+            next_obs = np.concatenate([obs_arr[1:], last_next[None]], axis=0)
+            term_col = np.zeros(n, np.float32)
+            term_col[-1] = 1.0 if terminated else 0.0
+            cols["obs"].append(obs_arr)
+            cols["actions"].append(np.asarray(tr.actions))
+            cols["rewards"].append(rewards)
+            cols["next_obs"].append(next_obs)
+            cols["terminateds"].append(term_col)
+            cols["loss_weight"].append(weight)
+            return
         values = np.asarray(tr.values, np.float32)
         adv, targets = _segment_gae(
             rewards, values, bootstrap, self.gamma, self.lambda_
         )
-        pid = self.policy_mapping_fn(aid)
-        cols = out[pid]
         cols["obs"].append(np.stack(tr.obs[:n]))
         cols["actions"].append(np.asarray(tr.actions[:n]))
         cols["logp"].append(np.asarray(tr.logp[:n], np.float32))

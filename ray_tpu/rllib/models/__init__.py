@@ -1,0 +1,7 @@
+from ray_tpu.rllib.models.catalog import (
+    MODEL_DEFAULTS,
+    ModelCatalog,
+    register_custom_module,
+)
+
+__all__ = ["MODEL_DEFAULTS", "ModelCatalog", "register_custom_module"]

@@ -57,18 +57,23 @@ class DataParallelTrainer(BaseTrainer):
 
     # ------------------------------------------------------------- data ingest
     def _dataset_shards(self) -> Optional[List[Dict[str, Any]]]:
-        """Split each provided dataset across workers (Data P18 ingest seam).
+        """Pipelined per-worker iterators over each provided dataset (Data
+        P18 ingest seam; reference: `streaming_split` feeding
+        `session.get_dataset_shard`, `python/ray/data/dataset.py:1134`).
 
-        Datasets with `.split(n, equal=)` (ray_tpu.data.Dataset) are split;
-        anything else is replicated to every worker.
+        ray_tpu.data Datasets become `DataIterator`s over ONE shared
+        executing stream — blocks are produced DURING training and assigned
+        to workers on demand, so epoch ingest overlaps the train loop and
+        nothing materializes up front. Anything else is replicated to every
+        worker.
         """
         if not self.datasets:
             return None
         n = self.scaling_config.num_workers
         shards: List[Dict[str, Any]] = [{} for _ in range(n)]
         for name, ds in self.datasets.items():
-            if hasattr(ds, "split"):
-                parts = ds.split(n, equal=True)
+            if hasattr(ds, "streaming_split"):
+                parts = ds.streaming_split(n, equal=True)
                 for i in range(n):
                     shards[i][name] = parts[i]
             else:

@@ -181,3 +181,100 @@ def test_streaming_through_global_op_barrier(ray_ctx):
         .map_batches(lambda b: {"id": b["id"] + 1})
     )
     assert sorted(r["id"] for r in ds.take_all()) == list(range(1, 129, 2))
+
+
+# ----------------------------------------------------------- streaming_split
+def test_streaming_split_on_demand_and_equal(ray_ctx):
+    """N consumers over one stream: on-demand assignment covers every row
+    exactly once; equal=True balances blocks k/k+1 per split; a second
+    epoch re-executes behind the all-consumer barrier."""
+    ds = rd.range(64, parallelism=8)
+    its = ds.streaming_split(2, equal=True)
+
+    @ray_tpu.remote
+    def consume(it, epochs):
+        out = []
+        for _ in range(epochs):
+            ids = []
+            for batch in it.iter_batches(batch_size=8):
+                ids.extend(int(x) for x in batch["id"])
+            out.append(ids)
+        return out
+
+    r0, r1 = ray_tpu.get(
+        [consume.remote(its[0], 2), consume.remote(its[1], 2)], timeout=120
+    )
+    for epoch in (0, 1):
+        ids = sorted(r0[epoch] + r1[epoch])
+        assert ids == list(range(64)), f"epoch {epoch} lost/duplicated rows"
+        # equal=True: 8 blocks over 2 splits -> 4 each (lockstep consumers).
+        assert abs(len(r0[epoch]) - len(r1[epoch])) <= 8
+    stats = its[0].stats()
+    assert stats["blocks_out"] == 16  # 8 blocks x 2 epochs
+    assert abs(stats["blocks_per_split"][0] - stats["blocks_per_split"][1]) <= 1
+
+
+def test_streaming_split_trainer_ingest_pipelined(ray_ctx, tmp_path):
+    """The VERDICT-r4 seam: train workers iterate a dataset whose blocks are
+    produced DURING training with bounded memory — peak
+    produced-but-unconsumed blocks stays well under the total, and the
+    static eager split is gone (shards are DataIterators)."""
+    from ray_tpu.air import RunConfig, ScalingConfig, session
+    from ray_tpu.train import DataParallelTrainer
+
+    log_path = str(tmp_path / "events.log")
+    TOTAL_BLOCKS = 12
+
+    def mark_produced(batch, path=log_path):
+        import time as _t
+
+        with open(path, "a") as f:
+            f.write(f"p {_t.time():.6f} {int(batch['id'][0])}\n")
+        _t.sleep(0.05)  # pace production so overlap is observable
+        return batch
+
+    ds = rd.range(TOTAL_BLOCKS * 100, parallelism=TOTAL_BLOCKS).map_batches(
+        mark_produced, batch_size=None
+    )
+
+    def loop(config, path=log_path):
+        import time as _t
+
+        shard = session.get_dataset_shard("train")
+        rows = 0
+        for batch in shard.iter_batches(batch_size=100):
+            with open(path, "a") as f:
+                f.write(f"c {_t.time():.6f} -\n")
+            _t.sleep(0.08)  # training step slower than production
+            rows += len(batch["id"])
+        session.report({"rows": rows})
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ssplit", storage_path=str(tmp_path)),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    assert result.metrics["rows"] > 0
+
+    events = []
+    with open(log_path) as f:
+        for line in f:
+            kind, t, _ = line.split()
+            events.append((float(t), kind))
+    events.sort()
+    produced = sum(1 for _, k in events if k == "p")
+    consumed = sum(1 for _, k in events if k == "c")
+    assert produced == TOTAL_BLOCKS
+    assert consumed == TOTAL_BLOCKS
+    # Overlap: production continues after consumption starts.
+    first_c = min(t for t, k in events if k == "c")
+    last_p = max(t for t, k in events if k == "p")
+    assert last_p > first_c, "all blocks materialized before training began"
+    # Bounded: peak produced-but-unconsumed < total (no eager materialize).
+    peak = cur = 0
+    for _t, kind in events:
+        cur += 1 if kind == "p" else -1
+        peak = max(peak, cur)
+    assert peak < TOTAL_BLOCKS, f"peak outstanding {peak} == total (eager)"
