@@ -170,6 +170,14 @@ class InputOperator(PhysicalOperator):
         self._pending = deque(bundles)
         self.inputs_done = True
 
+    def permute(self, seed) -> None:
+        """Reorder pending bundles (lifted randomize_block_order)."""
+        import numpy as np
+
+        bundles = list(self._pending)
+        order = np.random.default_rng(seed).permutation(len(bundles))
+        self._pending = deque(bundles[i] for i in order)
+
     def poll(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
         # Pre-existing refs: already materialized, so no budget GATE — but
         # they must still be ACCOUNTED (via _emit): downstream moves and the
@@ -209,6 +217,15 @@ class ReadOperator(PhysicalOperator):
         serialize once instead of write+read+write at the boundary."""
         self._chain = list(segment)
         self.name = f"{self.name}->Map[{names}]"
+
+    def permute(self, seed) -> None:
+        """Reorder read entries (lifted randomize_block_order) — must run
+        before start() groups entries into generator tasks."""
+        import numpy as np
+
+        assert not self._started, "cannot permute a started read"
+        order = np.random.default_rng(seed).permutation(len(self._entries))
+        self._entries = [self._entries[i] for i in order]
 
     def start(self, ctx: DataContext) -> None:
         if self._started:
@@ -632,15 +649,21 @@ class ReadSource:
 
 # ------------------------------------------------------------------- planning
 def build_pipeline(source_op: PhysicalOperator, logical_ops: List) -> List[PhysicalOperator]:
-    """Compile a Dataset's logical op chain into physical operators, fusing
-    consecutive per-block ops into single MapOperators (the reference's
-    OperatorFusionRule, `_internal/logical/rules/operator_fusion.py`)."""
-    ops: List[PhysicalOperator] = [source_op]
-    segment: List = []
+    """Compile a Dataset's logical op chain into physical operators. The
+    rule-based optimizer (`_internal/optimizer.py` — reference:
+    `logical/optimizers.py` applying `OperatorFusionRule` +
+    `ReorderRandomizeBlocksRule`) rewrites the chain first: lifted
+    randomize_block_order ops become source permutations, and consecutive
+    per-block ops arrive pre-fused into segments."""
+    from ray_tpu.data._internal.optimizer import optimize
 
-    def flush():
-        nonlocal segment
-        if segment:
+    plan = optimize(logical_ops)
+    for seed in plan.source_permute_seeds:
+        source_op.permute(seed)
+    ops: List[PhysicalOperator] = [source_op]
+    for kind, payload in plan.segments:
+        if kind == "map":
+            segment = payload
             names = ",".join(k for k, _ in segment)
             if (
                 len(ops) == 1
@@ -652,28 +675,11 @@ def build_pipeline(source_op: PhysicalOperator, logical_ops: List) -> List[Physi
                 source_op.fuse_chain(segment, names)
             else:
                 ops.append(MapOperator(segment, name=f"Map[{names}]"))
-            segment = []
-
-    i = 0
-    while i < len(logical_ops):
-        kind, payload = logical_ops[i]
-        if kind == "map_batches_actors":
-            flush()
-            fn, ctor_args, batch_size, batch_format, num_actors = payload
-            # Fuse any fusable per-block tail into the actor call.
-            tail: List = []
-            j = i + 1
-            while j < len(logical_ops) and logical_ops[j][0] != "map_batches_actors":
-                tail.append(logical_ops[j])
-                j += 1
+        else:  # "actors"
+            (fn, ctor_args, batch_size, batch_format, num_actors), tail = payload
             ops.append(
                 ActorPoolMapOperator(
                     fn, ctor_args, batch_size, batch_format, num_actors, tail
                 )
             )
-            i = j
-        else:
-            segment.append(logical_ops[i])
-            i += 1
-    flush()
     return ops

@@ -1,18 +1,26 @@
-"""Block: the unit of distributed data — a columnar dict of numpy arrays.
+"""Block: the unit of distributed data — dict-of-numpy OR a pyarrow Table.
 
-Reference: `python/ray/data/block.py` (`BlockAccessor`) — but where the
-reference centers on Arrow, the TPU-native format is dict-of-numpy: batches
-come out as contiguous host arrays ready for `jax.device_put` onto a mesh.
-Pandas / Arrow / row dicts convert at the boundary.
+Reference: `python/ray/data/block.py` (`BlockAccessor`) +
+`_internal/arrow_block.py:138` (`ArrowBlockAccessor`). Two first-class block
+layouts, dispatched by `BlockAccessor`:
+
+- dict of numpy arrays — the TPU-native layout: batches are contiguous host
+  arrays ready for `jax.device_put` onto a mesh.
+- `pyarrow.Table` — the columnar layout for string/ragged data: slices and
+  takes stay zero-copy Arrow end to end (parquet reads, `from_arrow`, and
+  any `map_batches(batch_format="pyarrow")` stage), so string-heavy
+  pipelines never pay numpy object-dtype boxing.
+
+Pandas / row dicts convert at the boundary.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
-Block = Dict[str, np.ndarray]
+Block = Union[Dict[str, np.ndarray], "pyarrow.Table"]  # noqa: F821
 
 
 def _to_numpy_column(values: Sequence[Any]) -> np.ndarray:
@@ -22,10 +30,37 @@ def _to_numpy_column(values: Sequence[Any]) -> np.ndarray:
     return arr
 
 
+def _is_arrow(block: Any) -> bool:
+    if block is None or isinstance(block, dict):
+        return False
+    try:
+        import pyarrow as pa
+    except ImportError:  # pragma: no cover - pyarrow is baked into CI
+        return False
+    return isinstance(block, pa.Table)
+
+
+def _arrow_col_to_numpy(col) -> np.ndarray:
+    """One Arrow column -> numpy; strings/nested fall back to object."""
+    try:
+        return col.to_numpy(zero_copy_only=False)
+    except Exception:
+        return _to_numpy_column(col.to_pylist())
+
+
 class BlockAccessor:
+    """Polymorphic accessor over both block layouts (reference:
+    `BlockAccessor.for_block` choosing Arrow/pandas/simple accessors)."""
+
     def __init__(self, block: Block):
         self._b = block
+        self._arrow = _is_arrow(block)
 
+    @property
+    def is_arrow(self) -> bool:
+        return self._arrow
+
+    # ---------------------------------------------------------- constructors
     @staticmethod
     def from_rows(rows: List[Any]) -> Block:
         """Rows: dicts (columnar-ized) or scalars (an 'item' column)."""
@@ -47,18 +82,24 @@ class BlockAccessor:
 
     @staticmethod
     def from_arrow(table) -> Block:
-        return {
-            name: _to_numpy_column(col.to_pylist())
-            if col.type.equals(__import__("pyarrow").string())
-            else col.to_numpy(zero_copy_only=False)
-            for name, col in zip(table.column_names, table.columns)
-        }
+        """Arrow tables ARE blocks: no conversion, columns stay columnar."""
+        return table
 
     @staticmethod
     def concat(blocks: List[Block]) -> Block:
-        blocks = [b for b in blocks if b and BlockAccessor(b).num_rows()]
+        blocks = [b for b in blocks if b is not None and BlockAccessor(b).num_rows()]
         if not blocks:
             return {}
+        if all(_is_arrow(b) for b in blocks):
+            import pyarrow as pa
+
+            if len(blocks) == 1:
+                return blocks[0]
+            return pa.concat_tables(blocks, promote_options="default")
+        if any(_is_arrow(b) for b in blocks):
+            # Mixed layouts (e.g. an Arrow read unioned with numpy blocks):
+            # settle on numpy.
+            blocks = [BlockAccessor(b).to_numpy() for b in blocks]
         if len(blocks) == 1:
             # Single block: no copy — iter_batches hits this on every block
             # when batch_size=None, and np.concatenate copied each block once
@@ -87,38 +128,77 @@ class BlockAccessor:
 
     # ----------------------------------------------------------------- queries
     def num_rows(self) -> int:
+        if self._arrow:
+            return self._b.num_rows
         if not self._b:
             return 0
         return len(next(iter(self._b.values())))
 
     def size_bytes(self) -> int:
+        if self._arrow:
+            return self._b.nbytes
         return sum(a.nbytes for a in self._b.values())
 
-    def schema(self) -> Dict[str, np.dtype]:
+    def schema(self) -> Dict[str, Any]:
+        if self._arrow:
+            return {f.name: f.type for f in self._b.schema}
         return {k: v.dtype for k, v in self._b.items()}
 
+    def column_names(self) -> List[str]:
+        if self._arrow:
+            return list(self._b.column_names)
+        return list(self._b.keys())
+
+    def column(self, name: str) -> np.ndarray:
+        """One column as numpy (key columns for sort/groupby/zip math).
+        Arrow string keys surface as object arrays HERE ONLY — the block's
+        payload columns never convert."""
+        if self._arrow:
+            return _arrow_col_to_numpy(self._b[name])
+        return self._b[name]
+
     def slice(self, start: int, end: int) -> Block:
+        if self._arrow:
+            # Zero-copy view over the parent table's buffers.
+            return self._b.slice(start, end - start)
         return {k: v[start:end] for k, v in self._b.items()}
 
     def take_indices(self, idx: np.ndarray) -> Block:
+        if self._arrow:
+            import pyarrow as pa
+
+            return self._b.take(pa.array(np.asarray(idx, np.int64)))
         return {k: v[idx] for k, v in self._b.items()}
 
     # ------------------------------------------------------------- conversions
-    def to_numpy(self) -> Block:
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        if self._arrow:
+            return {
+                name: _arrow_col_to_numpy(col)
+                for name, col in zip(self._b.column_names, self._b.columns)
+            }
         return self._b
 
     def to_pandas(self):
+        if self._arrow:
+            return self._b.to_pandas()
         import pandas as pd
 
         return pd.DataFrame({k: list(v) if v.dtype == object else v
                              for k, v in self._b.items()})
 
     def to_arrow(self):
+        if self._arrow:
+            return self._b
         import pyarrow as pa
 
         return pa.table({k: pa.array(list(v)) for k, v in self._b.items()})
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        if self._arrow:
+            for row in self._b.to_pylist():
+                yield row
+            return
         n = self.num_rows()
         keys = list(self._b.keys())
         for i in range(n):
@@ -126,7 +206,7 @@ class BlockAccessor:
 
     def to_batch(self, batch_format: str = "numpy"):
         if batch_format == "numpy":
-            return self._b
+            return self.to_numpy()
         if batch_format == "pandas":
             return self.to_pandas()
         if batch_format == "pyarrow":
@@ -137,18 +217,13 @@ class BlockAccessor:
     def from_batch(batch) -> Block:
         import pandas as pd
 
+        if _is_arrow(batch):
+            return batch
         if isinstance(batch, dict):
             return {k: np.asarray(v) if not isinstance(v, np.ndarray) else v
                     for k, v in batch.items()}
         if isinstance(batch, pd.DataFrame):
             return BlockAccessor.from_pandas(batch)
-        try:
-            import pyarrow as pa
-
-            if isinstance(batch, pa.Table):
-                return BlockAccessor.from_arrow(batch)
-        except ImportError:
-            pass
         if isinstance(batch, list):
             return BlockAccessor.from_rows(batch)
         raise TypeError(f"cannot convert batch of type {type(batch)} to a block")

@@ -59,20 +59,49 @@ def _apply_chain(block: Block, chain: List[PerBlockOp]) -> Block:
             acc = BlockAccessor(acc.take_indices(np.nonzero(keep)[0]))
         elif kind == "add_column":
             name, fn = payload
-            b = dict(acc.to_numpy())
-            b[name] = np.asarray(fn(acc.to_batch("numpy")))
-            acc = BlockAccessor(b)
+            col = np.asarray(fn(acc.to_batch("numpy")))
+            if acc.is_arrow and col.ndim == 1:
+                import pyarrow as pa
+
+                table = acc.to_arrow()
+                if name in table.column_names:
+                    table = table.set_column(
+                        table.column_names.index(name), name, pa.array(col)
+                    )
+                else:
+                    table = table.append_column(name, pa.array(col))
+                acc = BlockAccessor(table)
+            else:
+                # Multi-dimensional columns (embeddings) don't fit a 1-D
+                # Arrow array: settle the block on the numpy layout, which
+                # stores them natively.
+                b = dict(acc.to_numpy())
+                b[name] = col
+                acc = BlockAccessor(b)
         elif kind == "drop_columns":
             cols = set(payload)
-            acc = BlockAccessor(
-                {k: v for k, v in acc.to_numpy().items() if k not in cols}
-            )
+            if acc.is_arrow:
+                table = acc.to_arrow()
+                acc = BlockAccessor(
+                    table.drop_columns(
+                        [c for c in table.column_names if c in cols]
+                    )
+                )
+            else:
+                acc = BlockAccessor(
+                    {k: v for k, v in acc.to_numpy().items() if k not in cols}
+                )
         elif kind == "select_columns":
             cols = list(payload)
-            acc = BlockAccessor({k: acc.to_numpy()[k] for k in cols})
+            if acc.is_arrow:
+                acc = BlockAccessor(acc.to_arrow().select(cols))
+            else:
+                acc = BlockAccessor({k: acc.to_numpy()[k] for k in cols})
         else:
             raise ValueError(f"unknown per-block op {kind}")
-    return acc.to_numpy()
+    # Whatever layout the chain ended in IS the output block — an Arrow
+    # chain stays Arrow (strings never box into numpy object arrays).
+    return acc._b
 
 
 def _num_rows(block: Block) -> int:
@@ -106,15 +135,16 @@ def _shuffle_reduce(seed: int, *pieces: Block) -> Block:
 
 
 def _sort_keys(block: Block, key: str) -> np.ndarray:
-    return np.asarray(block[key]) if block else np.array([])
+    acc = BlockAccessor(block)
+    return np.asarray(acc.column(key)) if acc.num_rows() else np.array([])
 
 
 def _sort_scatter(block: Block, key: str, bounds: np.ndarray, descending: bool) -> List[Block]:
     """Range-partition rows by key against the sampled boundaries."""
     acc = BlockAccessor(block)
     if acc.num_rows() == 0:
-        return [acc.to_numpy() for _ in range(len(bounds) + 1)]
-    keys = np.asarray(block[key])
+        return [acc.slice(0, 0) for _ in range(len(bounds) + 1)]
+    keys = np.asarray(acc.column(key))
     part = np.searchsorted(bounds, keys, side="right")
     out = [acc.take_indices(np.nonzero(part == j)[0]) for j in range(len(bounds) + 1)]
     return out[::-1] if descending else out
@@ -122,12 +152,13 @@ def _sort_scatter(block: Block, key: str, bounds: np.ndarray, descending: bool) 
 
 def _sort_reduce(key: str, descending: bool, *pieces: Block) -> Block:
     merged = BlockAccessor.concat(list(pieces))
-    if not merged:
+    macc = BlockAccessor(merged)
+    if not macc.num_rows():
         return merged
-    order = np.argsort(merged[key], kind="stable")
+    order = np.argsort(macc.column(key), kind="stable")
     if descending:
         order = order[::-1]
-    return BlockAccessor(merged).take_indices(order)
+    return macc.take_indices(order)
 
 
 def _stable_hash(v: Any) -> int:
@@ -141,18 +172,61 @@ def _stable_hash(v: Any) -> int:
 
 
 def _groupby_scatter(block: Block, key: str, n_out: int) -> List[Block]:
+    """Hash-partition by key. Only the KEY column is examined row-wise; the
+    payload moves via take_indices, which keeps Arrow blocks Arrow — string
+    payload columns never convert to numpy object arrays."""
     acc = BlockAccessor(block)
     if acc.num_rows() == 0:
-        return [acc.to_numpy() for _ in range(n_out)]
-    hashes = np.array([_stable_hash(v) % n_out for v in block[key]])
+        return [acc.slice(0, 0) for _ in range(n_out)]
+    hashes = np.array([_stable_hash(v) % n_out for v in acc.column(key)])
     return [acc.take_indices(np.nonzero(hashes == j)[0]) for j in range(n_out)]
+
+
+def _groupby_agg_arrow(table, key: str, aggs: List[Tuple[str, str, str]]):
+    """Arrow-native aggregation: pyarrow's hash group_by does the whole
+    reduction columnar — string keys stay Arrow strings throughout
+    (reference: `_internal/arrow_block.py` ArrowBlockAccessor._aggregate)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    spec = []
+    renames = {key: key}
+    for op, col, out_name in aggs:
+        if op == "count":
+            spec.append(([], "count_all", None))
+            renames["count_all"] = out_name
+        elif op == "std":
+            spec.append((col, "stddev", pc.VarianceOptions(ddof=1)))
+            renames[f"{col}_stddev"] = out_name
+        else:
+            if op not in ("sum", "mean", "min", "max"):
+                raise ValueError(f"unknown aggregation {op}")
+            spec.append((col, op, None))
+            renames[f"{col}_{op}"] = out_name
+    out = table.group_by(key).aggregate(spec)
+    out = out.rename_columns([renames.get(c, c) for c in out.column_names])
+    # Deterministic output order (the numpy path sorts unique keys).
+    order = pc.sort_indices(out, sort_keys=[(key, "ascending")])
+    out = out.take(order)
+    # Single-group std of one row is null under ddof=1; the numpy path
+    # reports 0.0 — align.
+    for op, _col, out_name in aggs:
+        if op == "std":
+            i = out.column_names.index(out_name)
+            out = out.set_column(
+                i, out_name, pc.fill_null(out[out_name], 0.0)
+            )
+    return out
 
 
 def _groupby_agg(key: str, aggs: List[Tuple[str, str, str]], *pieces: Block) -> Block:
     """aggs: [(op, col, out_name)]; op in count/sum/mean/min/max/std."""
     merged = BlockAccessor.concat(list(pieces))
-    if not merged:
+    macc = BlockAccessor(merged)
+    if not macc.num_rows():
         return {}
+    if macc.is_arrow:
+        return _groupby_agg_arrow(merged, key, aggs)
     keys = merged[key]
     uniq = sorted(set(keys.tolist()))
     out: Dict[str, List[Any]] = {key: []}
@@ -198,10 +272,17 @@ def _write_block(block: Block, path: str, fmt: str, kwargs: dict) -> Optional[st
 
 
 def _zip_blocks(a: Block, b: Block) -> Block:
-    out = dict(a)
-    for k, v in b.items():
-        out[k if k not in out else f"{k}_1"] = v
-    return out
+    aa, ab = BlockAccessor(a), BlockAccessor(b)
+    if aa.is_arrow and ab.is_arrow:
+        out = a
+        for name in ab.column_names():
+            new = name if name not in out.column_names else f"{name}_1"
+            out = out.append_column(new, b[name])
+        return out
+    da = dict(aa.to_numpy())
+    for k, v in ab.to_numpy().items():
+        da[k if k not in da else f"{k}_1"] = v
+    return da
 
 
 _remote_cache: Dict[Any, Any] = {}
@@ -317,6 +398,14 @@ class Dataset:
 
     def select_columns(self, cols: List[str]) -> "Dataset":
         return self._derive(("select_columns", cols))
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Shuffle BLOCK order without touching rows (reference:
+        `Datastream.randomize_block_order` + the ReorderRandomizeBlocks
+        optimizer rule): the optimizer lifts this out of the op chain into a
+        source permutation so it never splits an otherwise-fusable map
+        chain."""
+        return self._derive(("randomize_block_order", seed))
 
     # ------------------------------------------------------------- execution
     def _stream_bundles(self, output_buffer_blocks: int = 2):

@@ -33,10 +33,11 @@ class _StreamSplitCoordinator:
     (a barrier — otherwise a fast consumer would re-execute the plan while
     stragglers still drain the previous pass)."""
 
-    def __init__(self, ds, n: int, equal: bool):
+    def __init__(self, ds, n: int, equal: bool, barrier_timeout_s: float = 600.0):
         self._ds = ds
         self._n = n
         self._equal = equal
+        self._barrier_timeout_s = barrier_timeout_s
         self._lock = threading.Lock()
         self._barrier = threading.Condition(self._lock)
         self._epoch = -1
@@ -67,7 +68,23 @@ class _StreamSplitCoordinator:
                 self._taken = [0] * self._n
                 self._barrier.notify_all()
                 return True
+            # Deadline: a consumer that never iterates its shard (worker
+            # returned early, conditional read) must surface as an ERROR
+            # naming the gap, not hang the whole gang forever.
+            import time as _time
+
+            deadline = _time.monotonic() + self._barrier_timeout_s
             while self._epoch < epoch:
+                if _time.monotonic() > deadline:
+                    waiting = sorted(
+                        s for (e, s) in self._arrived if e == epoch
+                    )
+                    raise RuntimeError(
+                        f"streaming_split epoch {epoch} barrier timed out "
+                        f"after {self._barrier_timeout_s:.0f}s: only splits "
+                        f"{waiting} of {self._n} arrived — every consumer "
+                        "must iterate its shard each epoch"
+                    )
                 self._barrier.wait(1.0)
             return True
 
@@ -83,10 +100,17 @@ class _StreamSplitCoordinator:
                 # Fairness gate: a split strictly ahead of the laggiest one
                 # waits its turn, so every split ends the epoch with k or
                 # k+1 blocks (lockstep SPMD consumers never actually wait).
+                # Best-effort with a deadline: a consumer that stopped
+                # pulling mid-epoch must not deadlock the rest — after 60s
+                # fairness yields and the live consumers drain the stream.
+                import time as _time
+
+                fair_deadline = _time.monotonic() + 60.0
                 while (
                     not self._done
                     and epoch == self._epoch
                     and self._taken[split_idx] > min(self._taken)
+                    and _time.monotonic() < fair_deadline
                 ):
                     self._barrier.wait(0.5)
             if epoch != self._epoch:

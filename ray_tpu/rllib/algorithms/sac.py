@@ -64,7 +64,18 @@ def make_sac_loss(config: SACConfig, target_entropy: float) -> Callable:
         )
         q1 = module.q_values(params["q1"], batch["obs"], batch["actions"])
         q2 = module.q_values(params["q2"], batch["obs"], batch["actions"])
-        critic_loss = jnp.mean(jnp.square(q1 - y)) + jnp.mean(jnp.square(q2 - y))
+        # loss_weight zeroes rows whose TD target is invalid (a truncated
+        # tail with no recorded final obs — the multi-agent runner emits
+        # these); the actor/alpha terms keep them, their states are real.
+        if "loss_weight" in batch:
+            w = batch["loss_weight"]
+            denom = jnp.maximum(jnp.sum(w), 1.0)
+            critic_loss = (
+                jnp.sum(w * jnp.square(q1 - y)) / denom
+                + jnp.sum(w * jnp.square(q2 - y)) / denom
+            )
+        else:
+            critic_loss = jnp.mean(jnp.square(q1 - y)) + jnp.mean(jnp.square(q2 - y))
 
         # --- actor: reparameterized a through frozen critics ----------------
         a_pi, logp_pi = module.sample(params, batch["obs"], batch["noise_pi"])
