@@ -124,8 +124,24 @@ def main():
             rows += len(batch["data"])
         assert rows == block_rows * n_blocks
 
+    # 5 timed runs: the metric is the MEDIAN with min/max recorded — ingest
+    # on a contended 1-core host is the highest-variance number here.
+    ingest(1)  # warmup (spawns read workers)
+    ingest_rates = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        ingest(1)
+        ingest_rates.append(total_gb / (time.perf_counter() - t0))
+    ingest_rates.sort()
     results.append(
-        timeit("data_ingest_streaming", ingest, 1, unit="GB/s", scale=total_gb)
+        {
+            "metric": "data_ingest_streaming",
+            "value": round(ingest_rates[2], 2),
+            "unit": "GB/s",
+            "n": 5,
+            "min": round(ingest_rates[0], 2),
+            "max": round(ingest_rates[-1], 2),
+        }
     )
 
     ray_tpu.shutdown()
@@ -134,10 +150,13 @@ def main():
         {
             "note": (
                 "data_ingest_streaming runs read->map FUSED (one serialize "
-                "per block); on a 1-core host the number is floored by "
-                "worker-side block generation + transform + one 16MB arena "
-                "write per block (~65% of wall time), not by operator "
-                "boundaries."
+                "per block) with whole-block batches, the event-driven "
+                "executor wait (completions wake the scheduler; no 20ms "
+                "tick latency per block), and read concurrency capped at "
+                "the single node's physical cores. Floor on this 1-core "
+                "host: worker-side block gen + transform + one 16MB arena "
+                "write per block (bare in-worker produce+ship measures "
+                "~2.1-2.3 GB/s)."
             )
         }
     ]

@@ -49,6 +49,11 @@ class _StreamSplitCoordinator:
         self._rows_out: List[int] = [0] * n
         self._taken: List[int] = [0] * n
         self._blocks_out = 0
+        # Epoch whose fairness gate tripped its deadline: fairness stays OFF
+        # for the remainder of that epoch (one consumer stopped pulling; the
+        # live ones must drain the stream at full speed, not one block per
+        # deadline).
+        self._fairness_off_epoch = -1
 
     def start_epoch(self, split_idx: int, epoch: int) -> bool:
         """Barrier: returns once epoch `epoch`'s stream is live."""
@@ -96,13 +101,15 @@ class _StreamSplitCoordinator:
         with self._barrier:
             if epoch != self._epoch or self._gen is None:
                 return None
-            if self._equal:
+            if self._equal and self._fairness_off_epoch != epoch:
                 # Fairness gate: a split strictly ahead of the laggiest one
                 # waits its turn, so every split ends the epoch with k or
                 # k+1 blocks (lockstep SPMD consumers never actually wait).
                 # Best-effort with a deadline: a consumer that stopped
-                # pulling mid-epoch must not deadlock the rest — after 60s
-                # fairness yields and the live consumers drain the stream.
+                # pulling mid-epoch must not deadlock the rest — on the
+                # first trip fairness turns OFF for the whole epoch, so the
+                # live consumers drain the stream at full speed (not one
+                # block per deadline).
                 import time as _time
 
                 fair_deadline = _time.monotonic() + 60.0
@@ -110,8 +117,10 @@ class _StreamSplitCoordinator:
                     not self._done
                     and epoch == self._epoch
                     and self._taken[split_idx] > min(self._taken)
-                    and _time.monotonic() < fair_deadline
                 ):
+                    if _time.monotonic() >= fair_deadline:
+                        self._fairness_off_epoch = epoch
+                        break
                     self._barrier.wait(0.5)
             if epoch != self._epoch:
                 return None
