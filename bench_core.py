@@ -144,6 +144,58 @@ def main():
         }
     )
 
+    # ------------------------------------------------------------------ GBDT
+    # Distributed histogram GBDT on a synthetic 1.0 GB dataset (the
+    # BASELINE.md XGBoost rows are the anchor: 693 s train / 786k rows/s
+    # predict for 100 GB on 10x m5.4xlarge = 160 cores; this box is ONE
+    # core). Train metric = boosted rows/s (rows x rounds / wall).
+    from ray_tpu.air import ScalingConfig
+    from ray_tpu.train.gbdt_trainer import GBDTTrainer
+
+    N, F, ROUNDS = 1_250_000, 100, 3
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((N, F))
+    w = rng.standard_normal(F)
+    y = X @ w + 0.1 * rng.standard_normal(N)
+    cols = {f"f{i}": X[:, i] for i in range(F)}
+    cols["y"] = y
+    gbdt_gb = (N * (F + 1) * 8) / 1e9
+    ds = rd.from_numpy(cols).repartition(8)
+    t0 = time.perf_counter()
+    res = GBDTTrainer(
+        datasets={"train": ds},
+        label_column="y",
+        params={"max_depth": 6, "eta": 0.3},
+        num_boost_round=ROUNDS,
+        scaling_config=ScalingConfig(num_workers=2),
+    ).fit()
+    train_s = time.perf_counter() - t0
+    assert res.error is None, res.error
+    results.append(
+        {
+            "metric": "gbdt_train_boosted_rows_per_s",
+            "value": round(N * ROUNDS / train_s, 0),
+            "unit": "rows/s",
+            "dataset_gb": round(gbdt_gb, 2),
+            "rounds": ROUNDS,
+            "seconds": round(train_s, 1),
+        }
+    )
+    model = res.checkpoint.to_dict()["model"]
+    t0 = time.perf_counter()
+    model.predict(X[:500_000])
+    pred_s = time.perf_counter() - t0
+    results.append(
+        {
+            "metric": "gbdt_predict_rows_per_s",
+            "value": round(500_000 / pred_s, 0),
+            "unit": "rows/s",
+            "trees": len(model.trees),
+            "seconds": round(pred_s, 2),
+        }
+    )
+    del X, y, cols, ds
+
     ray_tpu.shutdown()
 
     notes = [

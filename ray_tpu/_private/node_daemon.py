@@ -46,6 +46,10 @@ class NodeDaemon:
         self.conn = None
         self.node_id_hex = ""
         self._data_listener = None
+        self._data_address = None
+        # Worker exits whose report failed (head down mid-reconnect): resent
+        # after rejoin so the head never believes a dead worker alive.
+        self._unreported_exits: list = []
 
     def _local_host(self) -> str:
         """The address peers can reach this daemon at: the interface used to
@@ -121,7 +125,13 @@ class NodeDaemon:
         from multiprocessing.connection import Client
 
         authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", ""))
-        data_address = self._start_data_server()
+        # Reconnects reuse the live data server (its address is stable; a
+        # second listener per rejoin would leak sockets + threads).
+        if self._data_listener is not None:
+            data_address = self._data_address
+        else:
+            data_address = self._start_data_server()
+        self._data_address = data_address
         self.conn = Client((self.head_host, self.head_port), authkey=authkey)
         self.conn.send_bytes(
             serialization.dumps(
@@ -243,7 +253,12 @@ class NodeDaemon:
                         dead.append(wid)
                         del self.procs[wid]
             for wid in dead:
-                self._send(("worker_exit", wid))
+                if not self._send(("worker_exit", wid)):
+                    # Head unreachable (reconnect in flight): buffer — a
+                    # silently dropped exit would leave the rejoined head
+                    # waiting on a corpse.
+                    with self._lock:
+                        self._unreported_exits.append(wid)
             refresh_ms = getattr(self, "memory_monitor_refresh_ms", 500)
             now = time.time()
             if refresh_ms > 0 and now - last_mem >= max(refresh_ms, 100) / 1000.0:
@@ -264,7 +279,20 @@ class NodeDaemon:
         reaper.start()
         try:
             while True:
-                msg = serialization.loads(self.conn.recv_bytes())
+                try:
+                    msg = serialization.loads(self.conn.recv_bytes())
+                except (EOFError, OSError):
+                    # Head connection lost. A restarted head (--persist FT)
+                    # binds the same address: REJOIN instead of tearing the
+                    # node down, so head death stops costing every node its
+                    # daemon (reference: raylets reconnect to a restarted
+                    # GCS, `gcs_server.cc:59`). Workers of the old epoch die
+                    # on their own EOF; the reaper keeps reporting them
+                    # against the NEW registration, which ignores unknown
+                    # ids.
+                    if not self._reconnect():
+                        break
+                    continue
                 kind = msg[0]
                 if kind == "spawn_worker":
                     self._spawn_worker(msg[1])
@@ -276,8 +304,6 @@ class NodeDaemon:
                     self._delete_object(msg[1], msg[2] if len(msg) > 2 else None)
                 elif kind == "shutdown":
                     break
-        except (EOFError, OSError):
-            pass  # head gone: tear down
         finally:
             self._stop.set()
             with self._lock:
@@ -288,6 +314,40 @@ class NodeDaemon:
                     popen.kill()
                 except ProcessLookupError:
                     pass
+
+    def _reconnect(self) -> bool:
+        """Try to rejoin a (re)started head at the same address for up to
+        RAY_TPU_DAEMON_RECONNECT_S seconds (0 disables — the pre-FT
+        tear-down behavior). Returns True once re-registered."""
+        grace = float(os.environ.get("RAY_TPU_DAEMON_RECONNECT_S", "60"))
+        if grace <= 0:
+            return False
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        deadline = time.time() + grace
+        while time.time() < deadline and not self._stop.is_set():
+            try:
+                self.connect()
+                with self._lock:
+                    backlog, self._unreported_exits = self._unreported_exits, []
+                for wid in backlog:
+                    self._send(("worker_exit", wid))
+                print(
+                    f"RAY_TPU_NODE_REJOINED {self.node_id_hex}", flush=True
+                )
+                return True
+            except Exception:
+                # A half-open attempt (e.g. head up but registration
+                # rejected) must not leak its socket per retry.
+                try:
+                    if self.conn is not None:
+                        self.conn.close()
+                except Exception:
+                    pass
+                time.sleep(1.0)
+        return False
 
 
 def main() -> None:

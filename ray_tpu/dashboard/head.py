@@ -58,23 +58,13 @@ class DashboardServer:
         return web.Response(text=text, content_type="text/plain")
 
     async def _index(self, _request):
+        """The live web UI: one self-contained page (vanilla JS polling the
+        REST endpoints — reference ships a React SPA, `client/src/App.tsx`)."""
         from aiohttp import web
 
-        from ray_tpu.util import state as state_api
+        from ray_tpu.dashboard.ui import INDEX_HTML
 
-        loop = asyncio.get_event_loop()
-        s = await loop.run_in_executor(None, state_api.summarize)
-        rows = "".join(
-            f"<tr><td>{k}</td><td><pre>{json.dumps(v, default=str)}</pre></td></tr>"
-            for k, v in s.items()
-        )
-        html = (
-            "<html><head><title>ray_tpu dashboard</title></head><body>"
-            "<h2>ray_tpu cluster</h2><table border=1>" + rows + "</table>"
-            "<p>APIs: /api/cluster /api/nodes /api/actors /api/tasks "
-            "/api/objects /api/jobs /metrics</p></body></html>"
-        )
-        return web.Response(text=html, content_type="text/html")
+        return web.Response(text=INDEX_HTML, content_type="text/html")
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> int:

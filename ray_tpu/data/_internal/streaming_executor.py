@@ -224,6 +224,12 @@ class ReadOperator(PhysicalOperator):
         # Block pulled but its meta sidecar not yet (transient stall): retried
         # next poll so the block/meta alternation never desynchronizes.
         self._pending_block: Optional[Any] = None
+        self._pending_meta: Optional[Any] = None
+        # A bundle emitted WITHOUT its meta means the producer errored right
+        # after sealing it (the block ref holds the sealed error): the
+        # stream ending afterwards is that error's consequence, and must
+        # surface as the user's exception on consume — not as ObjectLost.
+        self._emitted_error_bundle = False
         self._started = False
         self.inputs_done = True
 
@@ -285,6 +291,12 @@ class ReadOperator(PhysicalOperator):
                 except ray_tpu.exceptions.GetTimeoutError:
                     break
                 except StopIteration:
+                    if self._emitted_error_bundle:
+                        # The producer errored and its poisoned bundle is
+                        # already flowing to the consumer, which will raise
+                        # the REAL exception: end this stream quietly.
+                        self._next_seq = len(self._entries)
+                        break
                     # The read task ended short of its entry count: blocks are
                     # LOST, not skippable — silent truncation would feed a
                     # training run partial data with no signal.
@@ -300,15 +312,20 @@ class ReadOperator(PhysicalOperator):
             # a long blocking wait here would park the whole pipeline behind
             # one slow producer (VERDICT r3 weak #6).
             try:
-                meta = ray_tpu.get(gen.next_ready(timeout=0.05))
+                meta_ref = self._pending_meta
+                if meta_ref is None:
+                    meta_ref = gen.next_ready(timeout=0.05)
+                meta = ray_tpu.get(meta_ref)
             except ray_tpu.exceptions.GetTimeoutError:
                 break
             except StopIteration:
                 # Producer errored between block and meta: the block ref holds
                 # the sealed error item — surface it on consume.
                 meta = None
+                self._emitted_error_bundle = True
             self._emit(RefBundle(self._pending_block, meta))
             self._pending_block = None
+            self._pending_meta = None
             self._next_seq += 1
             progressed = True
         return progressed
@@ -324,8 +341,15 @@ class ReadOperator(PhysicalOperator):
         if len(self.out_queue) >= ctx.max_output_queue_blocks or not budget_ok():
             return False
         if self._pending_block is not None:
-            # Waiting on a meta sidecar (arrives right behind its block):
-            # poll() retries it with its own short timeout.
+            # Waiting on the meta sidecar (the next generator item): park in
+            # its arrival like the block path — returning without waiting
+            # would spin the scheduler at poll frequency.
+            gen = self._gens[self._next_seq % len(self._gens)]
+            try:
+                if self._pending_meta is None:
+                    self._pending_meta = gen.next_ready(timeout=timeout)
+            except (ray_tpu.exceptions.GetTimeoutError, StopIteration):
+                pass
             return True
         gen = self._gens[self._next_seq % len(self._gens)]
         try:
@@ -359,12 +383,21 @@ class MapOperator(PhysicalOperator):
         # block order end-to-end (tasks still run concurrently behind it).
         self._inflight: deque = deque()  # (block_ref, meta_ref)
         self._cap: Optional[int] = None
+        self._cap_ts = 0.0
+
+    def _task_cap(self, ctx: DataContext) -> int:
+        # Cached with a short TTL: _default_task_cap makes control-plane
+        # round trips (cluster_resources + nodes) and dispatch runs on the
+        # hot scheduling loop — but cluster membership can change mid-run
+        # (a node joins), so the cap must not be frozen forever either.
+        now = time.monotonic()
+        if self._cap is None or now - self._cap_ts > 5.0:
+            self._cap = _default_task_cap(ctx)
+            self._cap_ts = now
+        return self._cap
 
     def start(self, ctx: DataContext) -> None:
-        # Cached: _default_task_cap makes control-plane round trips
-        # (cluster_resources + nodes) and dispatch runs on the hot
-        # scheduling loop; the cap is invariant for the run.
-        self._cap = _default_task_cap(ctx)
+        self._task_cap(ctx)
 
     def num_active_tasks(self) -> int:
         return len(self._inflight)
@@ -372,8 +405,7 @@ class MapOperator(PhysicalOperator):
     def dispatch(self, ctx: DataContext, budget_ok: Callable[[], bool]) -> bool:
         if not self.in_queue:
             return False
-        cap = self._cap if self._cap is not None else _default_task_cap(ctx)
-        if len(self._inflight) >= cap:
+        if len(self._inflight) >= self._task_cap(ctx):
             return False
         if not budget_ok():
             return False
@@ -521,12 +553,17 @@ def _default_task_cap(ctx: DataContext) -> int:
     try:
         cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
         nodes = ray_tpu.nodes()
-        if len(nodes) == 1:
-            # Single-node cluster: read/map tasks are memory-bandwidth
-            # bound, so concurrency beyond the host's PHYSICAL cores only
-            # adds contention (measured: 4 readers on a 1-core host run at
-            # ~0.6x the rate of cores-matched readers). Logical num_cpus is
-            # an admission-control declaration, not a parallelism oracle.
+        from ray_tpu._private.worker import DriverContext, global_worker
+
+        if len(nodes) == 1 and isinstance(global_worker.context, DriverContext):
+            # Single-node cluster with an IN-PROCESS head: every worker runs
+            # on THIS host, so its physical core count is authoritative.
+            # Read/map tasks are memory-bandwidth bound — concurrency beyond
+            # physical cores only adds contention (measured: 4 readers on a
+            # 1-core host run at ~0.6x cores-matched readers). Logical
+            # num_cpus is admission control, not a parallelism oracle.
+            # Remote drivers skip the clamp: their local core count says
+            # nothing about the node executing the tasks.
             import os
 
             cpus = min(cpus, os.cpu_count() or cpus)

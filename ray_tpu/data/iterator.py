@@ -116,10 +116,14 @@ class _StreamSplitCoordinator:
                 while (
                     not self._done
                     and epoch == self._epoch
+                    # Another waiter tripping the deadline releases everyone
+                    # parked here too, not just itself.
+                    and self._fairness_off_epoch != epoch
                     and self._taken[split_idx] > min(self._taken)
                 ):
                     if _time.monotonic() >= fair_deadline:
                         self._fairness_off_epoch = epoch
+                        self._barrier.notify_all()
                         break
                     self._barrier.wait(0.5)
             if epoch != self._epoch:

@@ -85,8 +85,14 @@ def test_dashboard_rest_and_metrics(ray_start_regular):
         assert any(t["name"] == "noop" for t in tasks)
         text = urllib.request.urlopen(f"{base}/metrics", timeout=15).read().decode()
         assert "dash_hits 5" in text
+        # The live web UI: self-contained page whose JS polls the REST
+        # endpoints the assertions above proved live — node/actor/task/job
+        # tables plus the refresh loop (reference: dashboard/client SPA).
         html = urllib.request.urlopen(f"{base}/", timeout=15).read().decode()
-        assert "ray_tpu cluster" in html
+        assert "ray_tpu dashboard" in html
+        for table in ("nodes-table", "actors-table", "tasks-table", "jobs-table"):
+            assert f'id="{table}"' in html, table
+        assert "/api/cluster" in html and "setInterval(refresh" in html
         assert urllib.request.urlopen(f"{base}/api/nope", timeout=15)
     except urllib.error.HTTPError as e:
         assert e.code == 404
