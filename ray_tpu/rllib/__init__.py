@@ -25,6 +25,7 @@ from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv, make_multi_agent
 from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
@@ -45,6 +46,8 @@ from ray_tpu.rllib.connectors import (
     UnsquashActions,
 )
 from ray_tpu.rllib.models import MODEL_DEFAULTS, ModelCatalog, register_custom_module
+from ray_tpu.rllib.utils.exploration import Exploration, build_exploration
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 
 __all__ = [
     "A2C",
@@ -53,6 +56,8 @@ __all__ = [
     "APPOConfig",
     "Algorithm",
     "AlgorithmConfig",
+    "ApexDQN",
+    "ApexDQNConfig",
     "BC",
     "BCConfig",
     "CQL",
@@ -66,6 +71,10 @@ __all__ = [
     "DQNConfig",
     "DeterministicContinuousModule",
     "EnvRunner",
+    "Exploration",
+    "build_exploration",
+    "PrioritizedReplayBuffer",
+    "ReplayBuffer",
     "FlattenObs",
     "IMPALA",
     "IMPALAConfig",

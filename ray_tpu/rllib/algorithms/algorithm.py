@@ -56,6 +56,13 @@ class AlgorithmConfig:
         self.evaluation_duration_unit: str = "episodes"
         self.evaluation_num_env_runners: int = 1
         self.evaluation_explore: bool = False
+        # Exploration (reference `.exploration(exploration_config=...)`,
+        # `rllib/utils/exploration/`): None -> each algorithm's built-in
+        # default (DQN epsilon-greedy, stochastic policies sample); a dict
+        # {"type": "SoftQ", ...} plugs a strategy from
+        # `ray_tpu.rllib.utils.exploration` into every env runner.
+        self.explore: bool = True
+        self.exploration_config: Any = None
 
     # ------------------------------------------------------------ fluent API
     def environment(self, env=None, *, env_config: Optional[dict] = None) -> "AlgorithmConfig":
@@ -116,6 +123,21 @@ class AlgorithmConfig:
             self.evaluation_num_env_runners = int(evaluation_num_env_runners)
         if evaluation_explore is not None:
             self.evaluation_explore = bool(evaluation_explore)
+        return self
+
+    def exploration(
+        self,
+        explore: Optional[bool] = None,
+        exploration_config: Any = None,
+    ) -> "AlgorithmConfig":
+        """Configure exploration (reference: `AlgorithmConfig.exploration`)."""
+        if explore is not None:
+            self.explore = bool(explore)
+        if exploration_config is not None:
+            from ray_tpu.rllib.utils.exploration import build_exploration
+
+            build_exploration(exploration_config)  # validate eagerly
+            self.exploration_config = exploration_config
         return self
 
     def learners(self, num_learners: Optional[int] = None) -> "AlgorithmConfig":
@@ -225,8 +247,13 @@ class Algorithm:
         from ray_tpu.rllib.env.env_runner import EnvRunner
         import ray_tpu
 
+        from ray_tpu.rllib.utils.exploration import build_exploration
+
         self.config = config
         self.iteration = 0
+        # Driver-side strategy instance: owns the annealing schedule whose
+        # values are pushed to runners each iteration (`exploration_push`).
+        self.exploration = build_exploration(config.exploration_config)
         creator = config.env_creator()
         if config.is_multi_agent:
             self._init_multi_agent(creator)
@@ -277,9 +304,19 @@ class Algorithm:
                 record_value_extras=self._record_value_extras,
                 obs_connector=config.env_to_module_connector,
                 action_connector=config.module_to_env_connector,
+                exploration=config.exploration_config,
+                default_explore=config.explore,
             )
             for i in range(n)
         ]
+
+    def exploration_push(self, env_steps: int):
+        """What to push to runners this iteration: the configured strategy's
+        schedule dict, or None when there is nothing to anneal."""
+        if self.exploration is None:
+            return None
+        sched = self.exploration.schedule(env_steps)
+        return sched or None
 
     # ------------------------------------------------------------- multi-agent
     # Whether this algorithm supports policy maps (PPO opts in; see
@@ -300,6 +337,14 @@ class Algorithm:
         if not self._supports_multi_agent:
             raise ValueError(
                 f"{type(self).__name__} does not support multi-agent training"
+            )
+        if config.exploration_config is not None:
+            # MultiAgentEnvRunner routes exploration through per-policy
+            # module forwards (epsilon push only); silently ignoring a
+            # configured strategy would misreport what trained.
+            raise ValueError(
+                "exploration_config strategies are single-agent only; "
+                "multi-agent policies use their modules' built-in exploration"
             )
         mapping = config.policy_mapping_fn
         if mapping is None:
@@ -377,6 +422,7 @@ class Algorithm:
                 seed=config.seed + 1000 * (i + 1),
                 gamma=config.gamma,
                 lambda_=getattr(config, "lambda_", 0.95),
+                default_explore=config.explore,
             )
             for i in range(config.num_env_runners)
         ]
@@ -446,9 +492,25 @@ class Algorithm:
         return out
 
     def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
         t0 = time.time()
         self.iteration += 1
+        # Annealed strategy state (epsilon/scale/pure_random) is pushed to
+        # EVERY algorithm's runners here — training_step implementations
+        # don't each re-wire the schedule plumbing. One-iteration lag on
+        # env_steps is inherent (steps count after sampling) and matches the
+        # reference's global-timestep-based schedule reads.
+        push = self.exploration_push(getattr(self, "env_steps", 0))
+        if push is not None and self.env_runners:
+            ray_tpu.get(
+                [r.set_exploration.remote(push) for r in self.env_runners]
+            )
         metrics = self.training_step()
+        if push is not None:
+            metrics.update(
+                {f"exploration/{k}": float(np.asarray(v)) for k, v in push.items()}
+            )
         cfg = self.config
         if (
             cfg.evaluation_interval
@@ -510,11 +572,16 @@ class Algorithm:
         else:
             weights = self.learner_group.get_weights()
         sync = [r.set_weights.remote(weights) for r in runners]
-        # Exploration schedules live in the driver (DQN epsilon): push the
-        # current value so evaluation_explore=True measures the schedule's
-        # policy, not a fresh runner's epsilon=1.0 uniform-random default.
-        if cfg.evaluation_explore and callable(getattr(self, "epsilon", None)):
-            sync += [r.set_exploration.remote(self.epsilon()) for r in runners]
+        # Exploration schedules live in the driver: push the current annealed
+        # value so evaluation_explore=True measures the schedule's policy, not
+        # a fresh runner's initial-state default (epsilon=1.0 / scale=1.0).
+        if cfg.evaluation_explore:
+            if self.exploration is not None:
+                push = self.exploration_push(getattr(self, "env_steps", 0))
+                if push is not None:
+                    sync += [r.set_exploration.remote(push) for r in runners]
+            elif callable(getattr(self, "epsilon", None)):
+                sync += [r.set_exploration.remote(self.epsilon()) for r in runners]
         # Eval runners adopt the training runners' connector state, frozen,
         # so normalization matches training without polluting its stats.
         if not self.is_multi_agent and self.env_runners and cfg.env_to_module_connector:

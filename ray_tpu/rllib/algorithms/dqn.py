@@ -21,6 +21,10 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.utils.replay_buffers import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
 
 
 class DQNConfig(AlgorithmConfig):
@@ -37,7 +41,28 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_final = 0.05
         self.epsilon_decay_steps = 10_000  # env steps
         self.grad_clip = 10.0
+        # None -> uniform ring buffer; {"type": "PrioritizedReplayBuffer",
+        # "alpha": .., "beta": ..} -> proportional prioritization with IS
+        # weights riding `loss_weight` (reference: DQNConfig
+        # `replay_buffer_config`, default MultiAgentPrioritizedReplayBuffer).
+        self.replay_buffer_config: Optional[Dict[str, Any]] = None
         self._algo_cls = DQN
+
+    def replay_is_prioritized(self) -> bool:
+        rbc = self.replay_buffer_config or {}
+        return rbc.get("type") in ("PrioritizedReplayBuffer", PrioritizedReplayBuffer)
+
+    def make_replay_buffer(self) -> ReplayBuffer:
+        rbc = self.replay_buffer_config
+        if rbc:
+            typ = rbc.get("type", "ReplayBuffer")
+            if self.replay_is_prioritized():
+                return PrioritizedReplayBuffer(
+                    self.buffer_capacity, alpha=rbc.get("alpha", 0.6)
+                )
+            if typ not in ("ReplayBuffer", ReplayBuffer):
+                raise ValueError(f"unknown replay buffer type {typ!r}")
+        return ReplayBuffer(self.buffer_capacity)
 
     def training(self, **kwargs) -> "DQNConfig":
         aliases = {"target_update_freq": "target_network_update_freq"}
@@ -46,30 +71,30 @@ class DQNConfig(AlgorithmConfig):
         return self
 
 
-class ReplayBuffer:
-    """Uniform ring buffer over flat numpy transitions (reference:
-    `rllib/utils/replay_buffers/replay_buffer.py`)."""
+def make_td_error_fn(config: "DQNConfig", module) -> Callable:
+    """Jitted |TD| per transition under (params, target_params) — the same
+    target math as `make_dqn_loss` reduced to the error vector; used to
+    refresh priorities after prioritized-replay updates (reference:
+    `dqn.py` `td_error` -> `update_priorities`)."""
+    import jax
+    import jax.numpy as jnp
 
-    def __init__(self, capacity: int):
-        self.capacity = capacity
-        self._store: Dict[str, np.ndarray] = {}
-        self._next = 0
-        self.size = 0
+    gamma, double_q = config.gamma, config.double_q
 
-    def add(self, batch: Dict[str, np.ndarray]) -> None:
-        n = len(next(iter(batch.values())))
-        if not self._store:
-            for k, v in batch.items():
-                self._store[k] = np.zeros((self.capacity,) + v.shape[1:], v.dtype)
-        idx = (self._next + np.arange(n)) % self.capacity
-        for k, v in batch.items():
-            self._store[k][idx] = v
-        self._next = (self._next + n) % self.capacity
-        self.size = min(self.size + n, self.capacity)
+    def td(params, target_params, obs, actions, rewards, next_obs, terminateds):
+        q_all, _ = module.forward(params, obs)
+        q_sa = jnp.take_along_axis(q_all, actions[..., None], axis=-1)[..., 0]
+        tq_all, _ = module.forward(target_params, next_obs)
+        if double_q:
+            nq, _ = module.forward(params, next_obs)
+            a_star = jnp.argmax(nq, axis=-1)
+            tq = jnp.take_along_axis(tq_all, a_star[..., None], axis=-1)[..., 0]
+        else:
+            tq = tq_all.max(axis=-1)
+        y = rewards + gamma * (1.0 - terminateds) * tq
+        return jnp.abs(q_sa - jnp.asarray(y, jnp.float32))
 
-    def sample(self, batch_size: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
-        idx = rng.integers(0, self.size, batch_size)
-        return {k: v[idx] for k, v in self._store.items()}
+    return jax.jit(td)
 
 
 def make_dqn_loss(config: DQNConfig) -> Callable:
@@ -175,11 +200,18 @@ class DQN(Algorithm):
     def __init__(self, config: DQNConfig):
         super().__init__(config)
         if self.is_multi_agent:
+            if config.replay_is_prioritized():
+                raise ValueError(
+                    "prioritized replay is single-agent here; use uniform "
+                    "buffers with multi-agent policy maps"
+                )
             self.buffers = {
                 pid: ReplayBuffer(config.buffer_capacity) for pid in self.modules
             }
         else:
-            self.buffer = ReplayBuffer(config.buffer_capacity)
+            self.buffer = config.make_replay_buffer()
+            if isinstance(self.buffer, PrioritizedReplayBuffer):
+                self._td_fn = make_td_error_fn(config, self.module)
         self.num_updates = 0
         self.env_steps = 0
         self._rng = np.random.default_rng(config.seed)
@@ -211,9 +243,13 @@ class DQN(Algorithm):
 
     # -------------------------------------------------------------- schedule
     def epsilon(self) -> float:
+        from ray_tpu.rllib.utils.exploration import _anneal
+
         cfg = self.config
-        frac = min(1.0, self.env_steps / max(1, cfg.epsilon_decay_steps))
-        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+        return _anneal(
+            cfg.epsilon_initial, cfg.epsilon_final, cfg.epsilon_decay_steps,
+            self.env_steps,
+        )
 
     # ----------------------------------------------------------- one iteration
     def _training_step_multi_agent(self) -> Dict[str, Any]:
@@ -232,27 +268,50 @@ class DQN(Algorithm):
             return self._training_step_multi_agent()
         cfg = self.config
         weights = self.learner_group.get_weights()
-        eps = self.epsilon()
-        ray_tpu.get(
-            [r.set_weights.remote(weights) for r in self.env_runners]
-            + [r.set_exploration.remote(eps) for r in self.env_runners]
-        )
+        sync = [r.set_weights.remote(weights) for r in self.env_runners]
+        out: Dict[str, Any] = {}
+        if self.exploration is None:
+            # Built-in epsilon-greedy schedule; configured strategies are
+            # pushed (and reported) by the base train() instead.
+            eps = self.epsilon()
+            sync += [r.set_exploration.remote(eps) for r in self.env_runners]
+            out["epsilon"] = eps
+        ray_tpu.get(sync)
         rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
         for ro in rollouts:
             self.buffer.add(self._transitions(ro))
             self.env_steps += int(ro["rewards"].size)
 
-        out: Dict[str, Any] = {
-            "epsilon": eps,
-            "buffer_size": self.buffer.size,
-            "num_env_steps_sampled": self.env_steps,
-        }
+        out.update(
+            buffer_size=self.buffer.size,
+            num_env_steps_sampled=self.env_steps,
+        )
+        prioritized = isinstance(self.buffer, PrioritizedReplayBuffer)
+        beta = (cfg.replay_buffer_config or {}).get("beta", 0.4)
         if self.buffer.size >= cfg.learning_starts:
             metrics_acc: List[Dict[str, float]] = []
             for _ in range(cfg.updates_per_iteration):
-                batch = self.buffer.sample(cfg.train_batch_size, self._rng)
+                if prioritized:
+                    batch = self.buffer.sample(
+                        cfg.train_batch_size, self._rng, beta=beta
+                    )
+                    idx = batch.pop("batch_indexes")
+                else:
+                    batch = self.buffer.sample(cfg.train_batch_size, self._rng)
                 metrics_acc.append(self.learner_group.update(batch))
                 self.num_updates += 1
+                if prioritized:
+                    # Refresh sampled priorities under post-update params.
+                    td = self._td_fn(
+                        self.learner_group.get_weights(),
+                        self.target_params,
+                        batch["obs"],
+                        batch["actions"],
+                        batch["rewards"],
+                        batch["next_obs"],
+                        batch["terminateds"],
+                    )
+                    self.buffer.update_priorities(idx, np.asarray(td))
                 if self.num_updates % cfg.target_network_update_freq == 0:
                     self._sync_target()
             out.update(

@@ -29,11 +29,14 @@ class EnvRunner:
         record_value_extras: bool = True,
         obs_connector: Any = None,
         action_connector: Any = None,
+        exploration: Any = None,
+        default_explore: bool = True,
     ):
         import gymnasium as gym
         import jax
 
         from ray_tpu.rllib.connectors.connector import build_connector
+        from ray_tpu.rllib.utils.exploration import build_exploration
 
         # gymnasium >=1.0 defaults vector envs to NEXT_STEP autoreset, where
         # the step after done ignores the action and returns the reset obs —
@@ -62,6 +65,10 @@ class EnvRunner:
         self.num_envs = num_envs
         self.rollout_length = rollout_length
         self.gamma = gamma
+        # `config.explore=False` (reference `AlgorithmConfig.explore`) pins
+        # training rollouts deterministic; evaluate() still overrides per
+        # call via sample(explore=...).
+        self._default_explore = bool(default_explore)
         # Algorithms that bootstrap truncations via runner-side values (PPO)
         # skip the obs-sized final_obs buffer entirely.
         self.record_final_obs = record_final_obs
@@ -95,7 +102,33 @@ class EnvRunner:
         self._value_based = getattr(module, "off_policy", False) or hasattr(
             module, "epsilon_greedy"
         )
-        if hasattr(module, "epsilon_greedy"):
+        # Pluggable exploration (reference: `rllib/utils/exploration/` via
+        # exploration_config). The strategy's knobs+noise ride a traced state
+        # pytree through ONE jitted fn — schedule pushes and OU evolution
+        # never recompile. `_clean_params` backs deterministic (explore=False)
+        # action paths when ParameterNoise perturbs the rollout params.
+        self._exploration = build_exploration(exploration)
+        self._clean_params = self._params
+        if self._exploration is not None:
+            strat = self._exploration
+            self._expl_state = strat.initial_state(num_envs, self._act_shape)
+            jitted_s = jax.jit(
+                lambda p, o, k, explore, st: strat.actions(
+                    module, p, o, k, explore, st
+                ),
+                static_argnums=(3,),
+            )
+
+            def _strategy_act(p, o, k, explore):
+                a, logp, v, d, st = jitted_s(
+                    p if explore else self._clean_params, o, k, explore,
+                    self._expl_state,
+                )
+                self._expl_state = st
+                return a, logp, v, d
+
+            self._act = _strategy_act
+        elif hasattr(module, "epsilon_greedy"):
             # Value-based modules (DQN): epsilon rides as a traced scalar so
             # exploration decay never retriggers compilation.
             jitted = jax.jit(
@@ -112,11 +145,27 @@ class EnvRunner:
             , static_argnums=(3,))
 
     def set_weights(self, weights) -> None:
-        self._params = weights
+        self._clean_params = weights
+        if self._exploration is not None:
+            import jax
 
-    def set_exploration(self, epsilon: float) -> None:
-        """Exploration state push (DQN epsilon schedule lives in the driver)."""
-        self._epsilon = float(epsilon)
+            # ParameterNoise redraws its perturbation here (once per sync);
+            # other strategies return the weights untouched.
+            self._key, sub = jax.random.split(self._key)
+            self._params = self._exploration.on_weights(weights, sub)
+        else:
+            self._params = weights
+
+    def set_exploration(self, value) -> None:
+        """Exploration push from the driver: a float (legacy DQN epsilon) or
+        a dict of schedule values merged into the strategy's traced state."""
+        if isinstance(value, dict):
+            if self._exploration is not None:
+                self._expl_state = {**self._expl_state, **value}
+            return
+        self._epsilon = float(value)
+        if self._exploration is not None and "epsilon" in self._expl_state:
+            self._expl_state = dict(self._expl_state, epsilon=np.float32(value))
 
     # ------------------------------------------------------------- connectors
     def _preprocess(self, obs) -> np.ndarray:
@@ -139,10 +188,12 @@ class EnvRunner:
             if freeze and hasattr(c, "frozen"):
                 c.frozen = True
 
-    def sample(self, explore: bool = True) -> Dict[str, np.ndarray]:
+    def sample(self, explore: Optional[bool] = None) -> Dict[str, np.ndarray]:
         """One rollout fragment: (T*num_envs) flat transition batch."""
         import jax
 
+        if explore is None:
+            explore = self._default_explore
         T, N = self.rollout_length, self.num_envs
         value_based = self._value_based
         need_logp = not value_based

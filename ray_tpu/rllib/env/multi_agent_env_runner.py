@@ -66,10 +66,13 @@ class MultiAgentEnvRunner:
         seed: int = 0,
         gamma: float = 0.99,
         lambda_: float = 0.95,
+        default_explore: bool = True,
     ):
         import jax
 
         self._envs = [env_creator() for _ in range(num_envs)]
+        # `config.explore=False` pins training rollouts deterministic.
+        self._default_explore = bool(default_explore)
         self.modules = modules
         self.policy_mapping_fn = policy_mapping_fn
         self.rollout_length = rollout_length
@@ -134,10 +137,12 @@ class MultiAgentEnvRunner:
         self._epsilon = float(epsilon)
 
     # ------------------------------------------------------------------ sample
-    def sample(self, explore: bool = True) -> Dict[str, Dict[str, np.ndarray]]:
+    def sample(self, explore=None) -> Dict[str, Dict[str, np.ndarray]]:
         """Collect `rollout_length` env steps; returns per-policy flat batches:
         GAE columns (advantages/value_targets) for policy-gradient maps, or
         (s, a, r, s', terminated) transitions for replay-trained maps."""
+        if explore is None:
+            explore = self._default_explore
         if self.value_based:
             keys = (
                 "obs", "actions", "rewards", "next_obs",

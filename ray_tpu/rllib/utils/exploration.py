@@ -1,0 +1,310 @@
+"""Exploration strategy library: pluggable, jit-safe action selection.
+
+Reference: `rllib/utils/exploration/` — EpsilonGreedy (`epsilon_greedy.py`),
+SoftQ (`soft_q.py`), StochasticSampling (`stochastic_sampling.py`), Random
+(`random.py`), GaussianNoise (`gaussian_noise.py`), OrnsteinUhlenbeckNoise
+(`ornstein_uhlenbeck_noise.py`), ParameterNoise (`parameter_noise.py`).
+
+TPU-first shape: a strategy is a pair of pure functions — `actions(...)`
+runs INSIDE the runner's single jitted forward with all annealable knobs
+(epsilon, noise scale, OU state) passed as a traced pytree `state`, so
+schedule decay and stateful noise never retrigger compilation; `schedule()`
+is driver-side numpy that recomputes the annealed scalars from the global
+env-step count and is pushed to runners with the weight sync. The reference
+instead threads framework-conditional torch/tf ops through each policy's
+action sampler; here the jit boundary forces the clean split.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class Exploration:
+    """Interface. `actions` must be pure/jittable: state in, state out."""
+
+    #: strategies that need per-env persistent arrays (OU noise) override.
+    def initial_state(self, num_envs: int, act_shape: Tuple[int, ...]) -> Dict[str, Any]:
+        return {}
+
+    def schedule(self, env_steps: int) -> Dict[str, Any]:
+        """Driver-side: annealed scalars for the current global step count.
+        Merged into the runner's live state by `EnvRunner.set_exploration`."""
+        return {}
+
+    def on_weights(self, params, key):
+        """Hook at weight-sync time (ParameterNoise perturbs here). Returns
+        the params the ROLLOUT should use; learner params are untouched."""
+        return params
+
+    def actions(self, module, params, obs, key, explore: bool, state: Dict[str, Any]):
+        """(action, logp, value, dist_inputs, new_state); jit-safe."""
+        raise NotImplementedError
+
+
+def _anneal(initial: float, final: float, steps: int, t: int) -> float:
+    frac = min(1.0, t / max(1, steps))
+    return float(initial + frac * (final - initial))
+
+
+class EpsilonGreedy(Exploration):
+    """Annealed epsilon-greedy over Q-values (reference:
+    `rllib/utils/exploration/epsilon_greedy.py`)."""
+
+    def __init__(self, initial_epsilon: float = 1.0, final_epsilon: float = 0.05,
+                 epsilon_timesteps: int = 10_000):
+        self.initial_epsilon = float(initial_epsilon)
+        self.final_epsilon = float(final_epsilon)
+        self.epsilon_timesteps = int(epsilon_timesteps)
+
+    def initial_state(self, num_envs, act_shape):
+        return {"epsilon": np.float32(self.initial_epsilon)}
+
+    def schedule(self, env_steps):
+        return {
+            "epsilon": np.float32(
+                _anneal(self.initial_epsilon, self.final_epsilon,
+                        self.epsilon_timesteps, env_steps)
+            )
+        }
+
+    def actions(self, module, params, obs, key, explore, state):
+        import jax
+        import jax.numpy as jnp
+
+        if hasattr(module, "epsilon_greedy"):
+            # Q modules carry the canonical implementation (QMLPModule);
+            # delegating keeps one copy of the argmax/dither block.
+            a, logp, v, d = module.epsilon_greedy(
+                params, obs, key, explore, state["epsilon"]
+            )
+            return a, logp, v, d, state
+        q, value = module.forward(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        if explore:
+            k1, k2 = jax.random.split(key)
+            random_a = jax.random.randint(k1, greedy.shape, 0, q.shape[-1])
+            u = jax.random.uniform(k2, greedy.shape)
+            action = jnp.where(u < state["epsilon"], random_a, greedy)
+        else:
+            action = greedy
+        return action, jnp.zeros(greedy.shape, jnp.float32), value, q, state
+
+
+class SoftQ(Exploration):
+    """Boltzmann sampling from softmax(Q / temperature) (reference:
+    `rllib/utils/exploration/soft_q.py`)."""
+
+    def __init__(self, temperature: float = 1.0):
+        self.temperature = float(temperature)
+
+    def initial_state(self, num_envs, act_shape):
+        return {"temperature": np.float32(self.temperature)}
+
+    def actions(self, module, params, obs, key, explore, state):
+        import jax
+        import jax.numpy as jnp
+
+        q, value = module.forward(params, obs)
+        if explore:
+            logits = q / jnp.maximum(state["temperature"], 1e-8)
+            action = jax.random.categorical(key, logits, axis=-1)
+        else:
+            action = jnp.argmax(q, axis=-1)
+        return action, jnp.zeros(action.shape, jnp.float32), value, q, state
+
+
+class StochasticSampling(Exploration):
+    """Sample the module's own action distribution (reference:
+    `rllib/utils/exploration/stochastic_sampling.py` — the PG default)."""
+
+    def actions(self, module, params, obs, key, explore, state):
+        a, logp, v, d = module.action_dist(params, obs, key, explore)
+        return a, logp, v, d, state
+
+
+class Random(Exploration):
+    """Uniform-random actions while exploring; greedy otherwise (reference:
+    `rllib/utils/exploration/random.py` — pure-exploration warmup)."""
+
+    def actions(self, module, params, obs, key, explore, state):
+        import jax
+        import jax.numpy as jnp
+
+        if not explore:
+            a, logp, v, d = module.action_dist(params, obs, key, False)
+            return a, logp, v, d, state
+        out, value = module.forward(params, obs)
+        low = getattr(module, "act_low", None)
+        if low is not None:  # continuous Box
+            action = jax.random.uniform(
+                key, obs.shape[:-1] + (module.act_dim,),
+                minval=module.act_low, maxval=module.act_high,
+            )
+            return action, jnp.zeros(action.shape[:-1], jnp.float32), value, out, state
+        action = jax.random.randint(key, out.shape[:-1], 0, out.shape[-1])
+        return action, jnp.zeros(action.shape, jnp.float32), value, out, state
+
+
+class GaussianNoise(Exploration):
+    """Deterministic action + annealed additive Gaussian noise, clipped to
+    bounds (reference: `rllib/utils/exploration/gaussian_noise.py` — the
+    DDPG/TD3 default). `scale` anneals initial->final over scale_timesteps."""
+
+    def __init__(self, stddev: float = 0.1, initial_scale: float = 1.0,
+                 final_scale: float = 1.0, scale_timesteps: int = 10_000,
+                 random_timesteps: int = 0):
+        self.stddev = float(stddev)
+        self.initial_scale = float(initial_scale)
+        self.final_scale = float(final_scale)
+        self.scale_timesteps = int(scale_timesteps)
+        self.random_timesteps = int(random_timesteps)
+
+    def initial_state(self, num_envs, act_shape):
+        return {
+            "scale": np.float32(self.initial_scale),
+            # >0 while in the pure-random warmup phase.
+            "pure_random": np.float32(1.0 if self.random_timesteps > 0 else 0.0),
+        }
+
+    def schedule(self, env_steps):
+        return {
+            "scale": np.float32(
+                _anneal(self.initial_scale, self.final_scale,
+                        self.scale_timesteps, env_steps)
+            ),
+            "pure_random": np.float32(1.0 if env_steps < self.random_timesteps else 0.0),
+        }
+
+    def actions(self, module, params, obs, key, explore, state):
+        import jax
+        import jax.numpy as jnp
+
+        a = module.pi(params, obs)
+        if explore:
+            k1, k2 = jax.random.split(key)
+            noise = jax.random.normal(k1, a.shape) * (
+                self.stddev * state["scale"] * module.scale
+            )
+            noisy = jnp.clip(a + noise, module.act_low, module.act_high)
+            rand = jax.random.uniform(
+                k2, a.shape, minval=module.act_low, maxval=module.act_high
+            )
+            a = jnp.where(state["pure_random"] > 0, rand, noisy)
+        value = module.q_values(params["q1"], obs, a)
+        return a, jnp.zeros(a.shape[:-1], jnp.float32), value, a, state
+
+
+class OrnsteinUhlenbeckNoise(Exploration):
+    """Temporally-correlated OU noise for continuous control (reference:
+    `rllib/utils/exploration/ornstein_uhlenbeck_noise.py`). The OU process
+    x += theta*(-x)*dt + sigma*sqrt(dt)*N(0,1) lives in the traced state as a
+    (num_envs, act_dim) array — it evolves inside jit across steps and
+    persists across rollout fragments."""
+
+    def __init__(self, ou_theta: float = 0.15, ou_sigma: float = 0.2,
+                 ou_base_scale: float = 0.1, initial_scale: float = 1.0,
+                 final_scale: float = 1.0, scale_timesteps: int = 10_000):
+        self.ou_theta = float(ou_theta)
+        self.ou_sigma = float(ou_sigma)
+        self.ou_base_scale = float(ou_base_scale)
+        self.initial_scale = float(initial_scale)
+        self.final_scale = float(final_scale)
+        self.scale_timesteps = int(scale_timesteps)
+
+    def initial_state(self, num_envs, act_shape):
+        return {
+            "scale": np.float32(self.initial_scale),
+            "ou": np.zeros((num_envs,) + tuple(act_shape), np.float32),
+        }
+
+    def schedule(self, env_steps):
+        return {
+            "scale": np.float32(
+                _anneal(self.initial_scale, self.final_scale,
+                        self.scale_timesteps, env_steps)
+            )
+        }
+
+    def actions(self, module, params, obs, key, explore, state):
+        import jax
+        import jax.numpy as jnp
+
+        a = module.pi(params, obs)
+        new_state = state
+        if explore:
+            ou = state["ou"]
+            drift = jax.random.normal(key, ou.shape)
+            ou = ou + self.ou_theta * (-ou) + self.ou_sigma * drift
+            noise = self.ou_base_scale * state["scale"] * ou * module.scale
+            a = jnp.clip(a + noise, module.act_low, module.act_high)
+            new_state = dict(state, ou=ou)
+        value = module.q_values(params["q1"], obs, a)
+        return a, jnp.zeros(a.shape[:-1], jnp.float32), value, a, new_state
+
+
+class ParameterNoise(Exploration):
+    """Adaptive parameter-space noise (reference:
+    `rllib/utils/exploration/parameter_noise.py`, Plappert et al. 2018):
+    the ROLLOUT acts greedily under weights perturbed once per weight sync
+    with N(0, stddev) — exploration comes from a consistently-different
+    policy rather than per-step action dithering. Learner weights are never
+    perturbed; each sync draws a fresh perturbation."""
+
+    def __init__(self, stddev: float = 0.05):
+        self.stddev = float(stddev)
+
+    def on_weights(self, params, key):
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(key, len(leaves))
+        perturbed = [
+            l + self.stddev * jax.random.normal(k, jnp.shape(l), jnp.float32)
+            if hasattr(l, "dtype") and jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+            else l
+            for l, k in zip(leaves, keys)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, perturbed)
+
+    def actions(self, module, params, obs, key, explore, state):
+        # Greedy under the (already-perturbed) rollout params.
+        a, logp, v, d = module.action_dist(params, obs, key, False)
+        return a, logp, v, d, state
+
+
+_STRATEGIES = {
+    "EpsilonGreedy": EpsilonGreedy,
+    "SoftQ": SoftQ,
+    "StochasticSampling": StochasticSampling,
+    "Random": Random,
+    "GaussianNoise": GaussianNoise,
+    "OrnsteinUhlenbeckNoise": OrnsteinUhlenbeckNoise,
+    "ParameterNoise": ParameterNoise,
+}
+
+
+def build_exploration(spec: Any) -> Optional[Exploration]:
+    """Resolve an exploration spec: None, an Exploration instance, or a dict
+    {"type": <name-or-class>, **kwargs} (the reference's exploration_config
+    format, `rllib/utils/exploration/exploration.py from_config`)."""
+    if spec is None or isinstance(spec, Exploration):
+        return spec
+    if isinstance(spec, type) and issubclass(spec, Exploration):
+        return spec()
+    if isinstance(spec, dict):
+        spec = dict(spec)
+        typ = spec.pop("type", None)
+        if typ is None:
+            raise ValueError("exploration_config requires a 'type' key")
+        if isinstance(typ, str):
+            if typ not in _STRATEGIES:
+                raise ValueError(
+                    f"unknown exploration type {typ!r}; one of {sorted(_STRATEGIES)}"
+                )
+            typ = _STRATEGIES[typ]
+        return typ(**spec)
+    raise TypeError(f"unsupported exploration spec: {type(spec)}")

@@ -18,9 +18,20 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+    # The virtual 8-device mesh time-shares this box's core(s): all 8 device
+    # programs' pre-collective compute serializes, so a heavy first step
+    # (conv grads compiling + executing) can exceed XLA CPU's default 40s
+    # collective-rendezvous kill switch, which hard-aborts the process
+    # (rendezvous.cc "Termination timeout ... Exiting"). Raise warn/terminate
+    # far above any legitimate single-step skew; a true deadlock still dies,
+    # just slower.
+    flags = (
+        flags + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=600"
     ).strip()
+os.environ["XLA_FLAGS"] = flags
 
 try:
     import jax

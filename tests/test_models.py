@@ -386,21 +386,24 @@ def test_resnet_forward_and_dp_training():
     logits = resnet.forward(params, imgs, cfg)
     assert logits.shape == (4, 10) and logits.dtype == jnp.float32
 
+    # 16x16 inputs + few steps: each step's 8 device programs serialize on
+    # this box's core, and a slow step under load risks XLA CPU's collective
+    # rendezvous watchdog (see conftest) — keep the per-step conv work small.
     mesh = MeshSpec(data=8).build()
-    opt = default_optimizer(learning_rate=5e-3)
+    opt = default_optimizer(learning_rate=1e-2)
     state = create_train_state(cfg, jax.random.PRNGKey(0), opt, mesh=mesh)
     step = make_train_step(cfg, opt, mesh=mesh)
     rng = np.random.default_rng(0)
-    # Learnable toy task: class = quadrant brightness pattern.
+    # Learnable toy task: class = channel-0 brightness.
     labels = rng.integers(0, 10, (16,))
-    images = rng.standard_normal((16, 32, 32, 3)).astype(np.float32) * 0.1
+    images = rng.standard_normal((16, 16, 16, 3)).astype(np.float32) * 0.1
     for i, lb in enumerate(labels):
         images[i, :, :, 0] += lb * 0.3  # class signal in channel 0
     batch = shard_batch(
         {"images": images, "labels": labels.astype(np.int32)}, mesh
     )
     first = None
-    for _ in range(60):
+    for _ in range(30):
         state, m = step(state, batch)
         first = first or float(m["loss"])
     # ln(10)=2.3 at random init; memorizing 16 examples should cut it sharply.

@@ -1,0 +1,369 @@
+"""Exploration library + prioritized replay + Ape-X DQN.
+
+Reference: `rllib/utils/exploration/` (EpsilonGreedy/SoftQ/Random/
+GaussianNoise/OrnsteinUhlenbeckNoise/ParameterNoise),
+`rllib/utils/replay_buffers/prioritized_replay_buffer.py`,
+`rllib/algorithms/apex_dqn/apex_dqn.py`.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _imports():
+    pytest.importorskip("gymnasium")
+
+
+# ----------------------------------------------------------- prioritized replay
+def test_prioritized_buffer_sampling_tracks_priorities():
+    from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer
+
+    rng = np.random.default_rng(0)
+    buf = PrioritizedReplayBuffer(64, alpha=1.0)
+    buf.add({"x": np.arange(64, dtype=np.float32)})
+    # Give item 7 overwhelming priority: it should dominate samples.
+    buf.update_priorities(np.arange(64), np.full(64, 1e-3))
+    buf.update_priorities(np.array([7]), np.array([1e3]))
+    got = buf.sample(256, rng, beta=0.0)
+    frac7 = float(np.mean(got["x"] == 7.0))
+    assert frac7 > 0.9, frac7
+    # IS weights: the over-sampled item carries the SMALLEST weight.
+    w = got["loss_weight"]
+    hot = w[got["x"] == 7.0]
+    assert hot.max() <= w.max() and np.isclose(w.max(), 1.0)
+    assert "batch_indexes" in got
+
+
+def test_prioritized_buffer_tree_consistency_fuzz():
+    """Sum-tree root equals the sum of live leaf priorities through random
+    interleaved adds/updates (incl. duplicate indices in one update)."""
+    from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer
+
+    rng = np.random.default_rng(1)
+    buf = PrioritizedReplayBuffer(37, alpha=0.8)  # non-power-of-two capacity
+    for round_ in range(30):
+        n = int(rng.integers(1, 9))
+        buf.add({"x": rng.random(n).astype(np.float32)})
+        if buf.size:
+            m = int(rng.integers(1, 6))
+            idx = rng.integers(0, buf.size, m)  # may contain duplicates
+            buf.update_priorities(idx, rng.random(m) * 5)
+            leaves = buf._tree[buf._cap2 : buf._cap2 + buf._cap2]
+            assert np.isclose(buf._tree[1], leaves.sum()), round_
+    got = buf.sample(32, rng)
+    assert len(got["x"]) == 32
+    assert np.all(got["batch_indexes"] < buf.size)
+
+
+def test_uniform_buffer_parity_with_dqn_import():
+    # DQN's buffer and the utils buffer are the same implementation surface.
+    from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+    rng = np.random.default_rng(2)
+    buf = ReplayBuffer(8)
+    buf.add({"a": np.arange(12, dtype=np.int64)})  # wraps the ring
+    assert buf.size == 8
+    got = buf.sample(16, rng)
+    assert set(np.unique(got["a"])) <= set(range(4, 12))
+
+
+# ------------------------------------------------------------------ strategies
+def _q_module():
+    from ray_tpu.rllib.core.rl_module import QMLPModule
+
+    return QMLPModule(obs_dim=4, num_actions=3, hiddens=(16,))
+
+
+def _cont_module():
+    from ray_tpu.rllib.core.rl_module import DeterministicContinuousModule
+
+    return DeterministicContinuousModule(
+        obs_dim=3, act_low=[-2.0], act_high=[2.0], hiddens=(16,)
+    )
+
+
+def _run(strat, module, explore=True, steps=3, num_envs=5):
+    import jax
+
+    params = module.init(jax.random.PRNGKey(0))
+    act_shape = (module.act_dim,) if hasattr(module, "act_dim") else ()
+    state = strat.initial_state(num_envs, act_shape)
+    jitted = jax.jit(
+        lambda p, o, k, e, st: strat.actions(module, p, o, k, e, st),
+        static_argnums=(3,),
+    )
+    obs = np.ones((num_envs, module.obs_dim), np.float32)
+    key = jax.random.PRNGKey(1)
+    outs = []
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        a, logp, v, d, state = jitted(params, obs, sub, explore, state)
+        outs.append(np.asarray(a))
+    return outs, state, params
+
+
+def test_epsilon_greedy_schedule_and_extremes():
+    from ray_tpu.rllib.utils.exploration import EpsilonGreedy
+
+    strat = EpsilonGreedy(initial_epsilon=1.0, final_epsilon=0.1,
+                          epsilon_timesteps=100)
+    assert np.isclose(strat.schedule(0)["epsilon"], 1.0)
+    assert np.isclose(strat.schedule(50)["epsilon"], 0.55)
+    assert np.isclose(strat.schedule(10_000)["epsilon"], 0.1)
+    m = _q_module()
+    # epsilon pinned to 0 -> greedy == explore=False path.
+    outs, state, _ = _run(strat, m, explore=True)
+    greedy, _, _ = _run(strat, m, explore=False)
+    state["epsilon"] = np.float32(0.0)
+    import jax
+
+    params = m.init(jax.random.PRNGKey(0))
+    a, *_ = strat.actions(params=params, module=m, obs=np.ones((5, 4), np.float32),
+                          key=jax.random.PRNGKey(9), explore=True, state=state)
+    assert np.array_equal(np.asarray(a), greedy[0])
+
+
+def test_softq_and_random_discrete():
+    from ray_tpu.rllib.utils.exploration import Random, SoftQ
+
+    m = _q_module()
+    outs, _, _ = _run(SoftQ(temperature=50.0), m, steps=40, num_envs=8)
+    # Very high temperature ~ uniform: all 3 actions appear.
+    assert len(np.unique(np.concatenate(outs))) == 3
+    outs, _, _ = _run(Random(), m, steps=40, num_envs=8)
+    assert len(np.unique(np.concatenate(outs))) == 3
+    # explore=False falls back to greedy (deterministic across steps).
+    outs, _, _ = _run(Random(), m, explore=False)
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_gaussian_and_ou_noise_continuous():
+    from ray_tpu.rllib.utils.exploration import (
+        GaussianNoise,
+        OrnsteinUhlenbeckNoise,
+    )
+
+    m = _cont_module()
+    det, _, _ = _run(GaussianNoise(stddev=0.3), m, explore=False)
+    noisy, _, _ = _run(GaussianNoise(stddev=0.3), m, explore=True)
+    assert not np.allclose(det[0], noisy[0])
+    assert np.all(noisy[0] >= -2.0) and np.all(noisy[0] <= 2.0)
+    # Pure-random warmup phase draws uniform over the Box.
+    g = GaussianNoise(stddev=0.0, random_timesteps=10)
+    st = g.schedule(0)
+    assert st["pure_random"] > 0
+    assert g.schedule(11)["pure_random"] == 0.0
+    # OU state evolves in the traced state and is temporally correlated.
+    ou = OrnsteinUhlenbeckNoise(ou_sigma=0.5)
+    outs, state, _ = _run(ou, m, steps=5)
+    assert not np.allclose(np.asarray(state["ou"]), 0.0)
+
+
+def test_parameter_noise_perturbs_rollout_params_only():
+    import jax
+
+    from ray_tpu.rllib.utils.exploration import ParameterNoise
+
+    m = _q_module()
+    params = m.init(jax.random.PRNGKey(0))
+    strat = ParameterNoise(stddev=0.1)
+    pp = strat.on_weights(params, jax.random.PRNGKey(3))
+    flat = jax.tree_util.tree_leaves(params)
+    flat_p = jax.tree_util.tree_leaves(pp)
+    assert any(not np.allclose(a, b) for a, b in zip(flat, flat_p))
+    # Same key -> same perturbation (deterministic for a given sync).
+    pp2 = strat.on_weights(params, jax.random.PRNGKey(3))
+    for a, b in zip(flat_p, jax.tree_util.tree_leaves(pp2)):
+        assert np.allclose(a, b)
+
+
+def test_build_exploration_spec_forms():
+    from ray_tpu.rllib.utils.exploration import (
+        EpsilonGreedy,
+        SoftQ,
+        build_exploration,
+    )
+
+    assert build_exploration(None) is None
+    s = build_exploration({"type": "SoftQ", "temperature": 2.0})
+    assert isinstance(s, SoftQ) and s.temperature == 2.0
+    s2 = build_exploration({"type": EpsilonGreedy, "final_epsilon": 0.2})
+    assert isinstance(s2, EpsilonGreedy) and s2.final_epsilon == 0.2
+    inst = SoftQ()
+    assert build_exploration(inst) is inst
+    with pytest.raises(ValueError):
+        build_exploration({"type": "NoSuchStrategy"})
+
+
+# ----------------------------------------------------------- runner integration
+def test_config_explore_false_pins_rollouts_deterministic():
+    """`.exploration(explore=False)` (reference AlgorithmConfig.explore)
+    makes default sample() identical to an explicit explore=False pass."""
+    _imports()
+    import gymnasium as gym
+
+    from ray_tpu.rllib.core.rl_module import QMLPModule
+    from ray_tpu.rllib.env.env_runner import EnvRunner
+
+    def creator():
+        return gym.make("CartPole-v1")
+
+    mod = QMLPModule(obs_dim=4, num_actions=2, hiddens=(16,))
+    pinned = EnvRunner(creator, mod, num_envs=2, rollout_length=16, seed=3,
+                       default_explore=False)
+    explicit = EnvRunner(creator, mod, num_envs=2, rollout_length=16, seed=3)
+    a = pinned.sample()  # default path must NOT explore
+    b = explicit.sample(explore=False)
+    assert np.array_equal(a["actions"], b["actions"])
+    assert np.array_equal(a["rewards"], b["rewards"])
+
+
+def test_dqn_softq_exploration_config(ray_start_regular):
+    """DQN rides a pluggable exploration strategy end-to-end."""
+    _imports()
+    from ray_tpu.rllib import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .training(
+            train_batch_size=32,
+            learning_starts=64,
+            updates_per_iteration=4,
+            buffer_capacity=2000,
+        )
+        .env_runners(num_env_runners=1, num_envs_per_runner=2,
+                     rollout_fragment_length=32)
+        .exploration(exploration_config={"type": "SoftQ", "temperature": 1.0})
+    )
+    algo = config.build()
+    try:
+        res = algo.train()
+        assert res["num_env_steps_sampled"] > 0
+        res = algo.train()
+        assert "loss" in res or "td_error_mean" in res
+    finally:
+        algo.stop()
+
+
+def test_dqn_prioritized_replay_learns(ray_start_regular):
+    """DQN with the prioritized buffer: IS weights flow through loss_weight
+    and TD priorities are refreshed after updates."""
+    _imports()
+    from ray_tpu.rllib import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .training(
+            train_batch_size=32,
+            learning_starts=64,
+            updates_per_iteration=8,
+            buffer_capacity=2000,
+            replay_buffer_config={
+                "type": "PrioritizedReplayBuffer",
+                "alpha": 0.6,
+                "beta": 0.4,
+            },
+        )
+        .env_runners(num_env_runners=1, num_envs_per_runner=2,
+                     rollout_fragment_length=32)
+    )
+    algo = config.build()
+    try:
+        for _ in range(3):
+            res = algo.train()
+        assert res["buffer_size"] > 0
+        st = algo.buffer.stats()
+        # Priorities were refreshed: max priority moved off its 1.0 init.
+        assert st["max_priority"] != 1.0
+    finally:
+        algo.stop()
+
+
+def test_apex_dqn_distributed_replay(ray_start_regular):
+    """Ape-X: sharded replay actors fill, per-worker epsilons follow the
+    power schedule, learner updates run and refresh shard priorities."""
+    _imports()
+    from ray_tpu.rllib import ApexDQNConfig
+
+    config = (
+        ApexDQNConfig()
+        .environment("CartPole-v1")
+        .training(
+            train_batch_size=32,
+            learning_starts=96,
+            updates_per_iteration=6,
+            buffer_capacity=4000,
+        )
+        .env_runners(num_env_runners=2, num_envs_per_runner=2,
+                     rollout_fragment_length=32)
+    )
+    algo = config.build()
+    try:
+        eps = algo.worker_epsilons()
+        assert len(eps) == 2 and eps[0] > eps[1]  # power schedule decays
+        got_update = False
+        for _ in range(6):
+            res = algo.train()
+            if "td_error_mean" in res:
+                got_update = True
+                break
+        assert got_update, res
+        assert sum(res["replay_shard_sizes"]) >= 96
+        assert len(res["replay_shard_sizes"]) == 2
+        stats = ray_tpu.get([s.stats.remote() for s in algo.replay_shards])
+        assert any(s["max_priority"] != 1.0 for s in stats)
+        # Checkpoint round-trip inherits DQN's save/restore.
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            algo.save(d)
+            algo.restore(d)
+    finally:
+        algo.stop()
+
+
+def test_td3_with_ou_exploration(ray_start_regular):
+    """TD3's runner swaps its default Gaussian dither for OU noise via
+    exploration_config — the continuous-control seam."""
+    _imports()
+    from ray_tpu.rllib import TD3Config
+
+    config = (
+        TD3Config()
+        .environment("Pendulum-v1")
+        .training(
+            train_batch_size=32,
+            learning_starts=64,
+            updates_per_iteration=2,
+            buffer_capacity=2000,
+        )
+        .env_runners(num_env_runners=1, num_envs_per_runner=1,
+                     rollout_fragment_length=32)
+        .exploration(
+            exploration_config={"type": "OrnsteinUhlenbeckNoise", "ou_sigma": 0.3}
+        )
+    )
+    algo = config.build()
+    try:
+        res = algo.train()
+        assert res["num_env_steps_sampled"] > 0
+        # The base train() pushes and reports the strategy's annealed state
+        # for EVERY algorithm (not just DQN).
+        assert "exploration/scale" in res, sorted(res)
+    finally:
+        algo.stop()
+
+
+def test_apex_rejects_exploration_config():
+    _imports()
+    from ray_tpu.rllib import ApexDQNConfig
+
+    cfg = ApexDQNConfig().environment("CartPole-v1").exploration(
+        exploration_config={"type": "SoftQ"}
+    )
+    with pytest.raises(ValueError, match="per-worker"):
+        cfg.build()
