@@ -12,6 +12,10 @@ function over a device mesh — grads sync via the mesh's data axis inside XLA
 (vectorized gymnasium envs); only the learner touches accelerator devices.
 """
 
+from ray_tpu.rllib.core.distributional import (
+    DistributionalQModule,
+    DuelingQMLPModule,
+)
 from ray_tpu.rllib.core.rl_module import (
     DeterministicContinuousModule,
     MLPModule,
@@ -70,6 +74,8 @@ __all__ = [
     "DQN",
     "DQNConfig",
     "DeterministicContinuousModule",
+    "DistributionalQModule",
+    "DuelingQMLPModule",
     "EnvRunner",
     "Exploration",
     "build_exploration",

@@ -91,6 +91,14 @@ class ApexDQN(DQN):
                 "exploration_config is not supported (tune "
                 "per_worker_epsilon_base/exponent instead)"
             )
+        if config.replay_buffer_config is not None:
+            # Sharded prioritized replay actors ARE the algorithm; a uniform
+            # replay_buffer_config would be silently overridden otherwise.
+            raise ValueError(
+                "ApexDQN always uses sharded prioritized replay; configure "
+                "prioritized_replay_alpha/beta + num_replay_shards instead "
+                "of replay_buffer_config"
+            )
         Algorithm.__init__(self, config)
         shard_cls = ray_tpu.remote(ReplayShard)
         self.replay_shards: List[Any] = [
@@ -167,7 +175,9 @@ class ApexDQN(DQN):
             for ref in ready:
                 runner = self._pending.pop(ref)
                 ro = ray_tpu.get(ref)
-                trans = self._transitions(ro)
+                trans = self._transitions(
+                    ro, self.config.n_step, self.config.gamma
+                )
                 shard = self.replay_shards[self._shard_rr % len(self.replay_shards)]
                 self._shard_rr += 1
                 adds.append(shard.add.remote(trans))
@@ -230,6 +240,7 @@ class ApexDQN(DQN):
                 batch["rewards"],
                 batch["next_obs"],
                 batch["terminateds"],
+                batch.get("discount"),
             )
             shard.update_priorities.remote(idx, np.asarray(td))
             if self.num_updates % cfg.target_network_update_freq == 0:

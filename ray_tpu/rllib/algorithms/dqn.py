@@ -41,6 +41,16 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_final = 0.05
         self.epsilon_decay_steps = 10_000  # env steps
         self.grad_clip = 10.0
+        # Rainbow knobs (reference DQNConfig: `n_step`, `num_atoms`,
+        # `v_min/v_max`, `dueling` — Rainbow is DQN configuration, not a
+        # separate algorithm). n_step > 1 builds n-step returns with per-row
+        # bootstrap discounts; num_atoms > 1 switches to the C51 categorical
+        # distributional loss on a DistributionalQModule.
+        self.n_step = 1
+        self.num_atoms = 1
+        self.v_min = -10.0
+        self.v_max = 10.0
+        self.dueling = False
         # None -> uniform ring buffer; {"type": "PrioritizedReplayBuffer",
         # "alpha": .., "beta": ..} -> proportional prioritization with IS
         # weights riding `loss_weight` (reference: DQNConfig
@@ -81,7 +91,8 @@ def make_td_error_fn(config: "DQNConfig", module) -> Callable:
 
     gamma, double_q = config.gamma, config.double_q
 
-    def td(params, target_params, obs, actions, rewards, next_obs, terminateds):
+    def td(params, target_params, obs, actions, rewards, next_obs, terminateds,
+           discount=None):
         q_all, _ = module.forward(params, obs)
         q_sa = jnp.take_along_axis(q_all, actions[..., None], axis=-1)[..., 0]
         tq_all, _ = module.forward(target_params, next_obs)
@@ -91,7 +102,8 @@ def make_td_error_fn(config: "DQNConfig", module) -> Callable:
             tq = jnp.take_along_axis(tq_all, a_star[..., None], axis=-1)[..., 0]
         else:
             tq = tq_all.max(axis=-1)
-        y = rewards + gamma * (1.0 - terminateds) * tq
+        disc = gamma if discount is None else discount
+        y = rewards + disc * (1.0 - terminateds) * tq
         return jnp.abs(q_sa - jnp.asarray(y, jnp.float32))
 
     return jax.jit(td)
@@ -118,7 +130,11 @@ def make_dqn_loss(config: DQNConfig) -> Callable:
             tq = jnp.take_along_axis(tq_all, a_star[..., None], axis=-1)[..., 0]
         else:
             tq = tq_all.max(axis=-1)
-        y = batch["rewards"] + gamma * (1.0 - batch["terminateds"]) * tq
+        # n-step batches carry a per-row bootstrap discount (gamma^h, h the
+        # realized horizon — fragment tails have h < n); 1-step batches fall
+        # back to the scalar. Dict membership is trace-time static.
+        disc = batch["discount"] if "discount" in batch else gamma
+        y = batch["rewards"] + disc * (1.0 - batch["terminateds"]) * tq
         y = jnp.asarray(y, jnp.float32)
         td = q_sa - y
         # loss_weight is all-ones when the runner recorded true final
@@ -134,6 +150,103 @@ def make_dqn_loss(config: DQNConfig) -> Callable:
         return total, aux
 
     return loss
+
+
+def make_c51_loss(config: DQNConfig) -> Callable:
+    """Categorical distributional TD loss (C51, Bellemare et al. 2017;
+    reference: `dqn_torch_policy.py` num_atoms>1 branch). The Bellman-updated
+    support Tz = r + gamma^h * (1-term) * z is projected onto the fixed atom
+    grid and trained by cross-entropy against the online log-probs of the
+    taken action; double-DQN selects the target action by online Q means.
+    Projection is one-hot einsum — scatter-free, fuses on the MXU path."""
+    gamma = config.gamma
+    double_q = config.double_q
+
+    def loss(module, params, batch, extra):
+        import jax
+        import jax.numpy as jnp
+
+        natoms = module.num_atoms
+        support = jnp.asarray(module.support)
+        delta = (module.v_max - module.v_min) / (natoms - 1)
+
+        logits = module.dist_logits(params, batch["obs"])  # (B, A, K)
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        act = batch["actions"][..., None, None]
+        logp_sa = jnp.take_along_axis(
+            logp_all, jnp.broadcast_to(act, act.shape[:-2] + (1, natoms)), axis=-2
+        )[..., 0, :]  # (B, K)
+
+        tprobs = module.dist_probs(extra["target_params"], batch["next_obs"])
+        if double_q:
+            q_next, _ = module.forward(params, batch["next_obs"])
+        else:
+            q_next = jnp.sum(tprobs * support, axis=-1)
+        a_star = jnp.argmax(q_next, axis=-1)[..., None, None]
+        p_next = jnp.take_along_axis(
+            tprobs, jnp.broadcast_to(a_star, a_star.shape[:-2] + (1, natoms)),
+            axis=-2,
+        )[..., 0, :]  # (B, K)
+
+        disc = batch["discount"][..., None] if "discount" in batch else gamma
+        Tz = jnp.clip(
+            batch["rewards"][..., None]
+            + disc * (1.0 - batch["terminateds"])[..., None] * support,
+            module.v_min,
+            module.v_max,
+        )
+        b = (Tz - module.v_min) / delta
+        lo = jnp.clip(jnp.floor(b), 0, natoms - 1)
+        hi = jnp.clip(lo + 1, 0, natoms - 1)
+        w_hi = b - lo  # 0 when b sits on an atom (incl. the top atom: hi==lo)
+        w_lo = 1.0 - w_hi
+        lo_i = lo.astype(jnp.int32)
+        hi_i = hi.astype(jnp.int32)
+        m = jnp.einsum(
+            "bj,bjk->bk", p_next * w_lo, jax.nn.one_hot(lo_i, natoms)
+        ) + jnp.einsum("bj,bjk->bk", p_next * w_hi, jax.nn.one_hot(hi_i, natoms))
+        m = jax.lax.stop_gradient(m)
+
+        ce = -jnp.sum(m * logp_sa, axis=-1)  # (B,)
+        weight = batch["loss_weight"]
+        total = jnp.sum(weight * ce) / jnp.maximum(jnp.sum(weight), 1.0)
+        # Q(s,a) for metrics from the ALREADY-computed logits (no second
+        # trunk forward): E_z[softmax] of the taken action's atom row.
+        q_sa = jnp.sum(jnp.exp(logp_sa) * support, axis=-1)
+        aux = {
+            # Cross-entropy vs the projected target doubles as the TD-error
+            # proxy (it is also what prioritized replay re-prioritizes on).
+            "td_error_mean": total,
+            "q_mean": jnp.mean(q_sa),
+        }
+        return total, aux
+
+    return loss
+
+
+def n_step_columns(rew, dones, terms, n: int, gamma: float):
+    """Vectorized n-step window math over (T, N) rollout buffers.
+
+    Returns (returns, end_index, discount): per row t the discounted reward
+    sum over steps t..e (stopping at the first done or the fragment edge),
+    the inclusive end index e, and the bootstrap discount gamma^(e-t+1).
+    Loops over the n offsets only — O(n) vector ops, not O(T*N*n) Python.
+    """
+    T, N = rew.shape
+    R = rew.astype(np.float32).copy()
+    end = np.tile(np.arange(T, dtype=np.int64)[:, None], (1, N))
+    discount = np.full((T, N), gamma, np.float32)
+    cont = 1.0 - dones  # window may extend past step t+k-1
+    for k in range(1, n):
+        ext = cont[: T - k]  # rows that extend to step t+k
+        R[: T - k] += (gamma**k) * rew[k:] * ext
+        end[: T - k] = np.where(ext > 0, np.arange(k, T)[:, None], end[: T - k])
+        discount[: T - k] = np.where(
+            ext > 0, np.float32(gamma ** (k + 1)), discount[: T - k]
+        )
+        cont = cont.copy()
+        cont[: T - k] *= 1.0 - dones[k:]
+    return R, end, discount
 
 
 def replay_ma_training_step(
@@ -205,6 +318,14 @@ class DQN(Algorithm):
                     "prioritized replay is single-agent here; use uniform "
                     "buffers with multi-agent policy maps"
                 )
+            if config.n_step != 1 or config.num_atoms != 1 or config.dueling:
+                # The MA path's transitions are built runner-side (1-step,
+                # scalar Q); silently training different targets than
+                # configured would misreport what trained.
+                raise ValueError(
+                    "n_step/num_atoms/dueling are single-agent DQN knobs; "
+                    "multi-agent policy maps train 1-step scalar Q"
+                )
             self.buffers = {
                 pid: ReplayBuffer(config.buffer_capacity) for pid in self.modules
             }
@@ -230,7 +351,37 @@ class DQN(Algorithm):
     # Q-network module from the catalog (epsilon-greedy exploration).
     _module_kind = "q"
 
+    def make_module(self, obs_dim: int, num_actions: int):
+        cfg = self.config
+        if cfg.num_atoms > 1:
+            from ray_tpu.rllib.core.distributional import DistributionalQModule
+
+            m = cfg.model or {}
+            return DistributionalQModule(
+                obs_dim,
+                num_actions,
+                hiddens=tuple(m.get("hiddens", (64, 64))),
+                activation=m.get("activation", "tanh"),
+                num_atoms=cfg.num_atoms,
+                v_min=cfg.v_min,
+                v_max=cfg.v_max,
+                dueling=cfg.dueling,
+            )
+        if cfg.dueling:
+            from ray_tpu.rllib.core.distributional import DuelingQMLPModule
+
+            m = cfg.model or {}
+            return DuelingQMLPModule(
+                obs_dim,
+                num_actions,
+                hiddens=tuple(m.get("hiddens", (64, 64))),
+                activation=m.get("activation", "tanh"),
+            )
+        return super().make_module(obs_dim, num_actions)
+
     def make_loss(self) -> Callable:
+        if self.config.num_atoms > 1:
+            return make_c51_loss(self.config)
         return make_dqn_loss(self.config)
 
     def make_optimizer(self):
@@ -279,7 +430,7 @@ class DQN(Algorithm):
         ray_tpu.get(sync)
         rollouts = ray_tpu.get([r.sample.remote() for r in self.env_runners])
         for ro in rollouts:
-            self.buffer.add(self._transitions(ro))
+            self.buffer.add(self._transitions(ro, cfg.n_step, cfg.gamma))
             self.env_steps += int(ro["rewards"].size)
 
         out.update(
@@ -310,6 +461,7 @@ class DQN(Algorithm):
                         batch["rewards"],
                         batch["next_obs"],
                         batch["terminateds"],
+                        batch.get("discount"),
                     )
                     self.buffer.update_priorities(idx, np.asarray(td))
                 if self.num_updates % cfg.target_network_update_freq == 0:
@@ -320,8 +472,11 @@ class DQN(Algorithm):
         return self.collect_episode_metrics(out)
 
     @staticmethod
-    def _transitions(ro: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """(T, N) rollout buffers -> flat (s, a, r, s', terminated, weight)."""
+    def _transitions(
+        ro: Dict[str, np.ndarray], n_step: int = 1, gamma: float = 0.99
+    ) -> Dict[str, np.ndarray]:
+        """(T, N) rollout buffers -> flat (s, a, r, s', terminated, weight);
+        n_step > 1 adds n-step returns + a per-row bootstrap `discount`."""
         obs, dones, terms = ro["obs"], ro["dones"], ro["terminateds"]
         next_obs = np.concatenate([obs[1:], ro["last_obs"][None]], axis=0)
         # SAME_STEP autoreset: the row after a done holds the reset obs, which
@@ -340,15 +495,33 @@ class DQN(Algorithm):
             )
             next_obs = np.where(mask > 0, final_obs, next_obs)
             weight = np.ones_like(dones)
+        rewards = ro["rewards"]
         flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
-        return {
+        out = {
             "obs": flat(obs).astype(np.float32),
             "actions": flat(ro["actions"]),
-            "rewards": flat(ro["rewards"]).astype(np.float32),
-            "next_obs": flat(next_obs).astype(np.float32),
-            "terminateds": flat(terms).astype(np.float32),
-            "loss_weight": flat(weight).astype(np.float32),
         }
+        if n_step > 1:
+            # Each row's window runs to its end index e (first done or the
+            # fragment edge); bootstrap obs/terminal/weight are GATHERED from
+            # row e, so truncation handling above applies transitively.
+            R, end, discount = n_step_columns(rewards, dones, terms, n_step, gamma)
+            envi = np.arange(obs.shape[1])
+            out.update(
+                rewards=flat(R),
+                next_obs=flat(next_obs[end, envi]).astype(np.float32),
+                terminateds=flat(terms[end, envi]).astype(np.float32),
+                loss_weight=flat(weight[end, envi]).astype(np.float32),
+                discount=flat(discount),
+            )
+        else:
+            out.update(
+                rewards=flat(rewards).astype(np.float32),
+                next_obs=flat(next_obs).astype(np.float32),
+                terminateds=flat(terms).astype(np.float32),
+                loss_weight=flat(weight).astype(np.float32),
+            )
+        return out
 
     # -------------------------------------------------------------- checkpoint
     def _extra_state(self) -> Dict[str, Any]:

@@ -1,0 +1,125 @@
+"""Distributional (C51) Q-network module with optional dueling heads.
+
+Reference: `rllib/algorithms/dqn/dqn_torch_model.py` (`num_atoms > 1`
+categorical distributional head, `dueling` value/advantage split — the
+reference's Rainbow pieces are DQN config knobs, not a separate algorithm)
+and Bellemare et al. 2017 (C51).
+
+TPU-first shape: the module emits per-action atom LOGITS in one (B, A,
+natoms) tensor from a shared trunk — the dueling combine (value + advantage
+- mean advantage) happens in logit space inside the same jitted forward, and
+scalar Q-values are the support-weighted softmax reduced on-device. The
+categorical projection lives in the loss (`dqn.py make_c51_loss`), not here.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import QValueModule, mlp_forward, mlp_init
+
+
+class DuelingQMLPModule(QValueModule):
+    """Scalar dueling Q-net (reference `dueling=True`, num_atoms=1):
+    Q(s,a) = V(s) + A(s,a) - mean_a A(s,a), heads off a shared trunk."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64), activation: str = "tanh"):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+        self.activation = activation
+
+    def init(self, key):
+        import jax
+
+        kt, ka, kv = jax.random.split(key, 3)
+        return {
+            "trunk": mlp_init(kt, (self.obs_dim, *self.hiddens)),
+            "adv": mlp_init(ka, (self.hiddens[-1], self.num_actions)),
+            "val": mlp_init(kv, (self.hiddens[-1], 1)),
+        }
+
+    def forward(self, params, obs):
+        from ray_tpu.rllib.core.rl_module import _activation
+
+        h = _activation(self.activation)(
+            mlp_forward(params["trunk"], obs, self.activation)
+        )
+        adv = mlp_forward(params["adv"], h, self.activation)
+        val = mlp_forward(params["val"], h, self.activation)
+        q = val + adv - adv.mean(axis=-1, keepdims=True)
+        return q, q.max(axis=-1)
+
+
+class DistributionalQModule(QValueModule):
+    """C51 Q-net: trunk -> (dueling) atom-logit heads; Q = E_z[softmax]."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64), activation: str = "tanh",
+                 num_atoms: int = 51, v_min: float = -10.0, v_max: float = 10.0,
+                 dueling: bool = True):
+        if num_atoms < 2:
+            raise ValueError("num_atoms must be >= 2 (use QMLPModule for scalar Q)")
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+        self.activation = activation
+        self.num_atoms = int(num_atoms)
+        self.v_min = float(v_min)
+        self.v_max = float(v_max)
+        self.dueling = bool(dueling)
+        # Fixed support; a buffer, not a parameter.
+        self.support = np.linspace(v_min, v_max, num_atoms).astype(np.float32)
+
+    def init(self, key):
+        import jax
+
+        kt, ka, kv = jax.random.split(key, 3)
+        trunk_sizes = (self.obs_dim, *self.hiddens)
+        params = {
+            "trunk": mlp_init(kt, trunk_sizes),
+            "adv": mlp_init(
+                ka, (self.hiddens[-1], self.num_actions * self.num_atoms)
+            ),
+        }
+        if self.dueling:
+            params["val"] = mlp_init(kv, (self.hiddens[-1], self.num_atoms))
+        return params
+
+    # -------------------------------------------------------------- forwards
+    def _trunk(self, params, obs):
+        act = mlp_forward(params["trunk"], obs, self.activation)
+        # mlp_forward leaves the last layer linear; the trunk feeds heads, so
+        # apply the nonlinearity it skipped.
+        from ray_tpu.rllib.core.rl_module import _activation
+
+        return _activation(self.activation)(act)
+
+    def dist_logits(self, params, obs):
+        """(B, A, natoms) atom logits; dueling combine in logit space."""
+        import jax.numpy as jnp
+
+        h = self._trunk(params, obs)
+        adv = mlp_forward(params["adv"], h, self.activation).reshape(
+            obs.shape[:-1] + (self.num_actions, self.num_atoms)
+        )
+        if not self.dueling:
+            return adv
+        val = mlp_forward(params["val"], h, self.activation)[..., None, :]
+        return val + adv - adv.mean(axis=-2, keepdims=True)
+
+    def dist_probs(self, params, obs):
+        import jax
+
+        return jax.nn.softmax(self.dist_logits(params, obs), axis=-1)
+
+    def forward(self, params, obs):
+        """Scalar Q-values (B, A) = support-weighted atom probabilities."""
+        import jax.numpy as jnp
+
+        probs = self.dist_probs(params, obs)
+        q = jnp.sum(probs * jnp.asarray(self.support), axis=-1)
+        return q, q.max(axis=-1)

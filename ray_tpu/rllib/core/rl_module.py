@@ -102,30 +102,15 @@ class RLModule:
         return action, act_logp, value, logits
 
 
-class QMLPModule(RLModule):
-    """Single-tower Q-network MLP for value-based algorithms: forward returns
-    per-action Q-values (logits slot) + max-Q (value slot); exploration is
-    epsilon-greedy with epsilon passed as a traced scalar (the runner jits
-    once and decays epsilon without recompiling). No value tower — every
-    weight here is read on the Q path (checkpoints, target copies, and weight
-    syncs stay half the size of the two-tower policy module)."""
+class QValueModule(RLModule):
+    """Base for Q-value modules: subclasses define forward -> (q, max_q) and
+    inherit the ONE epsilon-greedy implementation. The runner detects
+    value-based modules by the presence of `epsilon_greedy`, so this method
+    must live here and NOT on RLModule (policy modules would otherwise be
+    misrouted onto the epsilon path)."""
 
     # Replay-trained: the runner skips logp/value/dist buffers entirely.
     off_policy = True
-
-    def __init__(self, obs_dim: int, num_actions: int, hiddens: Sequence[int] = (64, 64),
-                 activation: str = "tanh"):
-        self.obs_dim = obs_dim
-        self.num_actions = num_actions
-        self.hiddens = tuple(hiddens)
-        self.activation = activation
-
-    def init(self, key):
-        return {"q": mlp_init(key, (self.obs_dim, *self.hiddens, self.num_actions))}
-
-    def forward(self, params, obs):
-        q = mlp_forward(params["q"], obs, self.activation)
-        return q, q.max(axis=-1)
 
     def epsilon_greedy(self, params, obs, key, explore: bool, epsilon):
         import jax
@@ -142,6 +127,29 @@ class QMLPModule(RLModule):
             action = greedy
         # logp slot unused for value-based policies; q rides the logits slot.
         return action, jnp.zeros(greedy.shape, jnp.float32), value, q
+
+
+class QMLPModule(QValueModule):
+    """Single-tower Q-network MLP for value-based algorithms: forward returns
+    per-action Q-values (logits slot) + max-Q (value slot); exploration is
+    epsilon-greedy with epsilon passed as a traced scalar (the runner jits
+    once and decays epsilon without recompiling). No value tower — every
+    weight here is read on the Q path (checkpoints, target copies, and weight
+    syncs stay half the size of the two-tower policy module)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hiddens: Sequence[int] = (64, 64),
+                 activation: str = "tanh"):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+        self.activation = activation
+
+    def init(self, key):
+        return {"q": mlp_init(key, (self.obs_dim, *self.hiddens, self.num_actions))}
+
+    def forward(self, params, obs):
+        q = mlp_forward(params["q"], obs, self.activation)
+        return q, q.max(axis=-1)
 
 
 class MLPModule(RLModule):
