@@ -214,8 +214,9 @@ def make_c51_loss(config: DQNConfig) -> Callable:
         # trunk forward): E_z[softmax] of the taken action's atom row.
         q_sa = jnp.sum(jnp.exp(logp_sa) * support, axis=-1)
         aux = {
-            # Cross-entropy vs the projected target doubles as the TD-error
-            # proxy (it is also what prioritized replay re-prioritizes on).
+            # Cross-entropy vs the projected target is the reported TD-error
+            # METRIC only; prioritized replay refreshes priorities through
+            # the scalar-Q `make_td_error_fn` in training_step.
             "td_error_mean": total,
             "q_mean": jnp.mean(q_sa),
         }
@@ -224,7 +225,7 @@ def make_c51_loss(config: DQNConfig) -> Callable:
     return loss
 
 
-def n_step_columns(rew, dones, terms, n: int, gamma: float):
+def n_step_columns(rew, dones, n: int, gamma: float):
     """Vectorized n-step window math over (T, N) rollout buffers.
 
     Returns (returns, end_index, discount): per row t the discounted reward
@@ -353,29 +354,38 @@ class DQN(Algorithm):
 
     def make_module(self, obs_dim: int, num_actions: int):
         cfg = self.config
-        if cfg.num_atoms > 1:
-            from ray_tpu.rllib.core.distributional import DistributionalQModule
+        if cfg.num_atoms > 1 or cfg.dueling:
+            # Same model-dict conventions as the catalog path (fcnet_*
+            # aliases honored); custom_module cannot combine with the
+            # Rainbow architectures, so fail loudly instead of bypassing it.
+            from ray_tpu.rllib.models.catalog import _activation, _hiddens
 
             m = cfg.model or {}
-            return DistributionalQModule(
-                obs_dim,
-                num_actions,
-                hiddens=tuple(m.get("hiddens", (64, 64))),
-                activation=m.get("activation", "tanh"),
-                num_atoms=cfg.num_atoms,
-                v_min=cfg.v_min,
-                v_max=cfg.v_max,
-                dueling=cfg.dueling,
-            )
-        if cfg.dueling:
+            if m.get("custom_module"):
+                raise ValueError(
+                    "custom_module cannot be combined with num_atoms>1/"
+                    "dueling (those knobs select their own architectures)"
+                )
+            hiddens, activation = _hiddens(m), _activation(m)
+            if cfg.num_atoms > 1:
+                from ray_tpu.rllib.core.distributional import (
+                    DistributionalQModule,
+                )
+
+                return DistributionalQModule(
+                    obs_dim,
+                    num_actions,
+                    hiddens=hiddens,
+                    activation=activation,
+                    num_atoms=cfg.num_atoms,
+                    v_min=cfg.v_min,
+                    v_max=cfg.v_max,
+                    dueling=cfg.dueling,
+                )
             from ray_tpu.rllib.core.distributional import DuelingQMLPModule
 
-            m = cfg.model or {}
             return DuelingQMLPModule(
-                obs_dim,
-                num_actions,
-                hiddens=tuple(m.get("hiddens", (64, 64))),
-                activation=m.get("activation", "tanh"),
+                obs_dim, num_actions, hiddens=hiddens, activation=activation
             )
         return super().make_module(obs_dim, num_actions)
 
@@ -505,7 +515,7 @@ class DQN(Algorithm):
             # Each row's window runs to its end index e (first done or the
             # fragment edge); bootstrap obs/terminal/weight are GATHERED from
             # row e, so truncation handling above applies transitively.
-            R, end, discount = n_step_columns(rewards, dones, terms, n_step, gamma)
+            R, end, discount = n_step_columns(rewards, dones, n_step, gamma)
             envi = np.arange(obs.shape[1])
             out.update(
                 rewards=flat(R),

@@ -22,8 +22,7 @@ def test_n_step_columns_respects_episode_boundaries():
 
     rew = np.array([[1.0], [1.0], [1.0], [1.0]], np.float32)
     dones = np.array([[0.0], [0.0], [1.0], [0.0]], np.float32)
-    terms = dones.copy()
-    R, end, disc = n_step_columns(rew, dones, terms, n=3, gamma=0.5)
+    R, end, disc = n_step_columns(rew, dones, n=3, gamma=0.5)
     # Row 0 spans steps 0-2 (stops AFTER including the done step).
     assert np.isclose(R[0, 0], 1 + 0.5 + 0.25)
     assert end[0, 0] == 2 and np.isclose(disc[0, 0], 0.125)
