@@ -31,10 +31,12 @@ from ray_tpu.serve.handle import (
     DeploymentResponse,
     DeploymentResponseGenerator,
 )
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve._private.common import AutoscalingConfig
 from ray_tpu.serve._private.http_proxy import ProxyRequest
 
 __all__ = [
+    "batch",
     "Application",
     "AutoscalingConfig",
     "Deployment",

@@ -34,6 +34,11 @@ class DeploymentInfo:
     init_args: Tuple = ()
     init_kwargs: Dict[str, Any] = field(default_factory=dict)
     num_replicas: int = 1
+    # In-flight calls one replica accepts concurrently (reference
+    # `max_concurrent_queries`, default 100 there). Default 1 keeps the
+    # strict one-at-a-time replica; raise it to overlap requests — required
+    # for `@serve.batch` to ever see a second item.
+    max_concurrent_queries: int = 1
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     autoscaling_config: Optional[AutoscalingConfig] = None
     route_prefix: Optional[str] = None

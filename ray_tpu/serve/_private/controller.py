@@ -124,6 +124,10 @@ class ServeController:
             opts = dict(info.ray_actor_options or {})
             opts.setdefault("num_cpus", 0.1)
             opts["name"] = f"SERVE_REPLICA::{rid}"
+            if info.max_concurrent_queries > 1:
+                # Threaded replica calls; async user methods share the
+                # actor's event loop, where @serve.batch queues live.
+                opts["max_concurrency"] = int(info.max_concurrent_queries)
             handle = (
                 ray_tpu.remote(ServeReplica)
                 .options(**opts)

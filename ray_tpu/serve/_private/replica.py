@@ -2,12 +2,16 @@
 
 Reference: `python/ray/serve/_private/replica.py:276` (`RayServeReplica`) —
 resolves the user class/function, injects handle arguments, executes requests.
-One request at a time (the actor's ordered queue); concurrency comes from
-replica count, balanced by the router's power-of-two choice.
+By default one request at a time (the actor's ordered queue) with concurrency
+from replica count, balanced by the router's power-of-two choice; the
+deployment option `max_concurrent_queries > 1` runs calls on a thread pool
+(async user methods then share the actor's one event loop — where
+`@serve.batch` queues accumulate).
 """
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Any, Dict, Tuple
 
@@ -25,47 +29,75 @@ class ServeReplica:
             if init_args or init_kwargs:
                 raise ValueError("function deployments take no init args")
             self._callable = target
+        # Lock-free under concurrent calls (threaded replicas).
+        self._request_counter = itertools.count(1)
         self._requests = 0
         self._started = time.time()
 
-    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict[str, Any]):
-        self._requests += 1
+    def _count_request(self) -> None:
+        self._requests = next(self._request_counter)
+
+    def _resolve(self, method_name: str):
         if method_name == "__call__":
             target = self._callable
             if not callable(target):
                 raise AttributeError(
                     f"deployment {self.deployment_name} object is not callable"
                 )
-        else:
-            target = getattr(self._callable, method_name)
-        return target(*args, **kwargs)
+            return target
+        return getattr(self._callable, method_name)
 
-    def handle_request_stream(self, method_name: str, args: Tuple,
-                              kwargs: Dict[str, Any]):
+    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict[str, Any]):
+        self._count_request()
+        return self._resolve(method_name)(*args, **kwargs)
+
+    async def handle_request_stream(self, method_name: str, args: Tuple,
+                                    kwargs: Dict[str, Any]):
         """Streaming variant (called with num_returns="streaming"): a user
         method returning a generator streams each item as its own object; a
         plain return streams one ("single", value) event. First element of
         each event tells the consumer which case it is (reference: streaming
-        deployment responses, `_private/replica.py` CallableWrapper gen path)."""
+        deployment responses, `_private/replica.py` CallableWrapper gen path).
+
+        An ASYNC generator: the worker drives it on the actor's shared event
+        loop, so `async def` deployments (and their `@serve.batch` queues,
+        which must see every concurrent request on ONE loop) work over the
+        proxy's streaming path, not just the handle path. SYNC user code must
+        never run on that shared loop — a blocking `def __call__` would
+        serialize every concurrent request and starve pending batch drains —
+        so sync targets (and sync-generator iteration) are pushed to the
+        loop's thread pool."""
+        import asyncio
+        import functools
         import inspect
 
-        out = self.handle_request(method_name, args, kwargs)
+        target = self._resolve(method_name)
+        self._count_request()
+        # Class deployments resolve "__call__" to the INSTANCE: the async
+        # check must look at its __call__ method, not the object.
+        fn = target if inspect.isroutine(target) else getattr(
+            target, "__call__", target
+        )
+        if inspect.iscoroutinefunction(fn) or inspect.isasyncgenfunction(fn):
+            out = target(*args, **kwargs)
+        else:
+            loop = asyncio.get_running_loop()
+            out = await loop.run_in_executor(
+                None, functools.partial(target, *args, **kwargs)
+            )
+        if inspect.iscoroutine(out):
+            out = await out
         if inspect.isgenerator(out):
-            for item in out:
+            loop = asyncio.get_running_loop()
+            sentinel = object()
+            while True:
+                item = await loop.run_in_executor(None, next, out, sentinel)
+                if item is sentinel:
+                    break
                 yield ("chunk", item)
         elif inspect.isasyncgen(out):
-            import asyncio
-
-            loop = asyncio.new_event_loop()
-            try:
-                while True:
-                    try:
-                        item = loop.run_until_complete(out.__anext__())
-                    except StopAsyncIteration:
-                        break
-                    yield ("chunk", item)
-            finally:
-                loop.close()
+            async for item in out:
+                yield ("chunk", item)
         else:
             yield ("single", out)
 

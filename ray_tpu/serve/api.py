@@ -329,6 +329,9 @@ def run(
             init_args=resolved_args,
             init_kwargs=resolved_kwargs,
             num_replicas=int(dep._options.get("num_replicas", 1)),
+            max_concurrent_queries=int(
+                dep._options.get("max_concurrent_queries", 1)
+            ),
             ray_actor_options=dep._options.get("ray_actor_options") or {},
             autoscaling_config=_coerce_autoscaling(
                 dep._options.get("autoscaling_config")
