@@ -131,7 +131,10 @@ class ServeController:
             handle = (
                 ray_tpu.remote(ServeReplica)
                 .options(**opts)
-                .remote(name, info.blob, info.init_args, info.init_kwargs)
+                .remote(
+                    name, info.blob, info.init_args, info.init_kwargs,
+                    max_concurrent_queries=info.max_concurrent_queries,
+                )
             )
             # Block until constructed so routing tables only list live replicas.
             ray_tpu.get(handle.__ray_ready__.remote())

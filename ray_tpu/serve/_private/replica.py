@@ -18,7 +18,10 @@ from typing import Any, Dict, Tuple
 
 class ServeReplica:
     def __init__(self, deployment_name: str, blob: bytes, init_args: Tuple,
-                 init_kwargs: Dict[str, Any]):
+                 init_kwargs: Dict[str, Any],
+                 max_concurrent_queries: int = 1):
+        from concurrent.futures import ThreadPoolExecutor
+
         from ray_tpu._private import serialization
 
         self.deployment_name = deployment_name
@@ -32,6 +35,14 @@ class ServeReplica:
         # Lock-free under concurrent calls (threaded replicas).
         self._request_counter = itertools.count(1)
         self._requests = 0
+        # Sync user code dispatched off the shared event loop runs HERE,
+        # sized to the deployment's concurrency contract — the loop's default
+        # executor caps at min(32, cpus+4) and is shared with sync-generator
+        # chunk iteration, which would head-of-line block streams.
+        self._sync_executor = ThreadPoolExecutor(
+            max_workers=max(1, int(max_concurrent_queries)),
+            thread_name_prefix=f"replica-sync-{deployment_name}",
+        )
         self._started = time.time()
 
     def _count_request(self) -> None:
@@ -83,7 +94,7 @@ class ServeReplica:
         else:
             loop = asyncio.get_running_loop()
             out = await loop.run_in_executor(
-                None, functools.partial(target, *args, **kwargs)
+                self._sync_executor, functools.partial(target, *args, **kwargs)
             )
         if inspect.iscoroutine(out):
             out = await out
@@ -91,7 +102,9 @@ class ServeReplica:
             loop = asyncio.get_running_loop()
             sentinel = object()
             while True:
-                item = await loop.run_in_executor(None, next, out, sentinel)
+                item = await loop.run_in_executor(
+                    self._sync_executor, next, out, sentinel
+                )
                 if item is sentinel:
                     break
                 yield ("chunk", item)
@@ -118,7 +131,7 @@ class ServeReplica:
                 f"deployment {self.deployment_name} is not an ASGI ingress "
                 "(decorate the class with @serve.ingress(app))"
             )
-        self._requests += 1
+        self._count_request()
         # Rebuild bytes-typed scope fields lost to the wire format.
         scope = dict(scope)
         scope["query_string"] = scope.get("query_string", b"") or b""

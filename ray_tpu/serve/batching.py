@@ -23,22 +23,41 @@ class _BatchQueue:
     task flushes full or timed-out batches through the wrapped function."""
 
     def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
-        import asyncio
-
         self._fn = fn
         self.max_batch_size = int(max_batch_size)
         self.batch_wait_timeout_s = float(batch_wait_timeout_s)
         self._items: List[Tuple[Any, Any]] = []
-        self._full = asyncio.Event()
+        self._loop: Optional[Any] = None
+        self._full: Optional[Any] = None
         self._drainer: Optional[Any] = None
         # Observability: sizes of executed batches (surfaced in tests and
         # debugging; the reference exposes similar counters via metrics).
         self.batch_sizes: List[int] = []
 
+    def _bind_loop(self, loop) -> None:
+        """The Event (and the drainer task) belong to ONE event loop. A queue
+        reused after its loop closed (asyncio.run called twice) rebinds
+        cleanly when idle; mixing live loops with pending items cannot work —
+        futures resolve only on their creating loop — so fail loudly instead
+        of hanging the second caller forever."""
+        import asyncio
+
+        if self._loop is loop:
+            return
+        if self._items:
+            raise RuntimeError(
+                "@serve.batch queue used from a second event loop while "
+                "items are pending on the first"
+            )
+        self._loop = loop
+        self._full = asyncio.Event()
+        self._drainer = None
+
     async def submit(self, self_obj, item):
         import asyncio
 
         loop = asyncio.get_running_loop()
+        self._bind_loop(loop)
         fut = loop.create_future()
         self._items.append((item, fut))
         if len(self._items) >= self.max_batch_size:

@@ -28,15 +28,19 @@ from ray_tpu.air.result import Result
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.base_trainer import BaseTrainer, TrainingFailedError
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 
 __all__ = [
     "Backend",
     "BackendConfig",
     "BaseTrainer",
+    "BatchPredictor",
     "Checkpoint",
     "CheckpointConfig",
     "DataParallelTrainer",
     "FailureConfig",
+    "JaxPredictor",
+    "Predictor",
     "Result",
     "RunConfig",
     "ScalingConfig",

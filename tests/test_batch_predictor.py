@@ -1,0 +1,124 @@
+"""Predictor / JaxPredictor / BatchPredictor batch inference.
+
+Reference: `python/ray/train/predictor.py`, `batch_predictor.py`.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+def _linear_ckpt():
+    # y = x @ w + b with known weights.
+    return Checkpoint(data_dict={
+        "params": {"w": np.array([[2.0], [3.0]], np.float32),
+                   "b": np.float32(1.0)}
+    })
+
+
+def _make_apply():
+    # A CLOSURE (not a module-level function): cloudpickle ships it by value,
+    # so worker actors need not import this test module.
+    def apply(params, feats):
+        return feats @ params["w"] + params["b"]
+
+    return apply
+
+
+_apply = _make_apply()
+
+
+def test_jax_predictor_direct():
+    from ray_tpu.train import JaxPredictor
+
+    p = JaxPredictor.from_checkpoint(
+        _linear_ckpt(), apply_fn=_apply, feature_columns=["a", "b"]
+    )
+    batch = {"a": np.array([1.0, 2.0]), "b": np.array([0.0, 1.0])}
+    out = p.predict(batch)
+    assert np.allclose(out["predictions"].ravel(), [3.0, 8.0])
+    # __call__ protocol (map_batches class UDF) matches predict.
+    assert np.allclose(p(batch)["predictions"], out["predictions"])
+
+
+def test_jax_predictor_missing_params_key():
+    from ray_tpu.train import JaxPredictor
+
+    with pytest.raises(ValueError, match="no 'params'"):
+        JaxPredictor.from_checkpoint(
+            Checkpoint(data_dict={"weights": 1}), apply_fn=_apply
+        )
+
+
+def test_batch_predictor_over_dataset(ray_start_regular):
+    from ray_tpu import data
+    from ray_tpu.train import BatchPredictor, JaxPredictor
+
+    n = 200
+    rng = np.random.default_rng(0)
+    a, b = rng.random(n).astype(np.float32), rng.random(n).astype(np.float32)
+    ids = np.arange(n)
+    ds = data.from_items(
+        [{"a": float(x), "b": float(y), "id": int(i)}
+         for x, y, i in zip(a, b, ids)]
+    )
+    bp = BatchPredictor.from_checkpoint(
+        _linear_ckpt(), JaxPredictor, apply_fn=_apply,
+        feature_columns=["a", "b"],
+    )
+    scored = bp.predict(ds, keep_columns=["id"], num_workers=2)
+    rows = scored.take_all()
+    assert len(rows) == n
+    got = {int(r["id"]): float(np.ravel(r["predictions"])[0]) for r in rows}
+    want = 2.0 * a + 3.0 * b + 1.0
+    for i in range(n):
+        assert abs(got[i] - float(want[i])) < 1e-5
+
+
+def test_batch_predictor_keep_column_collision(ray_start_regular):
+    from ray_tpu import data
+    from ray_tpu.train import BatchPredictor, JaxPredictor
+
+    ds = data.from_items([{"a": 1.0, "b": 2.0, "predictions": 9}])
+    bp = BatchPredictor.from_checkpoint(
+        _linear_ckpt(), JaxPredictor, apply_fn=_apply,
+        feature_columns=["a", "b"],
+    )
+    with pytest.raises(Exception, match="collides"):
+        bp.predict(ds, keep_columns=["predictions"]).take_all()
+
+
+def test_batch_predictor_with_gbdt(ray_start_regular):
+    """The existing XGBoostPredictor rides BatchPredictor unchanged (it
+    already implements the Predictor protocol)."""
+    from ray_tpu import data
+    from ray_tpu.train import BatchPredictor
+    from ray_tpu.train.xgboost import XGBoostPredictor, XGBoostTrainer
+    from ray_tpu.air.config import ScalingConfig
+
+    rng = np.random.default_rng(1)
+    n = 400
+    x0, x1 = rng.random(n), rng.random(n)
+    y = (x0 + x1 > 1.0).astype(np.float32)
+    train = data.from_items(
+        [{"x0": float(a), "x1": float(b), "label": float(c)}
+         for a, b, c in zip(x0, x1, y)]
+    )
+    trainer = XGBoostTrainer(
+        label_column="label",
+        params={"objective": "binary:logistic", "max_depth": 3,
+                "num_boost_round": 5},
+        datasets={"train": train},
+        scaling_config=ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    bp = BatchPredictor.from_checkpoint(result.checkpoint, XGBoostPredictor)
+    scored = bp.predict(train, num_workers=2)
+    preds = np.concatenate(
+        [np.ravel(r["predictions"]) for r in scored.take_all()]
+    )
+    assert preds.shape[0] == n
+    acc = float(np.mean((preds > 0.5) == (y > 0.5)))
+    assert acc > 0.8, acc

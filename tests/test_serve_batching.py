@@ -149,6 +149,19 @@ def test_batch_free_function_form():
     assert seen == [3]
 
 
+def test_batch_queue_rebinds_across_event_loops():
+    """asyncio.run twice on the same decorated function must not hang: the
+    queue's Event/drainer rebind to the new loop when idle."""
+    from ray_tpu.serve.batching import batch
+
+    @batch(max_batch_size=3, batch_wait_timeout_s=0.05)
+    async def double(items):
+        return [x * 2 for x in items]
+
+    assert asyncio.run(double(1)) == 2
+    assert asyncio.run(double(2)) == 4  # second, fresh loop
+
+
 # ----------------------------------------------------------------- integration
 def test_serve_batch_over_http(ray_start_regular):
     """Async deployments (and their batch queues) work through the proxy's
