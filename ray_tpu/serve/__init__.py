@@ -32,11 +32,14 @@ from ray_tpu.serve.handle import (
     DeploymentResponseGenerator,
 )
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve._private.common import AutoscalingConfig
 from ray_tpu.serve._private.http_proxy import ProxyRequest
 
 __all__ = [
     "batch",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "Application",
     "AutoscalingConfig",
     "Deployment",

@@ -37,6 +37,16 @@ class ProxyRequest:
         return self.body.decode()
 
 
+def _asgi_route_kwargs(request) -> Dict[str, Any]:
+    """Routing metadata for ASGI calls: the multiplexed model id (if any)
+    rides a reserved kwarg so the router can apply model affinity; route()
+    pops it before invoking the replica method."""
+    from ray_tpu.serve.multiplex import MODEL_ID_HEADER, MODEL_ID_KWARG
+
+    mid = request.headers.get(MODEL_ID_HEADER, "")
+    return {MODEL_ID_KWARG: mid} if mid else {}
+
+
 class HTTPProxy:
     def __init__(self, controller, port: Optional[int] = None):
         self._controller = controller
@@ -269,8 +279,16 @@ class HTTPProxy:
             headers=dict(request.headers),
             body=body,
         )
+        from ray_tpu.serve.multiplex import MODEL_ID_HEADER, MODEL_ID_KWARG
+
+        call_kwargs = {}
+        mid = request.headers.get(MODEL_ID_HEADER, "")
+        if mid:
+            call_kwargs[MODEL_ID_KWARG] = mid
         loop = asyncio.get_event_loop()
-        stream = _ReplicaStream(handle._ensure_router(), "__call__", (preq,), {})
+        stream = _ReplicaStream(
+            handle._ensure_router(), "__call__", (preq,), call_kwargs
+        )
         resp = None
         try:
             first = await loop.run_in_executor(None, stream.next_or_none)
@@ -320,7 +338,8 @@ class HTTPProxy:
         }
         loop = asyncio.get_event_loop()
         stream = _ReplicaStream(
-            handle._ensure_router(), "handle_asgi", (scope, body), {},
+            handle._ensure_router(), "handle_asgi", (scope, body),
+            _asgi_route_kwargs(request),
             raw_method=True,
         )
         resp = None

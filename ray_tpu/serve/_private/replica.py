@@ -59,8 +59,33 @@ class ServeReplica:
         return getattr(self._callable, method_name)
 
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict[str, Any]):
+        import inspect
+
+        from ray_tpu.serve.multiplex import (
+            MODEL_ID_KWARG,
+            _reset_model_id,
+            _run_with_model_id,
+            _set_model_id,
+        )
+
         self._count_request()
-        return self._resolve(method_name)(*args, **kwargs)
+        model_id = kwargs.pop(MODEL_ID_KWARG, "")
+        target = self._resolve(method_name)
+        if not model_id:
+            return target(*args, **kwargs)
+        # Async: the ctxvar set must live inside the ONE task that drives the
+        # user coroutine (task contexts persist across suspensions). Sync:
+        # set/reset around the call in this thread.
+        fn = target if inspect.isroutine(target) else getattr(
+            target, "__call__", target
+        )
+        if inspect.iscoroutinefunction(fn):
+            return _run_with_model_id(model_id, target(*args, **kwargs))
+        token = _set_model_id(model_id)
+        try:
+            return target(*args, **kwargs)
+        finally:
+            _reset_model_id(token)
 
     async def handle_request_stream(self, method_name: str, args: Tuple,
                                     kwargs: Dict[str, Any]):
@@ -82,8 +107,16 @@ class ServeReplica:
         import functools
         import inspect
 
+        from ray_tpu.serve.multiplex import (
+            MODEL_ID_KWARG,
+            _reset_model_id,
+            _run_with_model_id,
+            _set_model_id,
+        )
+
         target = self._resolve(method_name)
         self._count_request()
+        model_id = kwargs.pop(MODEL_ID_KWARG, "")
         # Class deployments resolve "__call__" to the INSTANCE: the async
         # check must look at its __call__ method, not the object.
         fn = target if inspect.isroutine(target) else getattr(
@@ -92,25 +125,83 @@ class ServeReplica:
         if inspect.iscoroutinefunction(fn) or inspect.isasyncgenfunction(fn):
             out = target(*args, **kwargs)
         else:
+            def _call_sync():
+                # Executor thread: set/reset the model-id ctxvar around the
+                # user call (each pooled thread has its own context).
+                if not model_id:
+                    return target(*args, **kwargs)
+                token = _set_model_id(model_id)
+                try:
+                    return target(*args, **kwargs)
+                finally:
+                    _reset_model_id(token)
+
             loop = asyncio.get_running_loop()
-            out = await loop.run_in_executor(
-                self._sync_executor, functools.partial(target, *args, **kwargs)
-            )
+            out = await loop.run_in_executor(self._sync_executor, _call_sync)
         if inspect.iscoroutine(out):
-            out = await out
+            if model_id:
+                # ensure_future: the user coroutine runs as ONE task whose
+                # context (with the model id set) is stable across every
+                # suspension — this async-generator frame itself resumes
+                # under a FRESH context per __anext__ and cannot hold it.
+                out = await asyncio.ensure_future(
+                    _run_with_model_id(model_id, out)
+                )
+            else:
+                out = await out
         if inspect.isgenerator(out):
             loop = asyncio.get_running_loop()
             sentinel = object()
+
+            def _next():
+                # Sync generator frames resume in THIS executor thread: set
+                # the model id around each pull so the body sees it.
+                if not model_id:
+                    return next(out, sentinel)
+                token = _set_model_id(model_id)
+                try:
+                    return next(out, sentinel)
+                finally:
+                    _reset_model_id(token)
+
             while True:
-                item = await loop.run_in_executor(
-                    self._sync_executor, next, out, sentinel
-                )
+                item = await loop.run_in_executor(self._sync_executor, _next)
                 if item is sentinel:
                     break
                 yield ("chunk", item)
         elif inspect.isasyncgen(out):
-            async for item in out:
-                yield ("chunk", item)
+            if model_id:
+                # Pump the user async-gen inside ONE task (stable context
+                # carrying the model id); this frame resumes under a fresh
+                # context per __anext__ and cannot hold the ctxvar itself.
+                done = object()
+                q: "asyncio.Queue" = asyncio.Queue(maxsize=2)
+
+                async def _pump():
+                    token = _set_model_id(model_id)
+                    try:
+                        async for item in out:
+                            await q.put(("chunk", item))
+                        await q.put((done, None))
+                    except Exception as e:  # noqa: BLE001 — relayed below
+                        await q.put(("err", e))
+                    finally:
+                        _reset_model_id(token)
+
+                task = asyncio.ensure_future(_pump())
+                try:
+                    while True:
+                        kind, item = await q.get()
+                        if kind is done:
+                            break
+                        if kind == "err":
+                            raise item
+                        yield ("chunk", item)
+                finally:
+                    task.cancel()
+            else:
+                async for item in out:
+                    yield ("chunk", item)
         else:
             yield ("single", out)
 
@@ -174,7 +265,20 @@ class ServeReplica:
                     response_done["event"] = ev = aio.Event()
                 ev.set()
 
+        # Multiplexed routing over ASGI: the header sets the request context
+        # (the app coroutine runs as one task in this private loop, so the
+        # ctxvar set in the runner thread is captured for its whole life).
+        from ray_tpu.serve.multiplex import MODEL_ID_HEADER, _set_model_id
+
+        model_id = ""
+        for k, v in scope["headers"]:
+            if k.decode().lower() == MODEL_ID_HEADER:
+                model_id = v.decode()
+                break
+
         def run():
+            if model_id:
+                _set_model_id(model_id)
             loop = asyncio.new_event_loop()
             try:
                 loop.run_until_complete(app(scope, receive, send))

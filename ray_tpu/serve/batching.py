@@ -45,10 +45,17 @@ class _BatchQueue:
         if self._loop is loop:
             return
         if self._items:
-            raise RuntimeError(
-                "@serve.batch queue used from a second event loop while "
-                "items are pending on the first"
-            )
+            if self._loop is not None and self._loop.is_closed():
+                # The first loop died with items still queued (e.g. a caller
+                # cancelled out of submit and asyncio.run tore down): their
+                # waiters are gone with that loop — drop the orphans instead
+                # of bricking the queue forever.
+                self._items.clear()
+            else:
+                raise RuntimeError(
+                    "@serve.batch queue used from a second event loop while "
+                    "items are pending on the first"
+                )
         self._loop = loop
         self._full = asyncio.Event()
         self._drainer = None

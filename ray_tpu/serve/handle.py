@@ -51,6 +51,14 @@ class Router:
         import collections
 
         self._stream_done_q: "collections.deque" = collections.deque()
+        # Multiplexed model affinity: model_id -> replica_id that last served
+        # it (its LRU holds the loaded weights; route traffic back there).
+        # Bounded LRU: per-tenant one-shot ids must not grow the router
+        # without limit.
+        import collections as _c
+
+        self._model_affinity: "_c.OrderedDict[str, str]" = _c.OrderedDict()
+        self._model_affinity_cap = 4096
         self._last_load_report = 0.0
         self._closed = False
         _all_routers.add(self)
@@ -164,20 +172,47 @@ class Router:
         ``raw_method`` — the proxy's ASGI path)."""
         from ray_tpu.actor import ActorHandle
 
+        from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+
+        model_id = ""
+        if kwargs and MODEL_ID_KWARG in kwargs:
+            # raw_method calls go straight to the named replica method (ASGI
+            # path) — the reserved kwarg is routing metadata only and must
+            # not reach its signature; the normal path's replica pops it.
+            model_id = (
+                kwargs.pop(MODEL_ID_KWARG) if raw_method
+                else kwargs[MODEL_ID_KWARG]
+            )
         self._ensure_table(force=force_refresh)  # outside the lock (push needs it)
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(f"no replicas for deployment '{self._name}'")
             self._sweep()
-            if len(self._replicas) == 1:
-                chosen = self._replicas[0]
-            else:
-                a, b = random.sample(self._replicas, 2)
-                chosen = (
-                    a
-                    if self._load_of(a.replica_id) <= self._load_of(b.replica_id)
-                    else b
-                )
+            chosen = None
+            if model_id:
+                # Sticky model routing: the replica that served this model
+                # already paid its load cost (reference: multiplexed-aware
+                # scheduling). Falls through when it died or was scaled away.
+                rid = self._model_affinity.get(model_id)
+                if rid is not None:
+                    chosen = next(
+                        (r for r in self._replicas if r.replica_id == rid), None
+                    )
+            if chosen is None:
+                if len(self._replicas) == 1:
+                    chosen = self._replicas[0]
+                else:
+                    a, b = random.sample(self._replicas, 2)
+                    chosen = (
+                        a
+                        if self._load_of(a.replica_id) <= self._load_of(b.replica_id)
+                        else b
+                    )
+            if model_id:
+                self._model_affinity[model_id] = chosen.replica_id
+                self._model_affinity.move_to_end(model_id)
+                while len(self._model_affinity) > self._model_affinity_cap:
+                    self._model_affinity.popitem(last=False)
             handle = ActorHandle(chosen.actor_id, "ServeReplica")
             if stream:
                 if raw_method:
@@ -207,6 +242,10 @@ class Router:
             pass
         with self._lock:
             self._replicas = [r for r in self._replicas if r.replica_id != replica_id]
+            for mid in [
+                m for m, r in self._model_affinity.items() if r == replica_id
+            ]:
+                del self._model_affinity[mid]
 
 
 class DeploymentResponse:
@@ -360,22 +399,31 @@ class DeploymentResponseGenerator:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
-                 method_name: str = "__call__", stream: bool = False):
+                 method_name: str = "__call__", stream: bool = False,
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method = method_name
         self._stream = stream
+        self._multiplexed_model_id = multiplexed_model_id
         self._router: Optional[Router] = None
 
     def options(self, *, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name,
             self._controller,
             method_name if method_name is not None else self._method,
             stream if stream is not None else self._stream,
+            multiplexed_model_id
+            if multiplexed_model_id is not None
+            else self._multiplexed_model_id,
         )
-        h._router = self._router
+        # Derived handles SHARE the parent's router: one replica table, one
+        # load book, one model-affinity map — and no router (+ its listener
+        # thread) per options()/bound-method call.
+        h._router = self._ensure_router()
         return h
 
     def _ensure_router(self) -> Router:
@@ -384,6 +432,10 @@ class DeploymentHandle:
         return self._router
 
     def remote(self, *args, **kwargs):
+        if self._multiplexed_model_id:
+            from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+
+            kwargs = {**kwargs, MODEL_ID_KWARG: self._multiplexed_model_id}
         router = self._ensure_router()
         if self._stream:
             return DeploymentResponseGenerator(
@@ -397,7 +449,8 @@ class DeploymentHandle:
     def __reduce__(self):
         return (
             DeploymentHandle,
-            (self.deployment_name, self._controller, self._method, self._stream),
+            (self.deployment_name, self._controller, self._method, self._stream,
+             self._multiplexed_model_id),
         )
 
     def __getattr__(self, name: str):

@@ -162,6 +162,26 @@ def test_batch_queue_rebinds_across_event_loops():
     assert asyncio.run(double(2)) == 4  # second, fresh loop
 
 
+def test_batch_queue_recovers_from_cancelled_first_loop():
+    """Items orphaned by a dead first loop (caller cancelled out of submit)
+    must not brick the queue for later loops."""
+    from ray_tpu.serve.batching import batch
+
+    @batch(max_batch_size=100, batch_wait_timeout_s=0.3)
+    async def echo(items):
+        return list(items)
+
+    async def cancelled():
+        # Times out long before the flush -> leaves the item queued when the
+        # loop dies.
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(echo(1), 0.01)
+
+    asyncio.run(cancelled())
+    # Fresh loop: the orphaned item is dropped and new calls work.
+    assert asyncio.run(echo(42)) == 42
+
+
 # ----------------------------------------------------------------- integration
 def test_serve_batch_over_http(ray_start_regular):
     """Async deployments (and their batch queues) work through the proxy's
