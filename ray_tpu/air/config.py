@@ -13,7 +13,7 @@ layout — the ScalingConfig -> jax.sharding.Mesh seam of SURVEY.md §7 step 5.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 
 @dataclass
@@ -97,3 +97,5 @@ class RunConfig:
     stop: Optional[Dict[str, Any]] = None
     verbose: int = 1
     log_to_file: bool = False
+    # Tune experiment-lifecycle hooks (`ray_tpu.tune.Callback` instances).
+    callbacks: Optional[List[Any]] = None

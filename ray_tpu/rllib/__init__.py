@@ -12,6 +12,7 @@ function over a device mesh — grads sync via the mesh's data axis inside XLA
 (vectorized gymnasium envs); only the learner touches accelerator devices.
 """
 
+from ray_tpu.rllib.callbacks import DefaultCallbacks, Episode
 from ray_tpu.rllib.core.distributional import (
     DistributionalQModule,
     DuelingQMLPModule,
@@ -73,6 +74,8 @@ __all__ = [
     "DDPGConfig",
     "DQN",
     "DQNConfig",
+    "DefaultCallbacks",
+    "Episode",
     "DeterministicContinuousModule",
     "DistributionalQModule",
     "DuelingQMLPModule",

@@ -63,6 +63,12 @@ class AlgorithmConfig:
         # `ray_tpu.rllib.utils.exploration` into every env runner.
         self.explore: bool = True
         self.exploration_config: Any = None
+        # Lifecycle hooks (reference `AlgorithmConfig.callbacks`): a
+        # DefaultCallbacks subclass, instantiated on the driver AND inside
+        # each env-runner actor (episode/sample hooks run there).
+        from ray_tpu.rllib.callbacks import DefaultCallbacks
+
+        self.callbacks_class = DefaultCallbacks
 
     # ------------------------------------------------------------ fluent API
     def environment(self, env=None, *, env_config: Optional[dict] = None) -> "AlgorithmConfig":
@@ -145,6 +151,19 @@ class AlgorithmConfig:
             self.num_learners = num_learners
         return self
 
+    def callbacks(self, callbacks_class) -> "AlgorithmConfig":
+        # Reference: `AlgorithmConfig.callbacks` — set the DefaultCallbacks
+        # subclass driving lifecycle hooks.
+        from ray_tpu.rllib.callbacks import DefaultCallbacks
+
+        if not (isinstance(callbacks_class, type)
+                and issubclass(callbacks_class, DefaultCallbacks)):
+            raise ValueError(
+                "callbacks_class must be a DefaultCallbacks subclass"
+            )
+        self.callbacks_class = callbacks_class
+        return self
+
     def multi_agent(
         self,
         *,
@@ -214,7 +233,10 @@ class AlgorithmConfig:
 
         usage.record_library_usage("rllib")
         algo_cls = getattr(self, "_algo_cls", None) or Algorithm
-        return algo_cls(self.copy())
+        algo = algo_cls(self.copy())
+        # After the SUBCLASS finished constructing (buffers, targets, ...).
+        algo.callbacks.on_algorithm_init(algorithm=algo)
+        return algo
 
     def env_creator(self) -> Callable[[], Any]:
         env, cfg = self.env, self.env_config
@@ -251,6 +273,7 @@ class Algorithm:
 
         self.config = config
         self.iteration = 0
+        self.callbacks = config.callbacks_class()
         # Driver-side strategy instance: owns the annealing schedule whose
         # values are pushed to runners each iteration (`exploration_push`).
         self.exploration = build_exploration(config.exploration_config)
@@ -306,6 +329,7 @@ class Algorithm:
                 action_connector=config.module_to_env_connector,
                 exploration=config.exploration_config,
                 default_explore=config.explore,
+                callbacks=config.callbacks_class,
             )
             for i in range(n)
         ]
@@ -423,6 +447,7 @@ class Algorithm:
                 gamma=config.gamma,
                 lambda_=getattr(config, "lambda_", 0.95),
                 default_explore=config.explore,
+                callbacks=config.callbacks_class,
             )
             for i in range(config.num_env_runners)
         ]
@@ -519,6 +544,7 @@ class Algorithm:
             metrics["evaluation"] = self.evaluate()["evaluation"]
         metrics["training_iteration"] = self.iteration
         metrics["time_this_iter_s"] = time.time() - t0
+        self.callbacks.on_train_result(algorithm=self, result=metrics)
         return metrics
 
     # ------------------------------------------------------------- evaluation
@@ -546,6 +572,7 @@ class Algorithm:
                     seed=config.seed + 555_000 + 1000 * i,
                     gamma=config.gamma,
                     lambda_=getattr(config, "lambda_", 0.95),
+                    callbacks=config.callbacks_class,
                 )
                 for i in range(n)
             ]
@@ -564,6 +591,7 @@ class Algorithm:
         import ray_tpu
 
         cfg = self.config
+        self.callbacks.on_evaluate_start(algorithm=self)
         runners = self._ensure_eval_runners()
         if self.is_multi_agent:
             weights = {
@@ -649,7 +677,9 @@ class Algorithm:
             metrics["episode_len_mean"] = len_sum / episodes
             metrics["episode_return_min"] = ret_min
             metrics["episode_return_max"] = ret_max
-        return {"evaluation": metrics}
+        out = {"evaluation": metrics}
+        self.callbacks.on_evaluate_end(algorithm=self, evaluation_metrics=out)
+        return out
 
     # ------------------------------------------------------------ checkpoints
     def _extra_state(self) -> Dict[str, Any]:

@@ -31,12 +31,19 @@ class EnvRunner:
         action_connector: Any = None,
         exploration: Any = None,
         default_explore: bool = True,
+        callbacks: Any = None,
     ):
         import gymnasium as gym
         import jax
 
+        from ray_tpu.rllib.callbacks import DefaultCallbacks, Episode
         from ray_tpu.rllib.connectors.connector import build_connector
         from ray_tpu.rllib.utils.exploration import build_exploration
+
+        # Worker-side lifecycle hooks (reference: callbacks run in rollout
+        # workers); instantiated HERE so hook state is per-runner.
+        self._callbacks = (callbacks or DefaultCallbacks)()
+        self._episode_cls = Episode
 
         # gymnasium >=1.0 defaults vector envs to NEXT_STEP autoreset, where
         # the step after done ignores the action and returns the reset obs —
@@ -272,8 +279,12 @@ class EnvRunner:
             self._episode_returns += rew
             self._episode_lengths += 1
             for i in np.nonzero(done)[0]:
-                self._completed.append(
-                    (float(self._episode_returns[i]), int(self._episode_lengths[i]))
+                ep = (float(self._episode_returns[i]), int(self._episode_lengths[i]))
+                self._completed.append(ep)
+                self._callbacks.on_episode_end(
+                    episode=self._episode_cls(
+                        episode_return=ep[0], episode_length=ep[1]
+                    )
                 )
                 self._episode_returns[i] = 0.0
                 self._episode_lengths[i] = 0
@@ -304,6 +315,7 @@ class EnvRunner:
                 bootstrap_values=boot_buf,
                 last_values=np.asarray(last_val, np.float32),
             )
+        self._callbacks.on_sample_end(samples=out)
         return out
 
     def _final_observations(self, infos, nxt: np.ndarray) -> np.ndarray:

@@ -17,6 +17,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_tpu.rllib.callbacks import Episode as _Episode
+
 
 def _segment_gae(
     rewards: np.ndarray,
@@ -67,8 +69,14 @@ class MultiAgentEnvRunner:
         gamma: float = 0.99,
         lambda_: float = 0.95,
         default_explore: bool = True,
+        callbacks=None,
     ):
         import jax
+
+        from ray_tpu.rllib.callbacks import DefaultCallbacks
+
+        # Worker-side lifecycle hooks (parity with EnvRunner).
+        self._callbacks = (callbacks or DefaultCallbacks)()
 
         self._envs = [env_creator() for _ in range(num_envs)]
         # `config.explore=False` pins training rollouts deterministic.
@@ -177,11 +185,13 @@ class MultiAgentEnvRunner:
                 )
                 for aid in open_agents:
                     self._close_trajectory(out, e, aid, boots.get(aid, 0.0))
-        return {
+        batches = {
             pid: {k: _stack(v) for k, v in cols.items()}
             for pid, cols in out.items()
             if cols["actions"]
         }
+        self._callbacks.on_sample_end(samples=batches)
+        return batches
 
     def _group_by_policy(
         self, per_env_obs: List[Dict[str, Any]]
@@ -291,6 +301,12 @@ class MultiAgentEnvRunner:
                             self._close_trajectory(out, e, aid, boots.get(aid, 0.0))
                 self._completed.append(
                     (self._episode_return[e], self._episode_len[e])
+                )
+                self._callbacks.on_episode_end(
+                    episode=_Episode(
+                        episode_return=float(self._episode_return[e]),
+                        episode_length=int(self._episode_len[e]),
+                    )
                 )
                 self._reset_env(e)
 

@@ -279,12 +279,7 @@ class HTTPProxy:
             headers=dict(request.headers),
             body=body,
         )
-        from ray_tpu.serve.multiplex import MODEL_ID_HEADER, MODEL_ID_KWARG
-
-        call_kwargs = {}
-        mid = request.headers.get(MODEL_ID_HEADER, "")
-        if mid:
-            call_kwargs[MODEL_ID_KWARG] = mid
+        call_kwargs = _asgi_route_kwargs(request)
         loop = asyncio.get_event_loop()
         stream = _ReplicaStream(
             handle._ensure_router(), "__call__", (preq,), call_kwargs

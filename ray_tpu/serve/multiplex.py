@@ -86,7 +86,9 @@ class _ModelCache:
                 model = await self._loader(self._self, model_id)
             else:
                 model = await self._loader(model_id)
-        except Exception as e:  # noqa: BLE001 — waiters see the load error
+        except BaseException as e:  # noqa: BLE001 — incl. CancelledError:
+            # the single-flight future MUST resolve or every waiter that
+            # grabbed it hangs forever (streaming disconnects cancel loads).
             if not fut.done():
                 fut.set_exception(e)
             # Consume the exception so an un-awaited future doesn't warn.
@@ -101,7 +103,9 @@ class _ModelCache:
             unload = getattr(evicted, "__serve_unload__", None)
             if callable(unload):
                 try:
-                    unload()
+                    out = unload()
+                    if asyncio.iscoroutine(out):
+                        await out
                 except Exception:  # noqa: BLE001 — eviction is best-effort
                     pass
         if not fut.done():

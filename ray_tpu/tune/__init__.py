@@ -24,6 +24,7 @@ from ray_tpu.tune.search.sample import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.callback import Callback
 from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.tune_config import TuneConfig
 from ray_tpu.tune.tuner import Tuner
@@ -33,6 +34,7 @@ from ray_tpu.tune.experiment.trial import Trial
 from ray_tpu.air.session import report, get_checkpoint
 
 __all__ = [
+    "Callback",
     "ResultGrid",
     "Trial",
     "TuneConfig",

@@ -42,7 +42,14 @@ class TrialRunner:
         num_samples: int = 0,
         trial_factory=None,
         experiment_dir: Optional[str] = None,
+        callbacks=None,
     ):
+        from ray_tpu.tune.callback import CallbackList
+
+        self._callbacks = CallbackList(callbacks)
+        # Monotonic event-loop step count passed to every callback hook
+        # (reference: Callback `iteration` argument).
+        self._iteration = 0
         self._train_fn = train_fn
         self.trials = trials
         # Adaptive mode: `searcher.suggest()` creates trials as capacity
@@ -121,6 +128,9 @@ class TrialRunner:
         self._actors[trial.trial_id] = actor
         self._refs[actor.next_result.remote()] = trial
         self._save_state(force=True)
+        self._callbacks.fire(
+            "on_trial_start", self._iteration, self.trials, trial
+        )
 
     def _teardown(self, trial: Trial) -> None:
         actor = self._actors.pop(trial.trial_id, None)
@@ -158,8 +168,13 @@ class TrialRunner:
             self._searcher.on_trial_complete(
                 trial.trial_id, trial.last_result, error=error
             )
+        self._callbacks.fire(
+            "on_trial_error" if error else "on_trial_complete",
+            self._iteration, self.trials, trial,
+        )
 
     def run(self) -> None:
+        self._callbacks.fire("setup")
         pending = [t for t in self.trials if t.status == trial_mod.PENDING]
         while pending or self._refs or (
             self._searcher is not None and len(self.trials) < self._num_samples
@@ -172,6 +187,7 @@ class TrialRunner:
             ready, _ = ray_tpu.wait(
                 list(self._refs.keys()), num_returns=1, timeout=5.0
             )
+            self._iteration += 1
             for ref in ready:
                 trial = self._refs.pop(ref)
                 try:
@@ -200,7 +216,15 @@ class TrialRunner:
                     trial.last_result = metrics
                     if tr.checkpoint is not None:
                         trial.checkpoint_manager.register(tr.checkpoint, metrics)
+                        self._callbacks.fire(
+                            "on_checkpoint", self._iteration, self.trials,
+                            trial, tr.checkpoint,
+                        )
                     self._save_state()
+                    self._callbacks.fire(
+                        "on_trial_result", self._iteration, self.trials,
+                        trial, metrics,
+                    )
                     if self._should_stop(metrics):
                         decision = STOP
                     else:
@@ -218,6 +242,7 @@ class TrialRunner:
                     else:
                         actor = self._actors[trial.trial_id]
                         self._refs[actor.next_result.remote()] = trial
+        self._callbacks.fire("on_experiment_end", self.trials)
 
     def _should_stop(self, metrics: Dict[str, Any]) -> bool:
         for k, v in self._stop.items():

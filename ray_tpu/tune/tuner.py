@@ -181,6 +181,7 @@ class Tuner:
             num_samples=self.tune_config.num_samples if searcher is not None else 0,
             trial_factory=lambda i: Trial({}, experiment_dir, i, experiment_name=name),
             experiment_dir=experiment_dir,
+            callbacks=self.run_config.callbacks,
         )
         runner.run()
         return ResultGrid(
