@@ -94,7 +94,9 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
-    stop: Optional[Dict[str, Any]] = None
+    # Metric-threshold dict, a `ray_tpu.tune.Stopper`, or a
+    # `(trial_id, result) -> bool` callable.
+    stop: Optional[Any] = None
     verbose: int = 1
     log_to_file: bool = False
     # Tune experiment-lifecycle hooks (`ray_tpu.tune.Callback` instances).

@@ -25,9 +25,16 @@ from ray_tpu.tune.search.sample import (
     uniform,
 )
 from ray_tpu.tune.callback import Callback
+from ray_tpu.tune.stopper import (
+    CombinedStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    Stopper,
+    TrialPlateauStopper,
+)
 from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.tune_config import TuneConfig
-from ray_tpu.tune.tuner import Tuner
+from ray_tpu.tune.tuner import Tuner, with_parameters
 from ray_tpu.tune.experiment.trial import Trial
 
 # `tune.report` parity alias: inside a function trainable, air session is live.
@@ -35,6 +42,12 @@ from ray_tpu.air.session import report, get_checkpoint
 
 __all__ = [
     "Callback",
+    "CombinedStopper",
+    "FunctionStopper",
+    "MaximumIterationStopper",
+    "Stopper",
+    "TrialPlateauStopper",
+    "with_parameters",
     "ResultGrid",
     "Trial",
     "TuneConfig",

@@ -36,7 +36,7 @@ class TrialRunner:
         scheduler: Optional[TrialScheduler] = None,
         max_concurrent: Optional[int] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
-        stop: Optional[Dict[str, float]] = None,
+        stop: Any = None,  # metric-threshold dict | Stopper | callable
         experiment_name: str = "",
         searcher=None,
         num_samples: int = 0,
@@ -61,7 +61,14 @@ class TrialRunner:
         self._scheduler = scheduler or FIFOScheduler()
         self._max_concurrent = max_concurrent or 8
         self._resources = dict(resources_per_trial or {"CPU": 1.0})
-        self._stop = dict(stop or {})
+        from ray_tpu.tune.stopper import Stopper, coerce_stopper
+
+        stop = coerce_stopper(stop)
+        self._stopper: Optional[Stopper] = (
+            stop if isinstance(stop, Stopper) else None
+        )
+        self._stop = dict(stop or {}) if isinstance(stop, (dict, type(None))) else {}
+        self._stop_all = False
         self._experiment_name = experiment_name
         self._actors: Dict[str, Any] = {}  # trial_id -> actor handle
         self._refs: Dict[Any, Trial] = {}  # outstanding next_result ref -> trial
@@ -179,6 +186,17 @@ class TrialRunner:
         while pending or self._refs or (
             self._searcher is not None and len(self.trials) < self._num_samples
         ):
+            if self._stop_all:
+                # A Stopper ended the experiment: terminate everything live.
+                for t in list(self._refs.values()):
+                    t.status = trial_mod.TERMINATED
+                    self._teardown(t)
+                    self._complete(t)
+                for t in pending:
+                    t.status = trial_mod.TERMINATED
+                pending.clear()
+                self._num_samples = len(self.trials)
+                continue
             while pending and len(self._actors) < self._max_concurrent:
                 self._launch(pending.pop(0))
             self._suggest_more()
@@ -225,7 +243,7 @@ class TrialRunner:
                         "on_trial_result", self._iteration, self.trials,
                         trial, metrics,
                     )
-                    if self._should_stop(metrics):
+                    if self._should_stop(trial, metrics):
                         decision = STOP
                     else:
                         decision = self._scheduler.on_trial_result(self, trial, metrics)
@@ -244,7 +262,17 @@ class TrialRunner:
                         self._refs[actor.next_result.remote()] = trial
         self._callbacks.fire("on_experiment_end", self.trials)
 
-    def _should_stop(self, metrics: Dict[str, Any]) -> bool:
+    def _should_stop(self, trial: Trial, metrics: Dict[str, Any]) -> bool:
+        if self._stopper is not None:
+            should = self._stopper(trial.trial_id, metrics)
+            # stop_all is consulted on EVERY result — even one that also
+            # stops its own trial — or an experiment-wide stop could be
+            # missed whenever the per-trial check fires first.
+            if self._stopper.stop_all():
+                self._stop_all = True
+                return True
+            if should:
+                return True
         for k, v in self._stop.items():
             if k in metrics and metrics[k] >= v:
                 return True

@@ -229,3 +229,20 @@ class Tuner:
                 t.restore_checkpoint = t.checkpoint  # latest persisted, if any
             trials.append(t)
         return trials
+
+
+def with_parameters(trainable, **kwargs):
+    """Bind large objects to a trainable via the object store (reference:
+    `python/ray/tune/trainable/util.py with_parameters`): each value is put
+    ONCE and fetched zero-copy per trial, instead of pickling into every
+    trial's config/spec."""
+    import ray_tpu
+
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    def inner(config):
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    inner.__name__ = getattr(trainable, "__name__", "trainable")
+    return inner

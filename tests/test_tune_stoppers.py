@@ -1,0 +1,108 @@
+"""tune.Stopper API + tune.with_parameters.
+
+Reference: `python/ray/tune/stopper/`, `trainable/util.py with_parameters`.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+
+
+def test_stopper_unit_behaviors():
+    from ray_tpu.tune import (
+        CombinedStopper,
+        FunctionStopper,
+        MaximumIterationStopper,
+        TrialPlateauStopper,
+    )
+
+    m = MaximumIterationStopper(3)
+    assert not m("t", {"training_iteration": 2})
+    assert m("t", {"training_iteration": 3})
+
+    f = FunctionStopper(lambda tid, r: r["loss"] < 0.1)
+    assert f("t", {"loss": 0.05}) and not f("t", {"loss": 0.5})
+
+    p = TrialPlateauStopper("loss", std=0.01, num_results=3, grace_period=3)
+    assert not p("t", {"loss": 1.0})
+    assert not p("t", {"loss": 0.5})
+    assert not p("t", {"loss": 0.5})  # grace met but window still moving
+    assert p("t", {"loss": 0.5})     # flat window -> stop
+    # Distinct trials track separately.
+    assert not p("other", {"loss": 0.5})
+
+    c = CombinedStopper(MaximumIterationStopper(10), f)
+    assert c("t", {"training_iteration": 1, "loss": 0.01})
+
+    from ray_tpu.tune.stopper import coerce_stopper
+
+    assert coerce_stopper(None) is None
+    assert isinstance(coerce_stopper(lambda t, r: False), FunctionStopper)
+    with pytest.raises(TypeError):
+        coerce_stopper(42)
+
+
+def test_stopper_stops_trials_in_runner(ray_start_regular):
+    def train_fn(config):
+        from ray_tpu.air import session
+
+        for i in range(50):
+            session.report({"loss": 1.0 / (i + 1)})
+
+    grid = tune.Tuner(
+        train_fn,
+        param_space={"x": tune.grid_search([1, 2])},
+        run_config=RunConfig(stop=tune.MaximumIterationStopper(3)),
+    ).fit()
+    assert len(grid) == 2
+    for r in grid:
+        assert r.metrics["training_iteration"] == 3
+
+
+def test_stop_all_ends_experiment(ray_start_regular):
+    class StopEverything(tune.Stopper):
+        def __init__(self):
+            self.seen = 0
+
+        def __call__(self, tid, result):
+            self.seen += 1
+            return False
+
+        def stop_all(self):
+            return self.seen >= 2
+
+    def train_fn(config):
+        from ray_tpu.air import session
+
+        for i in range(100):
+            session.report({"i": i})
+
+    grid = tune.Tuner(
+        train_fn,
+        param_space={"x": tune.grid_search([1, 2])},
+        run_config=RunConfig(stop=StopEverything()),
+    ).fit()
+    # Experiment ended long before 100 reports per trial.
+    for r in grid:
+        if r.metrics:
+            assert r.metrics.get("training_iteration", 0) < 100
+
+
+def test_with_parameters_ships_large_objects(ray_start_regular):
+    big = np.arange(200_000, dtype=np.float64)  # 1.6MB, put once
+
+    def train_fn(config, data=None):
+        from ray_tpu.air import session
+
+        session.report({"checksum": float(data.sum()) + config["x"]})
+
+    wrapped = tune.with_parameters(train_fn, data=big)
+    grid = tune.Tuner(
+        wrapped, param_space={"x": tune.grid_search([0.0, 1.0])}
+    ).fit()
+    sums = sorted(r.metrics["checksum"] for r in grid)
+    want = float(big.sum())
+    assert sums == [want, want + 1.0]
