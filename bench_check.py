@@ -1,0 +1,90 @@
+"""Trajectory check: diff a fresh `bench_core.py` run against the recorded
+baseline (BENCH_CORE.json) and fail on regressions, so a future PR cannot
+silently give back a control-plane win.
+
+Usage:
+    python bench_core.py | tee /tmp/bench_new.json
+    python bench_check.py /tmp/bench_new.json [--baseline BENCH_CORE.json]
+                          [--threshold 0.2]
+
+Both inputs are JSON-lines; non-metric lines (tables, notes) are ignored.
+Every recorded metric is higher-is-better (ops/s, GB/s, rows/s). A metric
+below baseline by more than `threshold` (default 20% — microbenchmarks on
+shared hosts are noisy) fails the check; new metrics are reported
+informationally; metrics missing from the new run fail (a deleted metric is
+how a regression hides).
+
+Exit status: 0 = no regressions, 1 = regression or missing metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+                out[rec["metric"]] = float(rec["value"])
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("new_run", help="JSON-lines output of a fresh bench_core.py run")
+    parser.add_argument("--baseline", default="BENCH_CORE.json")
+    parser.add_argument("--threshold", type=float, default=0.2,
+                        help="max tolerated fractional drop per metric")
+    ns = parser.parse_args()
+
+    base = load_metrics(ns.baseline)
+    new = load_metrics(ns.new_run)
+    if not base:
+        print(f"bench_check: no metrics in baseline {ns.baseline}", file=sys.stderr)
+        return 1
+    if not new:
+        print(f"bench_check: no metrics in {ns.new_run}", file=sys.stderr)
+        return 1
+
+    failures = []
+    for name, old_v in sorted(base.items()):
+        if name not in new:
+            failures.append(f"{name}: MISSING from new run (baseline {old_v:g})")
+            continue
+        new_v = new[name]
+        delta = (new_v - old_v) / old_v if old_v else 0.0
+        status = "ok"
+        if delta < -ns.threshold:
+            status = "REGRESSION"
+            failures.append(
+                f"{name}: {old_v:g} -> {new_v:g} ({delta:+.1%}, "
+                f"threshold -{ns.threshold:.0%})"
+            )
+        print(f"{name:35s} {old_v:>12g} -> {new_v:>12g}  {delta:+7.1%}  {status}")
+    for name in sorted(set(new) - set(base)):
+        print(f"{name:35s} {'(new)':>12} -> {new[name]:>12g}           new")
+
+    if failures:
+        print("\nbench_check FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("\nbench_check OK: no metric regressed beyond "
+          f"{ns.threshold:.0%} of {ns.baseline}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,16 @@ def main():
 
     results.append(timeit("put_1KB", put_small, 2000))
 
+    def put_small_burst(n):
+        # Burst shape: submissions coalesce through the control-plane batch
+        # layer; the trailing get() is a FIFO barrier proving every
+        # registration was processed (not just buffered).
+        refs = [ray_tpu.put(small) for _ in range(n)]
+        assert ray_tpu.get(refs[-1]) == small
+        del refs
+
+    results.append(timeit("put_1KB_burst", put_small_burst, 2000))
+
     big = np.zeros(1_250_000)  # 10 MB
 
     def put_large(n):
@@ -84,6 +94,17 @@ def main():
         ray_tpu.get([nop.remote() for _ in range(n)])
 
     results.append(timeit("task_throughput_async", task_async, 1500))
+
+    # Pure submission-side burst rate: how fast `.remote()` hands tasks to
+    # the control plane (execution drains outside the timed region).
+    _burst: list = []
+
+    def task_submit_burst(n):
+        _burst.extend(nop.remote() for _ in range(n))
+
+    results.append(timeit("task_submit_burst", task_submit_burst, 3000))
+    ray_tpu.get(_burst)
+    _burst.clear()
 
     # ---------------------------------------------------------------- actors
     @ray_tpu.remote

@@ -88,8 +88,32 @@ class Config:
     # When a dispatch class saturates the node, further same-class tasks
     # queue directly on the class's busy workers — the reference's
     # lease-based pipelined submission (`direct_task_transport.h:75`).
-    worker_pipeline_depth: int = 8
+    # 16 pairs with control-plane micro-batching: a worker's completion
+    # batch covers its whole in-flight window, so deeper pipelines mean
+    # fewer scheduler round trips per task.
+    worker_pipeline_depth: int = 16
     max_io_workers: int = 2
+
+    # --- control-plane micro-batching (batching.py) ---
+    # Coalesce small control-plane messages (task submissions, actor-call
+    # ExecRequests, put_meta registrations, completions, stream items, ref
+    # ops) into one ("batch", [msgs]) frame per connection, flushed on a
+    # count/byte threshold or a sub-millisecond timer. Blocking ops (get/
+    # wait/any request) always flush first, so sync latency never waits on
+    # the timer. False restores one frame per message with identical
+    # observable semantics.
+    control_plane_batching: bool = True
+    # Flush a connection's buffer once it holds this many messages...
+    control_plane_batch_max_msgs: int = 128
+    # ...or once its (approximate) serialized payload reaches this many bytes.
+    control_plane_batch_max_bytes: int = 1 * 1024 * 1024
+    # Client-side coalescing window + safety-net timer: messages arriving
+    # closer together than this batch; a buffered message never waits longer
+    # than ~this before hitting the wire. Must sit BELOW the sync-roundtrip
+    # period (~0.4ms on small hosts) so request/response traffic takes the
+    # immediate-send path and never pays a timer wakeup. (The scheduler side
+    # flushes every event-loop iteration instead and ignores this knob.)
+    control_plane_batch_flush_interval_s: float = 0.0002
 
     # --- fault tolerance ---
     task_max_retries: int = 3

@@ -1,0 +1,54 @@
+"""Version-compatibility shims for the jax API surface.
+
+The tree targets the modern `jax.shard_map` entry point (top-level since
+jax ~0.6); older jaxlibs (0.4.x, still common in baked container images)
+only ship `jax.experimental.shard_map.shard_map` with the pre-rename
+keywords (`check_rep` instead of `check_vma`, `auto` — the complement set —
+instead of `axis_names`). Route every shard_map call through here so one
+tree runs on both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def distributed_is_initialized() -> bool:
+    """`jax.distributed.is_initialized()` with fallback for jaxlibs that
+    predate it (0.4.x): probe the global distributed state's client WITHOUT
+    touching the backend (initializing XLA here would make a later
+    `jax.distributed.initialize()` impossible)."""
+    import jax
+
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    state = getattr(jax.distributed, "global_state", None)
+    return getattr(state, "client", None) is not None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None,
+              axis_names: Optional[Any] = None):
+    """`jax.shard_map` with graceful fallback to the experimental namespace.
+
+    `axis_names` follows the modern meaning (the MANUAL axes); on the legacy
+    API it is translated to `auto` = the remaining mesh axes. Omitted
+    kwargs keep each API's own defaults (both default to fully manual)."""
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if native is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        return native(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if check_vma is not None:
+        kwargs["check_rep"] = bool(check_vma)
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return legacy(f, **kwargs)

@@ -274,6 +274,28 @@ class NodeDaemon:
                     )
             time.sleep(0.2)
 
+    def _dispatch(self, msg) -> bool:
+        """Handle one head->daemon message; False means shutdown."""
+        kind = msg[0]
+        if kind == "batch":
+            # Coalesced control frame (head-side micro-batching, e.g. a
+            # delete burst): process every contained message.
+            for m in msg[1]:
+                if not self._dispatch(m):
+                    return False
+            return True
+        if kind == "spawn_worker":
+            self._spawn_worker(msg[1])
+        elif kind == "kill_worker":
+            self._kill_worker(msg[1])
+        elif kind == "read_object":
+            self._read_object(msg[1], msg[2], *msg[3:])
+        elif kind == "delete_object":
+            self._delete_object(msg[1], msg[2] if len(msg) > 2 else None)
+        elif kind == "shutdown":
+            return False
+        return True
+
     def serve(self):
         reaper = threading.Thread(target=self._reaper_loop, daemon=True, name="reaper")
         reaper.start()
@@ -293,16 +315,7 @@ class NodeDaemon:
                     if not self._reconnect():
                         break
                     continue
-                kind = msg[0]
-                if kind == "spawn_worker":
-                    self._spawn_worker(msg[1])
-                elif kind == "kill_worker":
-                    self._kill_worker(msg[1])
-                elif kind == "read_object":
-                    self._read_object(msg[1], msg[2], *msg[3:])
-                elif kind == "delete_object":
-                    self._delete_object(msg[1], msg[2] if len(msg) > 2 else None)
-                elif kind == "shutdown":
+                if not self._dispatch(msg):
                     break
         finally:
             self._stop.set()

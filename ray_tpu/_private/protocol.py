@@ -16,6 +16,16 @@ Message grammar (all pickled with cloudpickle):
     ("exec", ExecRequest)
     ("resp", req_id: int, ok: bool, payload)
     ("shutdown",)
+  either direction:
+    ("batch", [msg, ...])   # micro-batched control frame: any of the above
+                            # (and ref_ops/stream/cmd/... messages) coalesced
+                            # by a per-connection BatchedSender (batching.py).
+                            # Receivers process every contained message before
+                            # running scheduling/wakeup work once; per-
+                            # connection FIFO holds because blocking sends
+                            # flush the batch buffer first. Config knobs:
+                            # control_plane_batching / _batch_max_msgs /
+                            # _batch_max_bytes / _batch_flush_interval_s.
 """
 
 from __future__ import annotations

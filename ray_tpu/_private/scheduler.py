@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization
+from ray_tpu._private.batching import approx_msg_nbytes as _approx_msg_nbytes
 from ray_tpu._private.config import Config
 from ray_tpu._private.gcs import GCS, ActorInfo, TaskEvent
 from ray_tpu._private.ids import (
@@ -415,7 +416,11 @@ class ActorRecord:
     # Holder id of the creating driver/worker for owned (non-detached)
     # actors: its death kills the actor.
     owner_holder: Optional[str] = None
-    inflight: List[TaskID] = field(default_factory=list)
+    # In-flight call ids, insertion-ordered. A dict (used as an ordered set):
+    # a burst enqueues thousands of calls on one actor, and the list version
+    # made each completion's membership-check + removal O(inflight) —
+    # O(n^2) per burst on the scheduler thread.
+    inflight: Dict[TaskID, None] = field(default_factory=dict)
     # Method calls queued while the actor is PENDING/RESTARTING.
     backlog: List[ExecRequest] = field(default_factory=list)
     acquired_pg: Optional[Tuple[PlacementGroupID, int]] = None
@@ -487,8 +492,17 @@ class Scheduler:
         # until the loop's poll timeout).
         self._wake_pending = False
         self._wake_lock = threading.Lock()
-        # Per-_schedule-pass exec coalescing buffer ({wh: [ExecRequest]}).
-        self._exec_buffer: Optional[Dict[Any, List[Any]]] = None
+        # Outbound control-plane micro-batching (batching.py): while the loop
+        # thread is inside an iteration, messages to workers/drivers/daemons
+        # coalesce per connection into ("batch", [msgs]) frames, flushed on a
+        # count/byte threshold and unconditionally before the loop sleeps.
+        # None = batching disabled (every _send_to is a direct send).
+        self._out_buffer: Optional[Dict[int, List[Any]]] = (
+            {} if config.control_plane_batching else None
+        )
+        self._loop_tid: Optional[int] = None
+        self._batch_max_msgs = max(1, int(config.control_plane_batch_max_msgs))
+        self._batch_max_bytes = int(config.control_plane_batch_max_bytes)
         # dispatch-class key -> leased workers (kept in sync by dispatch /
         # idle / death transitions): O(1) pipeline-candidate lookup.
         self._leases: Dict[tuple, List[WorkerHandle]] = {}
@@ -661,6 +675,7 @@ class Scheduler:
         return True
 
     def _on_daemon_death(self, daemon: DaemonHandle):
+        self._drop_outbound(daemon)
         self._conn_to_daemon.pop(daemon.conn, None)
         self._pull_sources.pop(daemon.node_id.binary(), None)
         self._fail_pulls_from(daemon.node_id.binary())
@@ -680,6 +695,7 @@ class Scheduler:
             holders.discard(dh.holder_id)
 
     def _on_driver_death(self, dh: DriverHandle):
+        self._drop_outbound(dh)
         self._conn_to_driver.pop(dh.conn, None)
         self._on_driver_death_cleanup_subs(dh)
         if dh.pull_node_id:
@@ -772,10 +788,72 @@ class Scheduler:
             except OSError:
                 pass
 
+    # -------------------------------------------------- outbound micro-batching
+    def _send_to(self, handle, msg) -> None:
+        """Send a control message to a worker/driver/daemon handle, coalescing
+        per connection while the scheduler thread is inside a loop iteration
+        (flushed on threshold and before the loop sleeps). Off-thread callers
+        (e.g. pull-read responders) and disabled batching send directly. Send
+        failures route to the handle's death path."""
+        buf = self._out_buffer
+        if buf is None or threading.get_ident() != self._loop_tid:
+            if not handle.send(msg):
+                self._on_send_failure(handle)
+            return
+        ent = buf.get(id(handle))
+        if ent is None:
+            ent = buf[id(handle)] = [handle, [], 0]
+        ent[1].append(msg)
+        ent[2] += _approx_msg_nbytes(msg)
+        if len(ent[1]) >= self._batch_max_msgs or ent[2] >= self._batch_max_bytes:
+            del buf[id(handle)]
+            self._send_many(handle, ent[1])
+
+    def _send_many(self, handle, msgs: List[Any]) -> None:
+        msg = msgs[0] if len(msgs) == 1 else ("batch", msgs)
+        if not handle.send(msg):
+            self._on_send_failure(handle)
+
+    def _flush_outbound(self) -> None:
+        buf = self._out_buffer
+        if buf is None:
+            return
+        # Loop until drained: a send failure runs death handlers, which may
+        # legitimately buffer NEW messages to other connections (error
+        # responses, actor-restart execs) — those must not sit through the
+        # loop's next sleep. Terminates: each pass only re-buffers via
+        # (liveness-guarded) death handlers, which run at most once per
+        # handle.
+        while buf:
+            entries = list(buf.values())
+            buf.clear()
+            for handle, msgs, _nbytes in entries:
+                self._send_many(handle, msgs)
+
+    def _drop_outbound(self, handle) -> None:
+        """Forget buffered messages for a dying connection (flushing to the
+        corpse would re-enter the death path)."""
+        if self._out_buffer is not None:
+            self._out_buffer.pop(id(handle), None)
+
+    def _on_send_failure(self, handle) -> None:
+        # Liveness guards make the failure path idempotent: a flush may fail
+        # for a handle whose death was already handled this iteration.
+        if isinstance(handle, WorkerHandle):
+            if self._workers_by_id.get(handle.worker_id.hex()) is handle:
+                self._on_worker_death(handle)
+        elif isinstance(handle, DriverHandle):
+            if handle.conn in self._conn_to_driver:
+                self._on_driver_death(handle)
+        elif isinstance(handle, DaemonHandle):
+            if handle.conn in self._conn_to_daemon:
+                self._on_daemon_death(handle)
+
     # ------------------------------------------------------------------ main loop
     def _loop(self):
         import multiprocessing.connection as mpc
 
+        self._loop_tid = threading.get_ident()
         last_health_check = time.time()
         while not self._stopped.is_set():
             waitables = (
@@ -867,13 +945,22 @@ class Scheduler:
                 import traceback
 
                 traceback.print_exc()
-        # Loop exited: fail any command that raced the stop and is still queued.
+            # Never sleep on undelivered output: everything this iteration
+            # coalesced goes out before the next mpc.wait.
+            try:
+                self._flush_outbound()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+        # Loop exited: fail any command that raced the stop and is still queued
+        # (fire-and-forget commands have no future to fail).
         while True:
             try:
                 _method, _payload, fut = self._commands.get_nowait()
             except queue.Empty:
                 break
-            if not fut.done():
+            if fut is not None and not fut.done():
                 fut.set_exception(RuntimeError("scheduler is stopped"))
 
     def _drain_worker(self, wh: WorkerHandle):
@@ -894,6 +981,10 @@ class Scheduler:
 
     def _on_daemon_message(self, daemon: DaemonHandle, msg):
         kind = msg[0]
+        if kind == "batch":
+            for m in msg[1]:
+                self._on_daemon_message(daemon, m)
+            return
         if kind == "worker_exit" or kind == "spawn_failed":
             wh = self._workers_by_id.get(msg[1])
             if wh is not None and isinstance(wh.process, _RemoteProc):
@@ -928,21 +1019,30 @@ class Scheduler:
         try:
             while dh.conn.poll():
                 msg = serialization.loads(dh.conn.recv_bytes())
-                kind = msg[0]
-                if kind == "req":
-                    _, req_id, method, payload = msg
-                    self._on_worker_request(dh, req_id, method, payload)
-                elif kind == "cmd":
-                    self._on_worker_request(dh, None, msg[1], msg[2])
-                elif kind == "object_data":
-                    _, token, ok, data = msg
-                    self._finish_pull(token, ok, data)
-                elif kind == "ref_ops":
-                    self._apply_ref_ops(msg[1], dh.holder_id)
+                self._on_driver_message(dh, msg)
         except (EOFError, OSError):
             self._on_driver_death(dh)
 
+    def _on_driver_message(self, dh: DriverHandle, msg):
+        kind = msg[0]
+        if kind == "batch":
+            for m in msg[1]:
+                self._on_driver_message(dh, m)
+        elif kind == "req":
+            _, req_id, method, payload = msg
+            self._on_worker_request(dh, req_id, method, payload)
+        elif kind == "cmd":
+            self._on_worker_request(dh, None, msg[1], msg[2])
+        elif kind == "object_data":
+            _, token, ok, data = msg
+            self._finish_pull(token, ok, data)
+        elif kind == "ref_ops":
+            self._apply_ref_ops(msg[1], dh.holder_id)
+
     def _shutdown_workers(self):
+        # Deliver anything still coalesced before the shutdown frames — a
+        # direct send must never overtake buffered messages on a connection.
+        self._flush_outbound()
         for node in self.nodes.values():
             if node.daemon is not None:
                 node.daemon.send(("shutdown",))
@@ -1138,6 +1238,7 @@ class Scheduler:
         return wh
 
     def _on_worker_death(self, wh: WorkerHandle):
+        self._drop_outbound(wh)
         node = self.nodes.get(wh.node_id)
         if node is not None:
             node.workers.pop(wh.worker_id, None)
@@ -1392,16 +1493,20 @@ class Scheduler:
     # ------------------------------------------------------------------ messages
     def _on_worker_message(self, wh: WorkerHandle, msg):
         kind = msg[0]
+        if kind == "batch":
+            # Coalesced frame: apply every contained message now; scheduling
+            # work runs once per loop iteration regardless of batch size.
+            for m in msg[1]:
+                self._on_worker_message(wh, m)
+            return
         if kind == "register":
             return
         if kind == "done":
+            # Lease-pipelined workers coalesce dones into "batch" frames
+            # while their local queue is non-empty; order within the frame =
+            # execution order.
             _, task_id_bytes, ok, metas = msg
             self._on_task_done(wh, TaskID(task_id_bytes), ok, metas)
-        elif kind == "done_batch":
-            # Lease-pipelined workers batch completions while their local
-            # queue is non-empty; order within the batch = execution order.
-            for task_id_bytes, ok, metas in msg[1]:
-                self._on_task_done(wh, TaskID(task_id_bytes), ok, metas)
         elif kind == "stream":
             _, task_id_bytes, index, meta = msg
             self._on_stream_item(TaskID(task_id_bytes), index, meta)
@@ -1420,7 +1525,9 @@ class Scheduler:
         # req_id None = one-way "cmd" message: no ack is expected.
         if req_id is None:
             return
-        wh.send(("resp", req_id, ok, payload))
+        # Coalesced on the loop thread (a burst of object-ready answers rides
+        # one frame); off-thread responders (pull reads) send directly.
+        self._send_to(wh, ("resp", req_id, ok, payload))
 
     def _on_worker_request(self, wh: WorkerHandle, req_id: Optional[int], method: str, payload):
         handler = getattr(self, "_req_" + method, None)
@@ -1482,7 +1589,7 @@ class Scheduler:
         for dh in list(self._conn_to_driver.values()):
             if dh.holder_id in holders:
                 try:
-                    dh.send(("pub", channel, payload))
+                    self._send_to(dh, ("pub", channel, payload))
                 except (OSError, ValueError):
                     pass
 
@@ -1533,8 +1640,7 @@ class Scheduler:
         if rec.spec.actor_id is not None:
             ar = self.actors.get(rec.spec.actor_id)
             if ar is not None:
-                if task_id in ar.inflight:
-                    ar.inflight.remove(task_id)
+                ar.inflight.pop(task_id, None)
                 if rec.spec.is_actor_creation:
                     self._on_actor_created(ar, ok, metas)
         else:
@@ -1966,7 +2072,9 @@ class Scheduler:
         # on their connections; head-local (virtual-node) segments free here.
         source = self._pull_sources.get(meta.node_id or b"")
         if source is not None:
-            source.send(("delete_object", meta.segment, meta.arena_offset))
+            # Coalesced: a release burst (e.g. a dropped dataset) deletes in
+            # a handful of frames instead of one write per object.
+            self._send_to(source, ("delete_object", meta.segment, meta.arena_offset))
         elif meta.arena_offset is not None:
             from ray_tpu._private.object_store import get_node_arena
 
@@ -2436,7 +2544,7 @@ class Scheduler:
                 and task_id in wh.inflight_tasks
             ):
                 wh.inflight_tasks.remove(task_id)
-                wh.send(("cancel_queued", task_id.binary()))
+                self._send_to(wh, ("cancel_queued", task_id.binary()))
                 self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
                 rec.state = "CANCELLED"
                 return True
@@ -2915,7 +3023,7 @@ class Scheduler:
             if len(wh.inflight_tasks) > 1:
                 queued, wh.inflight_tasks = wh.inflight_tasks[1:], wh.inflight_tasks[:1]
                 for tid in queued:
-                    wh.send(("cancel_queued", tid.binary()))
+                    self._send_to(wh, ("cancel_queued", tid.binary()))
                     qrec = self.tasks.get(tid)
                     if qrec is not None and qrec.state == "RUNNING":
                         qrec.state = "PENDING"
@@ -3059,20 +3167,28 @@ class Scheduler:
             rec.state = "RUNNING"
             rec.worker = wh.worker_id
             rec.node = wh.node_id
-        ar.inflight.append(req.spec.task_id)
+        ar.inflight[req.spec.task_id] = None
         self._record_event(req.spec, "RUNNING")
-        if not wh.send(("exec", req)):
-            self._on_worker_death(wh)
+        # Coalesced: an async actor-call burst dispatches as one frame per
+        # worker. Send failure routes to the worker-death path at flush.
+        self._send_to(wh, ("exec", req))
 
     def _resolve_then(self, req: ExecRequest, then: Callable[[], None]):
         """Resolve ("id", ...) placeholders in an ExecRequest's args to metas, then
         invoke `then`. Error deps propagate immediately."""
-        dep_ids = [v for (kind, v) in getattr(req, "_arg_entries", []) if kind == "id"]
         # ExecRequests built by the worker facade carry entries in arg_metas slots
         # as tuples; normalize here.
         entries = getattr(req, "_arg_entries", None)
         kwentries = getattr(req, "_kwarg_entries", None)
         if entries is None:
+            then()
+            return
+        if not entries and not kwentries:
+            # No-arg call (the dominant burst shape): nothing to resolve.
+            req.arg_metas = []
+            req.kwarg_metas = {}
+            req._arg_entries = None
+            req._kwarg_entries = None
             then()
             return
         needed = {v for (k, v) in entries if k == "id"} | {
@@ -3255,12 +3371,9 @@ class Scheduler:
         self._try_schedule_pgs()
         if not self.pending:
             return
-        # Coalesce this pass's dispatches into one message per worker.
-        self._exec_buffer = {}
-        try:
-            self._schedule_classes()
-        finally:
-            self._flush_exec_buffer()
+        # Dispatches coalesce per worker in the loop-wide outbound buffer
+        # (_send_to), flushed on threshold / end of iteration.
+        self._schedule_classes()
 
     def _schedule_classes(self):
         # Per dispatch class: drain head-first until the first resource
@@ -3513,22 +3626,10 @@ class Scheduler:
         if rec.spec.func.function_id not in wh.known_functions:
             req.func_blob = self.gcs.function_table.get(rec.spec.func.function_id, rec.func_blob)
             wh.known_functions.add(rec.spec.func.function_id)
-        if self._exec_buffer is not None:
-            # Inside a _schedule pass: coalesce this wakeup's dispatches into
-            # one message per worker (flushed in _flush_exec_buffer).
-            self._exec_buffer.setdefault(wh.worker_id, (wh, []))[1].append(req)
-            return
-        if not wh.send(("exec", req)):
-            # Death handling retries or seals an error for this record itself;
-            # the caller must not also re-queue it.
-            self._on_worker_death(wh)
-
-    def _flush_exec_buffer(self) -> None:
-        buffer, self._exec_buffer = self._exec_buffer, None
-        for wh, reqs in buffer.values():
-            msg = ("exec", reqs[0]) if len(reqs) == 1 else ("exec_batch", reqs)
-            if not wh.send(msg):
-                self._on_worker_death(wh)
+        # Coalesced per worker in the loop-wide outbound buffer; a send
+        # failure at flush runs worker-death handling, which retries or seals
+        # an error for every in-flight record itself.
+        self._send_to(wh, ("exec", req))
 
     def _remove_from_lease_index(self, wh: WorkerHandle) -> None:
         if wh.lease_key is not None:
@@ -3612,7 +3713,7 @@ class Scheduler:
         rec.state = "RUNNING"
         rec.worker = wh.worker_id
         rec.node = node.node_id
-        ar.inflight.append(rec.spec.task_id)
+        ar.inflight[rec.spec.task_id] = None
         self._record_event(rec.spec, "RUNNING")
         req = ExecRequest(
             spec=rec.spec,
@@ -3622,10 +3723,9 @@ class Scheduler:
             return_ids=rec.return_ids,
         )
         wh.known_functions.add(rec.spec.func.function_id)
-        if not wh.send(("exec", req)):
-            # Actor death handling restarts or fails the actor itself; don't
-            # also re-queue this creation record.
-            self._on_worker_death(wh)
+        # Send failure at flush runs actor death handling, which restarts or
+        # fails the actor itself; the creation record is never re-queued here.
+        self._send_to(wh, ("exec", req))
         return True
 
     def _try_start_actor(self, ar: ActorRecord):

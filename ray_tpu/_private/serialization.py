@@ -76,7 +76,17 @@ class _Pickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
+# Exact-type fast path: these can neither carry out-of-band buffers nor
+# contain ObjectRefs, so the C pickler alone is equivalent to the full
+# cloudpickle pass (bytes/str were always serialized in-band anyway) at a
+# fraction of the per-call overhead — the control plane serializes millions
+# of tiny task results.
+_SIMPLE_TYPES = (type(None), bool, int, float, bytes, str)
+
+
 def serialize(value: Any) -> SerializedValue:
+    if type(value) in _SIMPLE_TYPES:
+        return SerializedValue(inband=pickle.dumps(value, protocol=5))
     buffers: List[pickle.PickleBuffer] = []
     import io
 

@@ -126,8 +126,20 @@ _flush_lock = threading.Lock()
 
 
 def flush_ref_ops() -> None:
-    """Send queued refcount ops to the control plane (called by the background
-    flusher, at task completion, and by tests for determinism)."""
+    """Queue drained refcount ops into the control plane (called by the
+    background flusher, at task completion, and by tests for determinism).
+    Both destinations are FIFO and non-blocking: connection-backed contexts
+    enqueue into the connection's batch buffer (ops piggyback on the next
+    outbound batch — a done, a submit, or the sub-ms flush timer), the
+    in-process driver into the scheduler's command queue. drain+enqueue is
+    atomic under _flush_lock so the add-before-rel queue order survives onto
+    the wire."""
+    t = _ref_tracker
+    if not t._ops and not t._dead and not t._dead_streams:
+        # Lock-free emptiness peek (safe in CPython): the per-task-completion
+        # call is almost always a no-op, and a racing enqueue just rides the
+        # NEXT flush — delivery stays eventual and ordered.
+        return
     with _flush_lock:
         ops = _ref_tracker.drain()
         if not ops:
@@ -410,6 +422,13 @@ class DriverContext:
             return list(ready.keys())
 
     def put_meta(self, meta: ObjectMeta):
+        if meta.segment is None and get_config().control_plane_batching:
+            # Inline objects can never fail the capacity check (no segment
+            # bytes), so the registration needs no ack. The scheduler's FIFO
+            # command queue keeps every later get/wait/submit ordered after
+            # it — identical observable semantics, no round trip.
+            self.scheduler.call_nowait("put_meta", meta)
+            return
         self.scheduler.call("put_meta", meta).result()
 
     def kv(self, op: str, *args):
@@ -469,7 +488,9 @@ class DriverContext:
         return self.scheduler.call("cancel", (task_id, force)).result()
 
     def ref_ops(self, ops):
-        self.scheduler.call("ref_ops", (ops, None)).result()
+        # Fire-and-forget: command-queue FIFO makes the releases visible to
+        # any later capacity check / get without an ack round trip per flush.
+        self.scheduler.call_nowait("ref_ops", (ops, None))
 
     def stream_next(self, task_id_bytes: bytes, index: int,
                     timeout: Optional[float] = None, blocking: bool = True):
@@ -559,6 +580,10 @@ class RemoteDriverContext:
                     pass
 
     def close(self):
+        # Deliver anything still coalesced (e.g. a submit enqueued just
+        # before shutdown) before tearing the connection down.
+        self.wc.batch.flush()
+        self.wc.batch.close()
         try:
             self.wc.conn.close()
         except OSError:
@@ -566,11 +591,12 @@ class RemoteDriverContext:
 
     # --- core ops (worker-style req/resp) ---
     def submit(self, rec):
-        # One-way: no ack round trip per pipelined submission.
-        self.wc.send(("cmd", "submit", rec))
+        # One-way + coalescable: pipelined `.remote()` bursts batch into one
+        # frame; any blocking request flushes first (FIFO preserved).
+        self.wc.send_async(("cmd", "submit", rec))
 
     def submit_actor_task(self, req: ExecRequest):
-        self.wc.send(("cmd", "submit_actor_task", req))
+        self.wc.send_async(("cmd", "submit_actor_task", req))
 
     def create_actor(self, payload):
         self.wc.request("create_actor", payload)
@@ -589,6 +615,11 @@ class RemoteDriverContext:
             return list(peeked.keys())
 
     def put_meta(self, meta):
+        if meta.segment is None and get_config().control_plane_batching:
+            # Inline puts cannot fail the capacity check: register without
+            # an ack; connection FIFO orders any later get/submit after it.
+            self.wc.send_async(("cmd", "put_meta", meta))
+            return
         self.wc.request("put_meta", meta)
 
     def kv(self, op, *args):
@@ -652,7 +683,8 @@ class RemoteDriverContext:
         return self.wc.request("driver_cmd", ("remove_node", node_id))
 
     def ref_ops(self, ops):
-        self.wc.send(("ref_ops", ops))
+        # Pure bookkeeping, never latency-critical: ride the next flush.
+        self.wc.batch.buffer(("ref_ops", ops))
 
     def stream_next(self, task_id_bytes: bytes, index: int,
                     timeout=None, blocking: bool = True):
@@ -701,11 +733,12 @@ class WorkerProcContext:
         self.rt = runtime  # worker_main.WorkerRuntime
 
     def submit(self, rec: TaskRecord):
-        # One-way: nested submissions from tasks pipeline without acks.
-        self.rt.wc.send(("cmd", "submit", rec))
+        # One-way + coalescable: nested submissions from tasks pipeline
+        # without acks and batch into one frame.
+        self.rt.wc.send_async(("cmd", "submit", rec))
 
     def submit_actor_task(self, req: ExecRequest):
-        self.rt.wc.send(("cmd", "submit_actor_task", req))
+        self.rt.wc.send_async(("cmd", "submit_actor_task", req))
 
     def create_actor(self, payload):
         self.rt.wc.request("create_actor", payload)
@@ -726,6 +759,9 @@ class WorkerProcContext:
             return list(peeked.keys())
 
     def put_meta(self, meta):
+        if meta.segment is None and get_config().control_plane_batching:
+            self.rt.wc.send_async(("cmd", "put_meta", meta))
+            return
         self.rt.wc.request("put_meta", meta)
 
     def kv(self, op, *args):
@@ -783,7 +819,8 @@ class WorkerProcContext:
         return self.rt.wc.request("driver_cmd", ("cancel", (task_id, force)))
 
     def ref_ops(self, ops):
-        self.rt.wc.send(("ref_ops", ops))
+        # Pure bookkeeping, never latency-critical: ride the next flush.
+        self.rt.wc.batch.buffer(("ref_ops", ops))
 
     def stream_next(self, task_id_bytes: bytes, index: int,
                     timeout=None, blocking: bool = True):
@@ -813,19 +850,9 @@ def _connect_worker_process(runtime):
     global_worker.job_id = JobID.from_int(1)
     set_config(runtime.args.config)
 
-    # Keep current task id in sync for put-id minting.
-    import ray_tpu._private.worker_main as wm
-
-    orig_execute = wm._execute
-
-    def tracking_execute(rt, req, *args, **kwargs):
-        global_worker.current_task_id = req.spec.task_id
-        try:
-            orig_execute(rt, req, *args, **kwargs)
-        finally:
-            global_worker.current_task_id = None
-
-    wm._execute = tracking_execute
+    # Current task id stays in sync for put-id minting: _execute sets it on
+    # global_worker directly (one hot-path function call cheaper than the
+    # wrapper this used to monkeypatch in).
 
 
 # --------------------------------------------------------------------------- helpers
@@ -834,6 +861,8 @@ def _serialize_arg_entries(
 ) -> Tuple[List[Tuple[str, Any]], Dict[str, Tuple[str, Any]]]:
     """Top-level ObjectRef args become dependencies; everything else is serialized
     into the object store now (zero-copy for large arrays)."""
+    if not args and not kwargs:
+        return [], {}
     cfg = get_config()
     store = global_worker.store
     entries: List[Tuple[str, Any]] = []

@@ -47,11 +47,18 @@ class WorkerArgs:
 
 
 class WorkerConnection:
-    """Request/response multiplexing over the driver pipe."""
+    """Request/response multiplexing over the driver pipe.
+
+    Outbound traffic goes through a per-connection BatchedSender: one-way
+    messages (cmd submits, dones, stream items, ref ops) coalesce into
+    ("batch", [msgs]) frames; blocking requests flush first, so FIFO holds
+    and get/wait latency never waits on the flush timer (batching.py)."""
 
     def __init__(self, conn):
+        from ray_tpu._private.batching import BatchedSender
+
         self.conn = conn
-        self._send_lock = threading.Lock()
+        self.batch = BatchedSender(conn.send_bytes)
         self._req_lock = threading.Lock()
         self._next_req_id = 0
         self._pending: Dict[int, "queue.SimpleQueue"] = {}
@@ -69,10 +76,6 @@ class WorkerConnection:
         # evict's next(iter(...)) can see the dict resize mid-iteration.
         self.cancelled: Dict[bytes, None] = {}
         self._cancelled_lock = threading.Lock()
-        # Batched "done" payloads from the serial dispatch loop: flushed when
-        # the local queue drains, so a pipelined burst pays one send per
-        # batch instead of per task.
-        self._done_buffer: List[tuple] = []
         # Hook for message kinds beyond exec/resp/shutdown (e.g. a client-mode
         # driver serving "read_object" pulls for objects it put).
         self.misc_handler = None
@@ -83,30 +86,27 @@ class WorkerConnection:
         self.exit_on_eof = False
 
     def send(self, msg) -> None:
-        with self._send_lock:
-            self.conn.send_bytes(serialization.dumps(msg))
+        """Ordered send: flushes buffered messages first (BatchedSender)."""
+        self.batch.send(msg)
+
+    def send_async(self, msg) -> None:
+        """Coalescable fire-and-forget send."""
+        self.batch.send_async(msg)
+
+    def flush_batch(self) -> None:
+        self.batch.flush()
 
     def send_done(self, payload: tuple, batch: bool = False) -> None:
         """Send (or buffer) one task-completion payload. Completion order
         must reach the scheduler in execution order (lease accounting
-        transfers on each done), so an immediate send always flushes the
-        buffer first."""
+        transfers on each done); the shared batch buffer preserves it, and
+        an immediate send flushes first by construction. batch=True defers
+        to the dispatch loop's queue-empty flush (pure buffering): a
+        pipelined run of N tasks pays one frame, not N."""
         if batch:
-            self._done_buffer.append(payload)
-            if len(self._done_buffer) >= 32:
-                self.flush_dones()
-            return
-        self.flush_dones()
-        self.send(("done",) + payload)
-
-    def flush_dones(self) -> None:
-        buf, self._done_buffer = self._done_buffer, []
-        if not buf:
-            return
-        if len(buf) == 1:
-            self.send(("done",) + buf[0])
+            self.batch.buffer(("done",) + payload)
         else:
-            self.send(("done_batch", buf))
+            self.send(("done",) + payload)
 
     def request(self, method: str, payload: Any, timeout: float | None = None) -> Any:
         """Blocking control-plane RPC to the driver (e.g. get/wait/submit)."""
@@ -126,38 +126,50 @@ class WorkerConnection:
             raise result
         return result
 
+    def _dispatch(self, msg) -> bool:
+        """Route one control message; False stops the reader (shutdown)."""
+        kind = msg[0]
+        if kind == "exec":
+            self.task_queue.put(msg[1])
+        elif kind == "resp":
+            _, req_id, ok, payload = msg
+            with self._req_lock:
+                q = self._pending.pop(req_id, None)
+            if q is not None:
+                q.put((ok, payload))
+        elif kind == "cancel_queued":
+            with self._cancelled_lock:
+                self.cancelled[msg[1]] = None
+                while len(self.cancelled) > 1024:
+                    self.cancelled.pop(next(iter(self.cancelled)), None)
+        elif kind == "shutdown":
+            self.task_queue.put(None)
+            return False
+        elif self.misc_handler is not None:
+            self.misc_handler(msg)
+        return True
+
     def reader_loop(self):
         try:
             while True:
                 data = self.conn.recv_bytes()
                 msg = serialization.loads(data)
-                kind = msg[0]
-                if kind == "exec":
-                    self.task_queue.put(msg[1])
-                elif kind == "exec_batch":
-                    for req in msg[1]:
-                        self.task_queue.put(req)
-                elif kind == "resp":
-                    _, req_id, ok, payload = msg
-                    with self._req_lock:
-                        q = self._pending.pop(req_id, None)
-                    if q is not None:
-                        q.put((ok, payload))
-                elif kind == "cancel_queued":
-                    with self._cancelled_lock:
-                        self.cancelled[msg[1]] = None
-                        while len(self.cancelled) > 1024:
-                            self.cancelled.pop(next(iter(self.cancelled)), None)
-                elif kind == "shutdown":
-                    self.task_queue.put(None)
+                if msg[0] == "batch":
+                    # Coalesced frame: process every contained message before
+                    # returning to the pipe (one wakeup per burst).
+                    alive = True
+                    for m in msg[1]:
+                        alive = self._dispatch(m) and alive
+                    if not alive:
+                        return
+                elif not self._dispatch(msg):
                     return
-                elif self.misc_handler is not None:
-                    self.misc_handler(msg)
         except (EOFError, OSError):
             if self.exit_on_eof:
                 os._exit(1)
         finally:
             self._closed.set()
+            self.batch.close()
             self.task_queue.put(None)
             # Unblock anyone waiting on a response: the driver is gone.
             with self._req_lock:
@@ -449,7 +461,10 @@ def _run_generator(rt: WorkerRuntime, req: ExecRequest, out, progress: Dict[byte
         oid = ObjectID.for_return(spec.task_id, base + len(item_oids))
         sv = serialization.serialize(v)
         meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
-        rt.wc.send(("stream", key, len(item_oids), meta))
+        # Coalescable: a fast producer's items batch; the consumer-side
+        # latency bound is the sub-ms flush timer (and any blocking request
+        # — e.g. the throttle below — flushes first).
+        rt.wc.send_async(("stream", key, len(item_oids), meta))
         item_oids.append(oid)
         progress[key] = len(item_oids)
         if window is not None and len(item_oids) >= window:
@@ -470,9 +485,13 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
     spec = req.spec
     rt.current_task_id = spec.task_id
     rt.current_task_name = spec.name or spec.func.name
+    # Put-id minting and lineage attribution key off the module-level worker
+    # state too (per-thread: threaded actors run concurrent calls).
+    worker_mod.global_worker.current_task_id = spec.task_id
     cfg = rt.args.config
-    for k, v in spec.env_vars.items():
-        os.environ[k] = v
+    if spec.env_vars:
+        for k, v in spec.env_vars.items():
+            os.environ[k] = v
     exec_span = None
     if spec.trace_context is not None:
         from ray_tpu.util import tracing
@@ -583,7 +602,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
             oid = ObjectID.for_return(spec.task_id, 1 + idx)
             meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
             meta.is_error = True
-            rt.wc.send(("stream", spec.task_id.binary(), idx, meta))
+            rt.wc.send_async(("stream", spec.task_id.binary(), idx, meta))
         else:
             # For "dynamic", return_ids[0] is the outer handle: the error
             # surfaces on the caller's single ObjectRef.
@@ -601,6 +620,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
             tracing.end_span(exec_span)
         rt.stream_progress.pop(spec.task_id.binary(), None)
         rt.current_task_id = None
+        worker_mod.global_worker.current_task_id = None
 
 
 def worker_loop(conn, args: WorkerArgs):
@@ -634,14 +654,15 @@ def worker_loop(conn, args: WorkerArgs):
         _install_output_tee(wc, rt, args.worker_id_hex)
     wc.send(("register", args.worker_id_hex, os.getpid()))
     while True:
-        # Flush batched completions on EVERY pass with an empty queue — a
-        # skipped (cancelled) task or any other continue-path must never
-        # leave a buffered done stranded while the loop blocks in get().
+        # Flush the batch buffer (completions, stream items, ref ops) on
+        # EVERY pass with an empty queue — a skipped (cancelled) task or any
+        # other continue-path must never leave a buffered message stranded
+        # while the loop blocks in get().
         if wc.task_queue.empty():
-            wc.flush_dones()
+            wc.flush_batch()
         req = wc.task_queue.get()
         if req is None:
-            wc.flush_dones()
+            wc.flush_batch()
             break
         if req.spec.task_id.binary() in wc.cancelled:
             # Cancelled while lease-queued: the scheduler already sealed the

@@ -7,7 +7,7 @@ TPU-first design the blueprint (§7 step 8) calls for:
 
  - layer stacks are sharded over `pipeline` on their leading (stage) dim, so
    each device group stores only L/P layers — the memory win PP exists for;
- - only the `pipeline` axis is manual (`jax.shard_map(axis_names={"pipeline"})`);
+ - only the `pipeline` axis is manual (`shard_map(axis_names={"pipeline"})`);
    data/fsdp/tensor/context stay compiler-managed, so TP/DP/CP collectives are
    still inserted by XLA *inside* each stage;
  - activations advance between stages with `lax.ppermute` over ICI; the
@@ -28,6 +28,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
+from ray_tpu._private.jax_compat import shard_map as _shard_map
 
 
 def pipeline_apply(
@@ -130,7 +131,7 @@ def pipeline_apply(
         # shard their leading (position) dim the same way.
         x_spec = P(None, None, "context", None)
         stream_spec = P("context")
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_rank,
         mesh=mesh,
         in_specs=(P("pipeline"), x_spec) + (stream_spec,) * len(seq_streams),

@@ -273,6 +273,13 @@ class Algorithm:
 
         self.config = config
         self.iteration = 0
+        # Cumulative sampled env steps, maintained on EVERY algorithm: replay
+        # algorithms (DQN family) advance it inside training_step; for the
+        # rest, train() folds in the per-iteration num_env_steps_sampled
+        # metric. Exploration schedules anneal against this — previously only
+        # replay algorithms defined it, so EpsilonGreedy froze at its initial
+        # value forever on PPO/A2C/PG/IMPALA/APPO.
+        self.env_steps = 0
         self.callbacks = config.callbacks_class()
         # Driver-side strategy instance: owns the annealing schedule whose
         # values are pushed to runners each iteration (`exploration_push`).
@@ -526,12 +533,20 @@ class Algorithm:
         # don't each re-wire the schedule plumbing. One-iteration lag on
         # env_steps is inherent (steps count after sampling) and matches the
         # reference's global-timestep-based schedule reads.
-        push = self.exploration_push(getattr(self, "env_steps", 0))
+        push = self.exploration_push(self.env_steps)
         if push is not None and self.env_runners:
             ray_tpu.get(
                 [r.set_exploration.remote(push) for r in self.env_runners]
             )
+        steps_before = self.env_steps
         metrics = self.training_step()
+        if self.env_steps == steps_before:
+            # Replay algorithms advance env_steps themselves (and report the
+            # cumulative total as the metric); everyone else reports the
+            # per-iteration count — fold it into the schedule counter here.
+            self.env_steps = steps_before + int(
+                metrics.get("num_env_steps_sampled") or 0
+            )
         if push is not None:
             metrics.update(
                 {f"exploration/{k}": float(np.asarray(v)) for k, v in push.items()}
@@ -605,7 +620,7 @@ class Algorithm:
         # a fresh runner's initial-state default (epsilon=1.0 / scale=1.0).
         if cfg.evaluation_explore:
             if self.exploration is not None:
-                push = self.exploration_push(getattr(self, "env_steps", 0))
+                push = self.exploration_push(self.env_steps)
                 if push is not None:
                     sync += [r.set_exploration.remote(push) for r in runners]
             elif callable(getattr(self, "epsilon", None)):

@@ -12,9 +12,10 @@ round-robin replay shard, and immediately resubmits that runner — the
 scheduler's lease pipelining keeps runners hot); the learner stays a jitted
 SPMD step on the driver's devices. Per-worker epsilons follow the reference's
 `PerWorkerEpsilonGreedy` power schedule so exploration diversity comes from
-the fleet, not a decayed scalar. Priorities are recomputed with one extra
-jitted TD forward after each update instead of threading per-sample TD
-errors through the learner's (mean-reduced, possibly sharded) metrics path.
+the fleet, not a decayed scalar. Priorities refresh from the per-sample
+|TD| the learner update itself returns (vector aux threads through the
+metrics path, concatenated across shards in sample order) — no extra
+weight fetch or TD forward per gradient step.
 """
 
 from __future__ import annotations
@@ -24,7 +25,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
-from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, make_td_error_fn
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer
 
 
@@ -113,7 +114,6 @@ class ApexDQN(DQN):
         self.num_updates = 0
         self.env_steps = 0
         self._rng = np.random.default_rng(config.seed)
-        self._td_fn = make_td_error_fn(config, self.module)
         self._sync_target()
         # One in-flight sample() per runner, resubmitted on harvest — the
         # decoupling that makes Ape-X Ape-X.
@@ -228,21 +228,14 @@ class ApexDQN(DQN):
             if batch is None:
                 continue
             idx = batch.pop("batch_indexes")
-            metrics_acc.append(self.learner_group.update(batch))
+            m = self.learner_group.update(batch)
+            td = np.asarray(m.pop("td_abs"))
+            metrics_acc.append(m)
             self.num_updates += 1
-            # Fresh |TD| under post-update params -> new shard priorities.
-            new_w = self.learner_group.get_weights()
-            td = self._td_fn(
-                new_w,
-                self.target_params,
-                batch["obs"],
-                batch["actions"],
-                batch["rewards"],
-                batch["next_obs"],
-                batch["terminateds"],
-                batch.get("discount"),
-            )
-            shard.update_priorities.remote(idx, np.asarray(td))
+            # Per-sample |TD| from the update itself -> new shard priorities
+            # (no weight re-fetch / second TD forward per gradient step —
+            # that doubled host<->device transfers).
+            shard.update_priorities.remote(idx[: len(td)], td)
             if self.num_updates % cfg.target_network_update_freq == 0:
                 self._sync_target()
         if metrics_acc:

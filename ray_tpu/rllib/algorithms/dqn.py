@@ -81,34 +81,6 @@ class DQNConfig(AlgorithmConfig):
         return self
 
 
-def make_td_error_fn(config: "DQNConfig", module) -> Callable:
-    """Jitted |TD| per transition under (params, target_params) — the same
-    target math as `make_dqn_loss` reduced to the error vector; used to
-    refresh priorities after prioritized-replay updates (reference:
-    `dqn.py` `td_error` -> `update_priorities`)."""
-    import jax
-    import jax.numpy as jnp
-
-    gamma, double_q = config.gamma, config.double_q
-
-    def td(params, target_params, obs, actions, rewards, next_obs, terminateds,
-           discount=None):
-        q_all, _ = module.forward(params, obs)
-        q_sa = jnp.take_along_axis(q_all, actions[..., None], axis=-1)[..., 0]
-        tq_all, _ = module.forward(target_params, next_obs)
-        if double_q:
-            nq, _ = module.forward(params, next_obs)
-            a_star = jnp.argmax(nq, axis=-1)
-            tq = jnp.take_along_axis(tq_all, a_star[..., None], axis=-1)[..., 0]
-        else:
-            tq = tq_all.max(axis=-1)
-        disc = gamma if discount is None else discount
-        y = rewards + disc * (1.0 - terminateds) * tq
-        return jnp.abs(q_sa - jnp.asarray(y, jnp.float32))
-
-    return jax.jit(td)
-
-
 def make_dqn_loss(config: DQNConfig) -> Callable:
     """Pure (module, params, batch, extra) -> (loss, aux): huber TD error with
     (double-)Q targets from the target params in the learner's extra state."""
@@ -146,6 +118,11 @@ def make_dqn_loss(config: DQNConfig) -> Callable:
         aux = {
             "td_error_mean": jnp.sum(weight * jnp.abs(td)) / jnp.maximum(jnp.sum(weight), 1.0),
             "q_mean": jnp.mean(q_sa),
+            # Per-sample |TD| rides out of the SAME jitted update (the
+            # learner passes vector aux through): prioritized replay
+            # refreshes priorities from it instead of re-fetching weights and
+            # running a second TD forward per gradient step.
+            "td_abs": jnp.abs(td),
         }
         return total, aux
 
@@ -214,11 +191,13 @@ def make_c51_loss(config: DQNConfig) -> Callable:
         # trunk forward): E_z[softmax] of the taken action's atom row.
         q_sa = jnp.sum(jnp.exp(logp_sa) * support, axis=-1)
         aux = {
-            # Cross-entropy vs the projected target is the reported TD-error
-            # METRIC only; prioritized replay refreshes priorities through
-            # the scalar-Q `make_td_error_fn` in training_step.
             "td_error_mean": total,
             "q_mean": jnp.mean(q_sa),
+            # Per-sample cross-entropy vs the projected target: the
+            # distributional TD error (what the reference uses for
+            # prioritized replay when num_atoms > 1), returned from the same
+            # jitted update so priorities refresh without a second forward.
+            "td_abs": ce,
         }
         return total, aux
 
@@ -297,7 +276,9 @@ def replay_ma_training_step(
             batch = buf.sample(cfg.train_batch_size, algo._rng)
             if batch_extras is not None:
                 batch_extras(pid, batch)
-            acc.append(lg.update(batch))
+            m = lg.update(batch)
+            m.pop("td_abs", None)  # vector aux; MA buffers are uniform
+            acc.append(m)
             algo.num_updates += 1
             if after_update is not None:
                 after_update()
@@ -332,8 +313,6 @@ class DQN(Algorithm):
             }
         else:
             self.buffer = config.make_replay_buffer()
-            if isinstance(self.buffer, PrioritizedReplayBuffer):
-                self._td_fn = make_td_error_fn(config, self.module)
         self.num_updates = 0
         self.env_steps = 0
         self._rng = np.random.default_rng(config.seed)
@@ -459,21 +438,16 @@ class DQN(Algorithm):
                     idx = batch.pop("batch_indexes")
                 else:
                     batch = self.buffer.sample(cfg.train_batch_size, self._rng)
-                metrics_acc.append(self.learner_group.update(batch))
+                m = self.learner_group.update(batch)
+                td = m.pop("td_abs", None)
+                metrics_acc.append(m)
                 self.num_updates += 1
                 if prioritized:
-                    # Refresh sampled priorities under post-update params.
-                    td = self._td_fn(
-                        self.learner_group.get_weights(),
-                        self.target_params,
-                        batch["obs"],
-                        batch["actions"],
-                        batch["rewards"],
-                        batch["next_obs"],
-                        batch["terminateds"],
-                        batch.get("discount"),
-                    )
-                    self.buffer.update_priorities(idx, np.asarray(td))
+                    # Refresh sampled priorities from the per-sample |TD| the
+                    # update itself returned — no weight re-fetch, no second
+                    # TD forward per gradient step.
+                    td = np.asarray(td)
+                    self.buffer.update_priorities(idx[: len(td)], td)
                 if self.num_updates % cfg.target_network_update_freq == 0:
                     self._sync_target()
             out.update(

@@ -87,8 +87,11 @@ class JaxLearner:
             )
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        """One SGD step on a host batch; returns scalar metrics."""
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """One SGD step on a host batch. Scalar aux entries come back as
+        floats; vector aux (e.g. DQN's per-sample `td_abs` for prioritized
+        replay) comes back as numpy arrays — computed inside the same jitted
+        step, so consumers never pay a second forward."""
         import jax
 
         if self.mesh is not None:
@@ -99,7 +102,11 @@ class JaxLearner:
         self.params, self.opt_state, self.extra, aux = self._update(
             self.params, self.opt_state, self.extra, batch
         )
-        return {k: float(v) for k, v in aux.items()}
+        out: Dict[str, Any] = {}
+        for k, v in aux.items():
+            arr = np.asarray(v)
+            out[k] = arr if arr.ndim else float(arr)
+        return out
 
     def set_extra(self, extra: Any) -> None:
         """Swap the replicated auxiliary state (e.g. a synced target network)."""

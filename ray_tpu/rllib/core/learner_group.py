@@ -125,9 +125,16 @@ class LearnerGroup:
                     *extras,
                 )
                 ray_tpu.get([lr.set_extra.remote(avg_extra) for lr in self._remote])
-        out: Dict[str, float] = {}
+        out: Dict[str, Any] = {}
         for k in metrics[0]:
-            out[k] = float(np.mean([m[k] for m in metrics]))
+            if np.ndim(metrics[0][k]):
+                # Vector aux (per-sample TD errors): shards sliced the batch
+                # in order, so concatenation restores per-sample order
+                # (covering the first n*per rows; the remainder was never
+                # trained this round).
+                out[k] = np.concatenate([np.asarray(m[k]) for m in metrics])
+            else:
+                out[k] = float(np.mean([m[k] for m in metrics]))
         return out
 
     def set_extra(self, extra) -> None:

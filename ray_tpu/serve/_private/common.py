@@ -54,3 +54,6 @@ class ReplicaInfo:
     replica_id: str
     actor_id: Any  # ActorID — picklable
     deployment: str
+    # Copied from the deployment so the ROUTER can cap per-replica load
+    # decisions (affinity escape) without a controller round trip.
+    max_concurrent_queries: int = 1

@@ -138,7 +138,12 @@ class ServeController:
             )
             # Block until constructed so routing tables only list live replicas.
             ray_tpu.get(handle.__ray_ready__.remote())
-            replicas.append(ReplicaInfo(rid, handle._actor_id, name))
+            replicas.append(
+                ReplicaInfo(
+                    rid, handle._actor_id, name,
+                    max_concurrent_queries=info.max_concurrent_queries,
+                )
+            )
             self._bump(f"replicas::{name}")
         while len(replicas) > target:
             rep = replicas.pop()

@@ -19,6 +19,11 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 _LOAD_REPORT_INTERVAL_S = 0.5
+# Model-affinity escape hysteresis: the sticky replica keeps a model's
+# traffic until its in-flight load exceeds the power-of-two alternative's by
+# more than this (or hits max_concurrent_queries) — switching replicas pays a
+# model reload, so a 1-request imbalance must not thrash the affinity map.
+_AFFINITY_ESCAPE_THRESHOLD = 2
 
 # Every live Router in this process; serve.shutdown() closes them so their
 # long-poll listeners release controller call slots.
@@ -198,6 +203,30 @@ class Router:
                     chosen = next(
                         (r for r in self._replicas if r.replica_id == rid), None
                     )
+                if chosen is not None and len(self._replicas) > 1:
+                    # Load-based escape: affinity must not pin a hot model's
+                    # traffic to one replica while others idle. When the
+                    # sticky replica is at its concurrency cap, or ahead of a
+                    # power-of-two alternative by more than the hysteresis
+                    # threshold (re-loading weights costs something), fall
+                    # back to the alternative and re-point the affinity map.
+                    aff_load = self._load_of(chosen.replica_id)
+                    others = [
+                        r for r in self._replicas
+                        if r.replica_id != chosen.replica_id
+                    ]
+                    alt = min(
+                        random.sample(others, min(2, len(others))),
+                        key=lambda r: self._load_of(r.replica_id),
+                    )
+                    alt_load = self._load_of(alt.replica_id)
+                    if aff_load >= chosen.max_concurrent_queries and (
+                        alt_load < alt.max_concurrent_queries
+                        or alt_load < aff_load
+                    ):
+                        chosen = alt
+                    elif aff_load > alt_load + _AFFINITY_ESCAPE_THRESHOLD:
+                        chosen = alt
             if chosen is None:
                 if len(self._replicas) == 1:
                     chosen = self._replicas[0]

@@ -19,18 +19,48 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+
+
+def _xla_accepts(extra_flags: str) -> bool:
+    """XLA's env-flag parser hard-aborts the PROCESS on unknown flags
+    (parse_flags_from_env.cc F-level check) — it cannot be try/excepted, so
+    probe version-dependent flags in a throwaway subprocess. A jaxlib
+    without them (e.g. 0.4.3x) otherwise aborts EVERY jax-touching test at
+    backend init, taking the rest of the pytest run with it."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + extra_flags).strip()
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                timeout=120,
+            ).returncode
+            == 0
+        )
+    except Exception:
+        return False
+
+
+_COLLECTIVE_TIMEOUT_FLAGS = (
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+    " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+)
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags and _xla_accepts(
+    _COLLECTIVE_TIMEOUT_FLAGS
+):
     # The virtual 8-device mesh time-shares this box's core(s): all 8 device
     # programs' pre-collective compute serializes, so a heavy first step
     # (conv grads compiling + executing) can exceed XLA CPU's default 40s
     # collective-rendezvous kill switch, which hard-aborts the process
     # (rendezvous.cc "Termination timeout ... Exiting"). Raise warn/terminate
     # far above any legitimate single-step skew; a true deadlock still dies,
-    # just slower.
-    flags = (
-        flags + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-        " --xla_cpu_collective_call_terminate_timeout_seconds=600"
-    ).strip()
+    # just slower. Skipped when the installed XLA predates these flags.
+    flags = (flags + " " + _COLLECTIVE_TIMEOUT_FLAGS).strip()
 os.environ["XLA_FLAGS"] = flags
 
 try:

@@ -234,3 +234,41 @@ def test_model_affinity_routing(ray_start_regular):
         assert len(pids) == 1, pids
     finally:
         serve.shutdown()
+
+
+def test_model_affinity_load_escape(ray_start_regular):
+    """Affinity routing is load-aware: when the sticky replica is saturated
+    (in-flight >= max_concurrent_queries), concurrent traffic for the same
+    model escapes to the power-of-two alternative instead of queueing behind
+    one replica while the other idles — and the affinity map follows."""
+    import os
+    import time
+
+    from ray_tpu import serve
+
+    serve.start(http_options={"location": "NoServer"})
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=1)
+    class Slow:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id):
+            return model_id
+
+        async def __call__(self, x):
+            await self.get_model()
+            time.sleep(0.3)
+            return os.getpid()
+
+    handle = serve.run(Slow.bind(), _blocking_http=False)
+    try:
+        # Fire a concurrent burst for ONE model id; resolve afterwards. The
+        # first call pins the affinity replica; the rest see it saturated
+        # and must spread to the second replica.
+        resps = [
+            handle.options(multiplexed_model_id="hot").remote(i)
+            for i in range(6)
+        ]
+        pids = {r.result() for r in resps}
+        assert len(pids) == 2, pids
+    finally:
+        serve.shutdown()
