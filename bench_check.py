@@ -24,6 +24,12 @@ import json
 import sys
 from typing import Dict
 
+# Metrics every bench_core run MUST produce, baseline or not: a run that
+# silently drops one of these is a broken bench, not a clean pass. The
+# telemetry ratio is the overhead guard — telemetry-on throughput within
+# `threshold` of telemetry-off (default 20%).
+REQUIRED_METRICS = ("task_throughput_telemetry_ratio",)
+
 
 def load_metrics(path: str) -> Dict[str, float]:
     out: Dict[str, float] = {}
@@ -59,6 +65,9 @@ def main() -> int:
         return 1
 
     failures = []
+    for name in REQUIRED_METRICS:
+        if name not in new:
+            failures.append(f"{name}: REQUIRED metric missing from new run")
     for name, old_v in sorted(base.items()):
         if name not in new:
             failures.append(f"{name}: MISSING from new run (baseline {old_v:g})")

@@ -219,6 +219,45 @@ def main():
 
     ray_tpu.shutdown()
 
+    # ------------------------------------------------------- telemetry overhead
+    # Same pipelined task workload in two fresh clusters, telemetry fully on
+    # (the default: per-stage task events + internal metrics) vs fully off.
+    # The recorded metric is the ratio on/off (~1.0 when telemetry is free);
+    # bench_check treats it like any higher-is-better metric, so an overhead
+    # regression beyond the threshold fails the trajectory check.
+    def task_throughput(system_config):
+        ray_tpu.init(num_cpus=4, _system_config=system_config)
+
+        @ray_tpu.remote
+        def _nop():
+            return None
+
+        def run(n):
+            ray_tpu.get([_nop.remote() for _ in range(n)])
+
+        r = timeit("task_throughput_probe", run, 2000)
+        ray_tpu.shutdown()
+        return r["value"]
+
+    # Alternating pairs, best-of-each: single measurements of this workload
+    # swing >10% run to run on a shared host, which would make the ratio
+    # guard fire on noise.
+    tel_on = tel_off = 0.0
+    for _ in range(3):
+        tel_on = max(tel_on, task_throughput({}))
+        tel_off = max(tel_off, task_throughput({
+            "enable_timeline": False, "enable_metrics": False,
+        }))
+    results.append(
+        {
+            "metric": "task_throughput_telemetry_ratio",
+            "value": round(tel_on / tel_off, 3),
+            "unit": "ratio",
+            "telemetry_on_ops_s": tel_on,
+            "telemetry_off_ops_s": tel_off,
+        }
+    )
+
     notes = [
         {
             "note": (

@@ -33,6 +33,18 @@ from ray_tpu.remote_function import RemoteFunction
 __version__ = "0.1.0"
 
 
+def timeline(filename=None):
+    """Unified chrome trace of the runtime (reference: `ray timeline`):
+    per-stage task lifecycle intervals (submit -> queued -> lease_granted ->
+    args_fetched -> exec_start -> exec_end -> result_stored) merged with
+    tracing spans (submit/execute/custom) and collective-op intervals on
+    shared trace ids. Returns the event list; writes JSON when `filename`
+    is given — load it at chrome://tracing or https://ui.perfetto.dev."""
+    from ray_tpu.util import state as _state
+
+    return _state.timeline(filename)
+
+
 def remote(*args, **kwargs):
     """`@ray_tpu.remote` decorator for functions and classes (reference:
     `worker.py:2942` overloads). Supports bare and parameterized forms."""
@@ -74,6 +86,7 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "timeline",
     "wait",
     "__version__",
 ]

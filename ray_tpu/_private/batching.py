@@ -39,6 +39,36 @@ from typing import Any, Callable, List, Optional
 
 from ray_tpu._private import serialization
 
+# Process-wide batching stats, exported as ray_tpu_batch_* metrics by the
+# telemetry collector (telemetry.ensure_batching_metrics). Plain ints bumped
+# under each sender's lock: the send path never touches a Metric object.
+# _FLUSH_SIZE_COUNTS[i] counts flushes of <= BATCH_FLUSH_BOUNDS[i] messages
+# (overflow flushes appear only in the frame count, like Histogram.observe).
+_STATS = {"msgs": 0, "frames": 0, "bytes": 0, "straggler_fires": 0}
+_FLUSH_SIZE_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+_FLUSH_SIZE_COUNTS = [0] * len(_FLUSH_SIZE_BOUNDS)
+_metrics_on = False
+
+
+def _enable_stats() -> None:
+    global _metrics_on
+    if _metrics_on:
+        return
+    _metrics_on = True
+    from ray_tpu._private import telemetry
+
+    telemetry.ensure_batching_metrics()
+
+
+def _record_flush(n_msgs: int, nbytes: int) -> None:
+    _STATS["msgs"] += n_msgs
+    _STATS["frames"] += 1
+    _STATS["bytes"] += nbytes
+    for i, b in enumerate(_FLUSH_SIZE_BOUNDS):
+        if n_msgs <= b:
+            _FLUSH_SIZE_COUNTS[i] += 1
+            break
+
 
 def _meta_nbytes(meta: Any) -> int:
     """Bytes an ObjectMeta carries IN the message (inline payloads only;
@@ -98,6 +128,9 @@ class BatchedSender:
 
             cfg = get_config()
         self._raw_send = raw_send
+        self._stats = bool(getattr(cfg, "enable_metrics", False))
+        if self._stats:
+            _enable_stats()
         self.enabled = bool(cfg.control_plane_batching)
         self.max_msgs = max(1, int(cfg.control_plane_batch_max_msgs))
         self.max_bytes = int(cfg.control_plane_batch_max_bytes)
@@ -117,6 +150,8 @@ class BatchedSender:
         queued before it. Raises on a dead connection."""
         with self._lock:
             self._flush_locked()
+            if self._stats:
+                _record_flush(1, approx_msg_nbytes(msg))
             self._raw_send(serialization.dumps(msg))
 
     def send_async(self, msg: Any) -> None:
@@ -185,10 +220,12 @@ class BatchedSender:
     # --------------------------------------------------------------- internals
     def _flush_locked(self) -> None:
         msgs, self._buf = self._buf, []
-        self._nbytes = 0
+        nbytes, self._nbytes = self._nbytes, 0
         self._last_write = time.monotonic()
         if not msgs:
             return
+        if self._stats:
+            _record_flush(len(msgs), nbytes)
         if len(msgs) == 1:
             self._raw_send(serialization.dumps(msgs[0]))
         else:
@@ -231,6 +268,8 @@ class BatchedSender:
                     break
                 last_activity = max(self._last_write, self._last_enqueue)
                 if time.monotonic() - last_activity >= self.interval:
+                    if self._stats and self._buf:
+                        _STATS["straggler_fires"] += 1
                     self.flush()
                     break
                 delay = min(delay * 2, 0.02)

@@ -122,8 +122,29 @@ class Config:
     health_check_failure_threshold: int = 5
 
     # --- task events / tracing (reference: task_event_buffer.h, gcs_task_manager.h) ---
+    # Ring-buffer capacity of the GCS task-event store; oldest events drop
+    # first. Doubles as state.summarize()'s listing budget (its task/object
+    # counts scan at most this many records per call) — the knob is the
+    # observability-retention budget, so shrinking it shrinks both.
     task_events_max_num_task_in_gcs: int = 100000
+    # Per-stage task lifecycle events (submit -> queued -> lease_granted ->
+    # args_fetched -> exec_start -> exec_end -> result_stored) and the
+    # ray_tpu.timeline() chrome trace built from them. Worker-side stages
+    # ride back on the existing done/batch messages (no extra round trips).
     enable_timeline: bool = True
+
+    # --- internal runtime metrics (util/metrics.py registry) ---
+    # Instrument the scheduler loop (queue depth, dispatch wait, lease
+    # occupancy), control-plane batching (flush sizes, coalesce ratio,
+    # straggler fires), the object store (bytes/objects/spills, hit rate),
+    # collectives (per-op wall time), and the Serve router (queue wait,
+    # saturation). Recorded off the hot path: hot paths bump plain ints;
+    # gauges/histograms materialize once per scheduler-loop tick / registry
+    # flush. False skips all instrumentation (knob-off parity).
+    enable_metrics: bool = True
+    # Scheduler-side gauge refresh floor: the loop snapshots its telemetry at
+    # most this often even when iterating per-message under load.
+    internal_metrics_interval_s: float = 0.25
 
     # --- collective ---
     collective_timeout_s: float = 120.0

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -67,6 +68,19 @@ class TaskEvent:
     node_id: str = ""
     worker_pid: int = 0
     extra: Dict[str, Any] = field(default_factory=dict)
+    # Per-stage timestamp pipeline, populated on terminal events:
+    # submit -> queued -> lease_granted -> args_fetched -> exec_start ->
+    # exec_end -> result_stored (reference: the per-state timestamps of
+    # `rpc::TaskEvents`/`task_event_buffer.h`; worker-side stages ride the
+    # done message, so recording them adds no round trips).
+    stages: Dict[str, float] = field(default_factory=dict)
+
+
+# Canonical stage order for consumers (state API durations, timeline).
+TASK_STAGES = (
+    "submit", "queued", "lease_granted", "args_fetched",
+    "exec_start", "exec_end", "result_stored",
+)
 
 
 class GCS:
@@ -82,8 +96,11 @@ class GCS:
         # creation record, persisted so a restarted head can restart them
         # (reference: Redis-backed GcsActorManager recovery).
         self.detached_actors: Dict[bytes, bytes] = {}
-        self.task_events: List[TaskEvent] = []
+        # Bounded ring (reference: gcs_task_manager's
+        # task_events_max_num_task_in_gcs): a full buffer drops the oldest
+        # event per append, O(1), instead of periodic bulk head-drops.
         self._task_event_cap = 100000
+        self.task_events: "deque[TaskEvent]" = deque(maxlen=self._task_event_cap)
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
 
     # --- internal KV (reference: GcsKvManager / experimental.internal_kv) ---
@@ -111,11 +128,30 @@ class GCS:
                 pass
 
     # --- task events ---
+    def set_task_event_cap(self, cap: int) -> None:
+        """Resize the ring to `task_events_max_num_task_in_gcs` (config)."""
+        cap = max(1, int(cap))
+        if cap != self._task_event_cap:
+            self._task_event_cap = cap
+            self.task_events = deque(self.task_events, maxlen=cap)
+
     def record_task_event(self, ev: TaskEvent) -> None:
-        self.task_events.append(ev)
-        if len(self.task_events) > self._task_event_cap:
-            # Bounded store with head drop, like the reference's gcs_task_manager.
-            del self.task_events[: self._task_event_cap // 10]
+        self.record_event_tuple(
+            (ev.task_id, ev.name, ev.state, ev.timestamp, ev.stages or None)
+        )
+
+    def record_event_tuple(self, ev: tuple) -> None:
+        """Hot-path append: `(task_id_hex, name, state, timestamp,
+        stages_or_None)`. The ring stores plain tuples (a dataclass + two
+        default-factory dicts per event is measurable at 3 events/task);
+        TaskEvent objects materialize at read time (task_event_list)."""
+        self.task_events.append(ev)  # ring: maxlen evicts the oldest
+
+    def task_event_list(self) -> List[TaskEvent]:
+        return [
+            TaskEvent(task_id=t, name=n, state=s, timestamp=ts, stages=st or {})
+            for (t, n, s, ts, st) in self.task_events
+        ]
 
     # --- persistence (reference: RedisStoreClient-backed GCS fault tolerance,
     # `store_client/redis_store_client.h:28`, restore at `gcs_server.cc:59`) ---

@@ -255,6 +255,31 @@ _peer_lock = threading.Lock()
 # Nodes known to advertise no data server: skip the locate round-trip.
 _no_peer_nodes: set = set()
 
+# Reader-side locality stats (ray_tpu_object_store_reads_total /
+# _pull_bytes_total via telemetry.ensure_objectstore_client_metrics): the
+# hot read path bumps plain ints; a registry collector publishes deltas.
+_READ_STATS = {"local_hits": 0, "cache_hits": 0, "pulls": 0, "pull_bytes": 0}
+_collector_installed = False
+
+
+def _stats_enabled() -> bool:
+    # Re-read the config every time (cheap attr read): a shutdown()/init()
+    # cycle may flip enable_metrics, and a stale cached verdict here would
+    # silently pin the old behavior for the life of the process. Only the
+    # collector install is once-per-process.
+    global _collector_installed
+    try:
+        from ray_tpu._private import telemetry
+
+        if not telemetry.metrics_enabled():
+            return False
+        if not _collector_installed:
+            _collector_installed = True
+            telemetry.ensure_objectstore_client_metrics()
+        return True
+    except Exception:  # noqa: BLE001 — stats must never break a read
+        return False
+
 
 def _fetch_peer(address: str, meta: ObjectMeta, timeout: float = 30.0) -> Optional[bytes]:
     """Pull a segment's bytes straight from the owning daemon's data server
@@ -319,11 +344,15 @@ def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn,
         return meta
     remote = force_remote and meta.node_id is not None and meta.node_id != store.node_id
     if not remote and os.path.exists(meta.segment):
+        if _stats_enabled():
+            _READ_STATS["local_hits"] += 1
         return meta
     # Pulled copies cache under the OBJECT id (arena objects share one file
     # path, so the segment basename isn't unique) as plain file segments.
     local_path = os.path.join(store.shm_dir, meta.object_id.hex())
     if os.path.exists(local_path):
+        if _stats_enabled():
+            _READ_STATS["cache_hits"] += 1
         return dataclasses.replace(meta, segment=local_path, arena_offset=None)
     fetched = data = None
     if locate_fn is not None and meta.node_id not in _no_peer_nodes:
@@ -345,6 +374,9 @@ def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn,
             _no_peer_nodes.add(located.node_id)
     if fetched is None:
         fetched, data = pull_fn(meta.object_id.binary())
+    if _stats_enabled():
+        _READ_STATS["pulls"] += 1
+        _READ_STATS["pull_bytes"] += len(data) if data else 0
     if fetched.segment is None:
         return fetched  # became inline (e.g. error overwrite)
     local_path = os.path.join(store.shm_dir, fetched.object_id.hex())

@@ -9,7 +9,13 @@ travel on these pipes (they go through the shared-memory store; see object_store
 Message grammar (all pickled with cloudpickle):
   worker -> driver:
     ("register", worker_id_hex, pid)
-    ("done", task_id_bytes, ok: bool, result_metas: list[ObjectMeta])
+    ("done", task_id_bytes, ok: bool, result_metas: list[ObjectMeta]
+           [, stage_ts: dict[str, float]])
+                            # Worker-side lifecycle stamps (args_fetched /
+                            # exec_start / exec_end / result_stored) ride the
+                            # completion message when enable_timeline is on —
+                            # per-stage task events cost zero extra round
+                            # trips. Readers treat the 5th element as optional.
     ("req", req_id: int, method: str, payload)        # blocking control-plane RPC
     ("actor_exit", reason)
   driver -> worker:
@@ -30,6 +36,7 @@ Message grammar (all pickled with cloudpickle):
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -101,6 +108,10 @@ class TaskSpec:
     # Tracing context propagated caller -> worker (util/tracing.py); the
     # execute-side span becomes a child of the caller's submit span.
     trace_context: Optional[Dict[str, str]] = None
+    # Caller-side submission wall time: the "submit" stage of the task-event
+    # pipeline (specs are built at the submit call site in every path —
+    # remote(), actor method calls, actor creation).
+    submitted_ts: float = field(default_factory=time.time)
 
 
 @dataclass

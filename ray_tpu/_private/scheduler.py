@@ -35,7 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ray_tpu._private import serialization
 from ray_tpu._private.batching import approx_msg_nbytes as _approx_msg_nbytes
 from ray_tpu._private.config import Config
-from ray_tpu._private.gcs import GCS, ActorInfo, TaskEvent
+from ray_tpu._private.gcs import GCS, ActorInfo
 from ray_tpu._private.ids import (
     ActorID,
     NodeID,
@@ -281,7 +281,11 @@ class TaskRecord:
     running_since: float = 0.0
     owner: str = ""
     oom_killed: bool = False
-    oom_detail: str = ""  # human context, e.g. " (node at 97% of 4096MB)" 
+    oom_detail: str = ""  # human context, e.g. " (node at 97% of 4096MB)"
+    # Per-stage lifecycle timestamps (submit lives on spec.submitted_ts;
+    # queued/lease_granted stamp here scheduler-side; args_fetched/exec_start/
+    # exec_end/result_stored merge in from the worker's done message).
+    stage_ts: Dict[str, float] = field(default_factory=dict)
 
 
 class _PendingQueue:
@@ -473,6 +477,13 @@ class Scheduler:
         self.gcs = gcs
         self.config = config
         self.session_dir = session_dir
+        # Task-event ring capacity comes from config, not the GCS default.
+        gcs.set_task_event_cap(config.task_events_max_num_task_in_gcs)
+        # Internal runtime metrics: hot paths bump plain ints on this object;
+        # gauges/histograms materialize once per loop tick (telemetry.py).
+        from ray_tpu._private.telemetry import SchedulerTelemetry
+
+        self.telemetry = SchedulerTelemetry(config)
         self.nodes: Dict[NodeID, NodeState] = {}
         self.node_order: List[NodeID] = []
         self.object_table: Dict[bytes, ObjectMeta] = {}
@@ -805,12 +816,14 @@ class Scheduler:
             ent = buf[id(handle)] = [handle, [], 0]
         ent[1].append(msg)
         ent[2] += _approx_msg_nbytes(msg)
+        self.telemetry.out_msgs += 1
         if len(ent[1]) >= self._batch_max_msgs or ent[2] >= self._batch_max_bytes:
             del buf[id(handle)]
             self._send_many(handle, ent[1])
 
     def _send_many(self, handle, msgs: List[Any]) -> None:
         msg = msgs[0] if len(msgs) == 1 else ("batch", msgs)
+        self.telemetry.out_frames += 1
         if not handle.send(msg):
             self._on_send_failure(handle)
 
@@ -877,6 +890,9 @@ class Scheduler:
             # Self-gated by memory_monitor_refresh_ms (NOT the 0.5s health
             # gate — sub-500ms refresh settings must be honored).
             self._memory_monitor_tick(now)
+            # Telemetry snapshot: self-gated by internal_metrics_interval_s,
+            # so a loop spinning per-message never pays per-iteration gauges.
+            self.telemetry.on_iteration(self, now)
             if self._delayed_retries:
                 due = [x for x in self._delayed_retries if x[0] <= now]
                 if due:
@@ -1274,6 +1290,10 @@ class Scheduler:
             rec.state = "PENDING"
             rec.worker = None
             self._record_event(rec.spec, "RETRY")
+            self.telemetry.retried += 1
+            # A fresh attempt gets a fresh stage pipeline (the dead attempt's
+            # lease/worker stamps would otherwise leak into the retry's).
+            rec.stage_ts = {"queued": time.time()}
             if rec.oom_killed:
                 # Back off before re-queuing (task_oom_retry_delay_ms): an
                 # immediate redispatch under sustained pressure would be
@@ -1504,9 +1524,11 @@ class Scheduler:
         if kind == "done":
             # Lease-pipelined workers coalesce dones into "batch" frames
             # while their local queue is non-empty; order within the frame =
-            # execution order.
-            _, task_id_bytes, ok, metas = msg
-            self._on_task_done(wh, TaskID(task_id_bytes), ok, metas)
+            # execution order. Element 5 (worker-side stage timestamps) is
+            # optional: absent when enable_timeline is off.
+            _, task_id_bytes, ok, metas = msg[:4]
+            stages = msg[4] if len(msg) > 4 else None
+            self._on_task_done(wh, TaskID(task_id_bytes), ok, metas, stages)
         elif kind == "stream":
             _, task_id_bytes, index, meta = msg
             self._on_stream_item(TaskID(task_id_bytes), index, meta)
@@ -1616,7 +1638,9 @@ class Scheduler:
             },
         )
 
-    def _on_task_done(self, wh: WorkerHandle, task_id: TaskID, ok: bool, metas: List[ObjectMeta]):
+    def _on_task_done(self, wh: WorkerHandle, task_id: TaskID, ok: bool,
+                      metas: List[ObjectMeta],
+                      stages: Optional[Dict[str, float]] = None):
         rec = self.tasks.get(task_id)
         if rec is None:
             return
@@ -1627,8 +1651,19 @@ class Scheduler:
             # completion path would clobber the successor's transferred
             # accounting and overwrite the cancellation error.
             return
+        if stages:
+            rec.stage_ts.update(stages)
         rec.state = "FINISHED" if ok else "FAILED"
-        self._record_event(rec.spec, rec.state)
+        tel = self.telemetry
+        if ok:
+            tel.finished += 1
+        else:
+            tel.failed += 1
+        if tel.enabled and stages:
+            t0, t1 = stages.get("exec_start"), stages.get("exec_end")
+            if t0 is not None and t1 is not None:
+                tel.exec_times.append(t1 - t0)
+        self._record_event(rec.spec, rec.state, rec=rec)
         # Actor-creation args stay pinned for the actor's lifetime: a restart
         # replays the creation task and needs them (released on DEAD).
         if not rec.spec.is_actor_creation:
@@ -2191,6 +2226,8 @@ class Scheduler:
         meta.segment = dst
         meta.arena_offset = None
         meta.spilled = True
+        self.telemetry.spill_ops += 1
+        self.telemetry.spilled_bytes += meta.size
         return True
 
     def _alias_error_meta(self, oid: ObjectID, err: ObjectMeta) -> ObjectMeta:
@@ -2236,8 +2273,9 @@ class Scheduler:
             for oid in rec.return_ids:
                 self._seal_object(err_meta(oid))
         rec.state = "FAILED"
+        self.telemetry.failed += 1
         self._release_task_pins(rec)
-        self._record_event(rec.spec, "FAILED")
+        self._record_event(rec.spec, "FAILED", rec=rec)
         if rec.spec.returns_mode is not None:
             self._finalize_stream(rec)
 
@@ -2568,7 +2606,36 @@ class Scheduler:
         return False
 
     def _cmd_task_events(self, _):
-        return list(self.gcs.task_events)
+        return self.gcs.task_event_list()
+
+    def _cmd_task_latency(self, _):
+        """p50/p95 queue-wait + exec rollups computed over the event ring IN
+        the scheduler process: summarize()/the dashboard poll this, and
+        shipping up to ring-cap TaskEvents per poll just to reduce them to
+        two percentile dicts would stall the loop on serialization."""
+        queue_waits: List[float] = []
+        exec_times: List[float] = []
+        for (_tid, _name, st, _ts, stages) in self.gcs.task_events:
+            if st not in ("FINISHED", "FAILED") or not stages:
+                continue
+            q0, q1 = stages.get("queued"), stages.get("lease_granted")
+            if q0 is not None and q1 is not None:
+                queue_waits.append(max(0.0, q1 - q0))
+            e0, e1 = stages.get("exec_start"), stages.get("exec_end")
+            if e0 is not None and e1 is not None:
+                exec_times.append(max(0.0, e1 - e0))
+        out = {}
+        for key, vals in (("queue_wait_s", queue_waits), ("exec_s", exec_times)):
+            if vals:
+                vals.sort()
+                n = len(vals)
+                out[key] = {
+                    "p50": vals[n // 2],
+                    "p95": vals[min(n - 1, int(n * 0.95))],
+                    "max": vals[-1],
+                    "samples": n,
+                }
+        return out
 
     @staticmethod
     def _task_summary(rec: TaskRecord) -> dict:
@@ -2580,10 +2647,18 @@ class Scheduler:
             "node_id": rec.node.hex() if rec.node else None,
             "retries_left": rec.retries_left,
             "submitted_at": rec.submitted_at,
+            "stages": {
+                "submit": getattr(rec.spec, "submitted_ts", rec.submitted_at),
+                **rec.stage_ts,
+            },
         }
 
     def _cmd_list_tasks(self, payload):
-        limit = int(payload or 1000)
+        # None = default; 0 is a real limit (the dashboard accepts ?limit=0)
+        # and must return nothing, not fall back to 1000.
+        limit = 1000 if payload is None else int(payload)
+        if limit <= 0:
+            return []
         # Live records keep dict insertion (submission) order; only the tail
         # slices materialize. GC'd history (older by construction) fills any
         # remaining budget in front.
@@ -2630,7 +2705,9 @@ class Scheduler:
         }
 
     def _cmd_list_objects(self, payload):
-        limit = int(payload or 1000)
+        limit = 1000 if payload is None else int(payload)
+        if limit <= 0:
+            return []
         out = []
         for key, meta in list(self.object_table.items())[-limit:]:
             out.append(
@@ -2757,8 +2834,8 @@ class Scheduler:
     _DRIVER_CMDS = frozenset(
         {
             "free", "register_function", "remove_pg", "cancel", "task_events",
-            "list_actors", "list_tasks", "list_objects", "get_nodes",
-            "add_node", "remove_node", "autoscaler_state",
+            "task_latency", "list_actors", "list_tasks", "list_objects",
+            "get_nodes", "add_node", "remove_node", "autoscaler_state",
         }
     )
 
@@ -3080,6 +3157,8 @@ class Scheduler:
         self.tasks[rec.spec.task_id] = rec
         if rec.func_blob is not None:
             self.gcs.function_table.setdefault(rec.spec.func.function_id, rec.func_blob)
+        rec.stage_ts["queued"] = time.time()
+        self.telemetry.submitted += 1
         self._record_event(rec.spec, "SUBMITTED")
         if rec.spec.actor_id is not None and not rec.spec.is_actor_creation:
             # Actor call path (should come through _submit_actor_task).
@@ -3134,6 +3213,8 @@ class Scheduler:
             for d in rec.dep_ids:
                 self.lineage_consumers[d] = self.lineage_consumers.get(d, 0) + 1
         self.tasks[spec.task_id] = rec
+        rec.stage_ts["queued"] = time.time()
+        self.telemetry.submitted += 1
         self._record_event(spec, "SUBMITTED")
         ar = self.actors.get(spec.actor_id)
         if ar is None or ar.state == "DEAD":
@@ -3167,6 +3248,7 @@ class Scheduler:
             rec.state = "RUNNING"
             rec.worker = wh.worker_id
             rec.node = wh.node_id
+            self._note_dispatch(rec, time.time())
         ar.inflight[req.spec.task_id] = None
         self._record_event(req.spec, "RUNNING")
         # Coalesced: an async actor-call burst dispatches as one frame per
@@ -3611,9 +3693,21 @@ class Scheduler:
         wh.lease_key = _PendingQueue.key_of(rec)
         wh.inflight_tasks = [rec.spec.task_id]
         self._leases.setdefault(wh.lease_key, []).append(wh)
+        self._note_dispatch(rec, rec.running_since)
         self._record_event(rec.spec, "RUNNING")
         self._send_exec(wh, rec, metas, kw)
         return True
+
+    def _note_dispatch(self, rec: TaskRecord, now: float) -> None:
+        """Stamp the lease_granted stage + dispatch telemetry (plain ints —
+        materialized at loop-tick cadence)."""
+        rec.stage_ts["lease_granted"] = now
+        tel = self.telemetry
+        tel.dispatched += 1
+        if tel.enabled:
+            queued = rec.stage_ts.get("queued")
+            if queued is not None:
+                tel.dispatch_waits.append(now - queued)
 
     def _send_exec(self, wh: WorkerHandle, rec: TaskRecord, metas, kw) -> None:
         req = ExecRequest(
@@ -3676,6 +3770,7 @@ class Scheduler:
             node = self.nodes.get(wh.node_id)
             if node is not None:
                 node.last_active = time.time()
+            self._note_dispatch(rec, rec.running_since)
             self._record_event(spec, "RUNNING")
             self._send_exec(wh, rec, metas, kw)
             return True
@@ -3714,6 +3809,7 @@ class Scheduler:
         rec.worker = wh.worker_id
         rec.node = node.node_id
         ar.inflight[rec.spec.task_id] = None
+        self._note_dispatch(rec, time.time())
         self._record_event(rec.spec, "RUNNING")
         req = ExecRequest(
             spec=rec.spec,
@@ -3784,16 +3880,22 @@ class Scheduler:
         ar.acquired = {}
 
     # ------------------------------------------------------------------ misc
-    def _record_event(self, spec: TaskSpec, state: str):
+    def _record_event(self, spec: TaskSpec, state: str,
+                      rec: Optional[TaskRecord] = None):
         if not self.config.enable_timeline:
             return
-        self.gcs.record_task_event(
-            TaskEvent(
-                task_id=spec.task_id.hex(),
-                name=spec.name or spec.func.name,
-                state=state,
-                timestamp=time.time(),
-            )
+        stages = None
+        if rec is not None and rec.stage_ts:
+            # Terminal events carry the full per-stage pipeline: the "submit"
+            # stamp from the caller-side spec plus scheduler- and
+            # worker-side stages accumulated on the record.
+            stages = {"submit": getattr(spec, "submitted_ts", rec.submitted_at),
+                      **rec.stage_ts}
+        # Tuple form, not TaskEvent: this runs up to 3x per task on the loop
+        # thread (gcs.record_event_tuple documents the shape).
+        self.gcs.record_event_tuple(
+            (spec.task_id.hex(), spec.name or spec.func.name, state,
+             time.time(), stages)
         )
 
 

@@ -1,0 +1,292 @@
+"""Runtime-internal telemetry: the glue between hot paths and util/metrics.
+
+Reference: the C++ OpenCensus stats pipeline (`src/ray/stats/metric_defs.cc`
+defines the scheduler/object-store/task counters the dashboard charts). Here
+the same role is filled by the existing `util/metrics.py` registry, with one
+hard rule: **hot paths never touch Metric objects**. They bump plain ints and
+append to plain lists; materialization into Counters/Gauges/Histograms
+happens at snapshot cadence — once per scheduler-loop tick (SchedulerTelemetry)
+or once per registry flush (the register_collector hooks used by the batching
+layer and the object-store read path).
+
+Every metric name exported by the runtime is listed in COMPONENTS.md
+(Observability section); keep the two in sync.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def metrics_enabled() -> bool:
+    from ray_tpu._private.config import get_config
+
+    return bool(get_config().enable_metrics)
+
+
+# Bucket boundaries for control-plane latency histograms: sub-ms to tens of
+# seconds (queue waits under load can be long).
+_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class SchedulerTelemetry:
+    """Scheduler-side counters + gauges.
+
+    The event loop calls `on_iteration(scheduler, now)` every pass; raw
+    increments come from the dispatch/completion/spill paths as plain
+    attribute bumps. Metric objects are created lazily on the first tick so
+    a metrics-off runtime never registers them (and never starts the
+    registry flusher thread)."""
+
+    def __init__(self, config):
+        self.enabled = bool(config.enable_metrics)
+        self._interval = float(config.internal_metrics_interval_s)
+        self._last_tick = 0.0
+        self._metrics = None
+        # Hot-path accumulators (plain ints/lists; loop-thread only).
+        self.submitted = 0
+        self.dispatched = 0
+        self.finished = 0
+        self.failed = 0
+        self.retried = 0
+        self.loop_iterations = 0
+        self.spill_ops = 0
+        self.spilled_bytes = 0
+        self.dispatch_waits: List[float] = []
+        self.exec_times: List[float] = []
+        # Scheduler-side outbound coalescing (_send_to/_flush_outbound).
+        self.out_msgs = 0
+        self.out_frames = 0
+
+    # ---------------------------------------------------------------- ticks
+    def on_iteration(self, sched, now: float) -> None:
+        self.loop_iterations += 1
+        if not self.enabled or now - self._last_tick < self._interval:
+            return
+        self._last_tick = now
+        m = self._metrics
+        if m is None:
+            m = self._metrics = self._create_metrics()
+        m["pending"].set(len(sched.pending))
+        leased = [wh for lst in sched._leases.values() for wh in lst]
+        m["lease_workers"].set(len(leased))
+        m["lease_occupancy"].set(sum(len(wh.inflight_tasks) for wh in leased))
+        m["objects"].set(len(sched.object_table))
+        m["object_bytes"].set(float(sum(sched.node_usage.values())))
+        m["tasks"].set(len(sched.tasks))
+        self._drain_counter(m["submitted"], "submitted")
+        self._drain_counter(m["dispatched"], "dispatched")
+        self._drain_counter(m["retried"], "retried")
+        self._drain_counter(m["loop_iters"], "loop_iterations")
+        self._drain_counter(m["spill_ops"], "spill_ops")
+        self._drain_counter(m["spilled_bytes"], "spilled_bytes")
+        self._drain_counter(m["out_msgs"], "out_msgs")
+        self._drain_counter(m["out_frames"], "out_frames")
+        if self.finished:
+            m["terminal"].inc(self.finished, {"state": "FINISHED"})
+            self.finished = 0
+        if self.failed:
+            m["terminal"].inc(self.failed, {"state": "FAILED"})
+            self.failed = 0
+        if self.dispatch_waits:
+            waits, self.dispatch_waits = self.dispatch_waits, []
+            for w in waits:
+                m["dispatch_wait"].observe(w)
+        if self.exec_times:
+            execs, self.exec_times = self.exec_times, []
+            for e in execs:
+                m["exec_time"].observe(e)
+
+    def _drain_counter(self, metric, attr: str) -> None:
+        v = getattr(self, attr)
+        if v:
+            metric.inc(v)
+            setattr(self, attr, 0)
+
+    def _create_metrics(self) -> Dict[str, object]:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        return {
+            "pending": Gauge("ray_tpu_scheduler_pending_tasks",
+                             "tasks queued in the scheduler (all dispatch classes)"),
+            "lease_workers": Gauge("ray_tpu_scheduler_leased_workers",
+                                   "workers currently holding a dispatch-class lease"),
+            "lease_occupancy": Gauge("ray_tpu_scheduler_lease_occupancy",
+                                     "in-flight tasks across leased workers (pipeline fill)"),
+            "tasks": Gauge("ray_tpu_scheduler_task_records",
+                           "live task records in the scheduler table"),
+            "objects": Gauge("ray_tpu_object_store_objects",
+                             "objects registered in the cluster object table"),
+            "object_bytes": Gauge("ray_tpu_object_store_bytes",
+                                  "bytes of sealed shared-memory segments across nodes"),
+            "submitted": Counter("ray_tpu_scheduler_tasks_submitted_total",
+                                 "task submissions registered"),
+            "dispatched": Counter("ray_tpu_scheduler_tasks_dispatched_total",
+                                  "tasks dispatched to workers"),
+            "retried": Counter("ray_tpu_scheduler_tasks_retried_total",
+                               "task retries after worker death/OOM"),
+            "terminal": Counter("ray_tpu_scheduler_tasks_terminal_total",
+                                "tasks reaching a terminal state", ("state",)),
+            "loop_iters": Counter("ray_tpu_scheduler_loop_iterations_total",
+                                  "scheduler event-loop iterations"),
+            "spill_ops": Counter("ray_tpu_object_store_spill_ops_total",
+                                 "objects relocated to the disk spill dir"),
+            "spilled_bytes": Counter("ray_tpu_object_store_spilled_bytes_total",
+                                     "bytes relocated to the disk spill dir"),
+            "out_msgs": Counter("ray_tpu_scheduler_outbound_msgs_total",
+                                "control messages coalesced by the scheduler loop"),
+            "out_frames": Counter("ray_tpu_scheduler_outbound_frames_total",
+                                  "frames the scheduler loop actually wrote"),
+            "dispatch_wait": Histogram(
+                "ray_tpu_scheduler_dispatch_wait_s",
+                "queued -> lease_granted wait per task",
+                boundaries=_LATENCY_BUCKETS),
+            "exec_time": Histogram(
+                "ray_tpu_task_exec_time_s",
+                "exec_start -> exec_end wall time per task (worker-reported)",
+                boundaries=_LATENCY_BUCKETS),
+        }
+
+
+# ------------------------------------------------------------------ batching
+_batching_installed = False
+
+
+def ensure_batching_metrics() -> None:
+    """Install the collector that publishes batching-layer stats. Called
+    lazily from the first BatchedSender in a metrics-enabled process."""
+    global _batching_installed
+    if _batching_installed:
+        return
+    _batching_installed = True
+    from ray_tpu._private import batching
+    from ray_tpu.util.metrics import Counter, Histogram, register_collector
+
+    # Single source of truth: the histogram's boundaries ARE the counting
+    # buckets the send path increments (positional zip in collect()).
+    BATCH_FLUSH_BOUNDS = batching._FLUSH_SIZE_BOUNDS
+
+    msgs = Counter("ray_tpu_batch_messages_total",
+                   "control messages that went through BatchedSenders")
+    frames = Counter("ray_tpu_batch_frames_total",
+                     "wire frames written by BatchedSenders (coalesce ratio = messages/frames)")
+    bytes_total = Counter("ray_tpu_batch_bytes_total",
+                          "approximate payload bytes through BatchedSenders")
+    stragglers = Counter("ray_tpu_batch_straggler_flushes_total",
+                         "flushes delivered by the straggler backstop timer")
+    flush_size = Histogram("ray_tpu_batch_flush_size",
+                           "messages per BatchedSender flush",
+                           boundaries=BATCH_FLUSH_BOUNDS)
+    last = {"msgs": 0, "frames": 0, "bytes": 0, "straggler_fires": 0,
+            "sizes": [0] * (len(BATCH_FLUSH_BOUNDS))}
+
+    def collect():
+        # Snapshot ONCE, then diff and advance the cursor from the same
+        # snapshot: re-reading the live dict when updating `last` would skip
+        # any bumps that landed in between, losing them forever.
+        s = dict(batching._STATS)
+        sizes = list(batching._FLUSH_SIZE_COUNTS)
+        d_msgs = s["msgs"] - last["msgs"]
+        d_frames = s["frames"] - last["frames"]
+        d_bytes = s["bytes"] - last["bytes"]
+        d_strag = s["straggler_fires"] - last["straggler_fires"]
+        if d_msgs:
+            msgs.inc(d_msgs)
+        if d_frames:
+            frames.inc(d_frames)
+        if d_bytes:
+            bytes_total.inc(d_bytes)
+        if d_strag:
+            stragglers.inc(d_strag)
+        deltas = [sizes[i] - last["sizes"][i] for i in range(len(last["sizes"]))]
+        if d_frames or any(deltas):
+            flush_size._merge_counts(deltas, d_frames, float(d_msgs))
+        last.update(msgs=s["msgs"], frames=s["frames"], bytes=s["bytes"],
+                    straggler_fires=s["straggler_fires"], sizes=sizes)
+
+    register_collector(collect)
+
+
+# --------------------------------------------------------------- object store
+_objectstore_installed = False
+
+
+def ensure_objectstore_client_metrics() -> None:
+    """Publish the reader-side hit/pull counters accumulated in
+    object_store.resolve_for_read (per process)."""
+    global _objectstore_installed
+    if _objectstore_installed:
+        return
+    _objectstore_installed = True
+    from ray_tpu._private import object_store
+    from ray_tpu.util.metrics import Counter, register_collector
+
+    reads = Counter("ray_tpu_object_store_reads_total",
+                    "segment reads by locality outcome", ("outcome",))
+    pull_bytes = Counter("ray_tpu_object_store_pull_bytes_total",
+                         "bytes transferred by cross-node object pulls")
+    last = {"local_hits": 0, "cache_hits": 0, "pulls": 0, "pull_bytes": 0}
+
+    def collect():
+        # Snapshot once; diff and advance the cursor from the snapshot (see
+        # the batching collector for why).
+        s = dict(object_store._READ_STATS)
+        for key, tag in (("local_hits", "local"), ("cache_hits", "cached"),
+                         ("pulls", "pulled")):
+            d = s[key] - last[key]
+            if d:
+                reads.inc(d, {"outcome": tag})
+        d = s["pull_bytes"] - last["pull_bytes"]
+        if d:
+            pull_bytes.inc(d)
+        last.update({k: s[k] for k in last})
+
+    register_collector(collect)
+
+
+# ---------------------------------------------------------------- collectives
+_collective_hist = None
+
+
+def collective_histogram():
+    """Lazy per-op wall-time histogram (tags: op, group)."""
+    global _collective_hist
+    if _collective_hist is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _collective_hist = Histogram(
+            "ray_tpu_collective_op_seconds",
+            "collective op wall time", boundaries=_LATENCY_BUCKETS,
+            tag_keys=("op", "group"),
+        )
+    return _collective_hist
+
+
+# --------------------------------------------------------------- serve router
+_router_metrics: Optional[dict] = None
+
+
+def router_metrics() -> dict:
+    """Lazy Serve-router metric set (tags: deployment)."""
+    global _router_metrics
+    if _router_metrics is None:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _router_metrics = {
+            "requests": Counter("ray_tpu_serve_router_requests_total",
+                                "requests routed to replicas", ("deployment",)),
+            "route_wait": Histogram("ray_tpu_serve_router_route_wait_s",
+                                    "time spent picking a replica and submitting",
+                                    boundaries=_LATENCY_BUCKETS,
+                                    tag_keys=("deployment",)),
+            "saturation": Gauge("ray_tpu_serve_replica_saturation",
+                                "in-flight requests / total replica concurrency capacity",
+                                ("deployment",)),
+            "inflight": Gauge("ray_tpu_serve_router_inflight",
+                              "requests in flight through this router",
+                              ("deployment",)),
+        }
+    return _router_metrics

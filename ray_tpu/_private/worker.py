@@ -469,6 +469,9 @@ class DriverContext:
     def task_events(self):
         return self.scheduler.call("task_events", None).result()
 
+    def task_latency(self):
+        return self.scheduler.call("task_latency", None).result()
+
     def list_actors(self):
         return self.scheduler.call("list_actors", None).result()
 
@@ -658,6 +661,9 @@ class RemoteDriverContext:
     def task_events(self):
         return self.wc.request("driver_cmd", ("task_events", None))
 
+    def task_latency(self):
+        return self.wc.request("driver_cmd", ("task_latency", None))
+
     def list_actors(self):
         return self.wc.request("driver_cmd", ("list_actors", None))
 
@@ -799,6 +805,9 @@ class WorkerProcContext:
 
     def task_events(self):
         return self.rt.wc.request("driver_cmd", ("task_events", None))
+
+    def task_latency(self):
+        return self.rt.wc.request("driver_cmd", ("task_latency", None))
 
     def list_actors(self):
         return self.rt.wc.request("driver_cmd", ("list_actors", None))

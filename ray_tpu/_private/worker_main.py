@@ -16,6 +16,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
@@ -502,6 +503,11 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
             trace_context=spec.trace_context,
             attributes={"task_id": spec.task_id.hex()},
         )
+    # Worker-side lifecycle stages (args_fetched / exec_start / exec_end /
+    # result_stored): ride back on the done message — zero extra round trips.
+    # Stamped for enable_metrics too: the scheduler's exec-time histogram is
+    # fed from these stamps even when the timeline/event store is off.
+    stages = {} if (cfg.enable_timeline or cfg.enable_metrics) else None
     try:
         if rt.setup_error is not None:
             raise exceptions.RuntimeEnvSetupError(
@@ -509,6 +515,10 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
             )
         args = [rt.fetch_value(m) for m in req.arg_metas]
         kwargs = {k: rt.fetch_value(m) for k, m in req.kwarg_metas.items()}
+        if stages is not None:
+            # exec_start follows immediately: first-call function deserialize
+            # is accounted to exec, keeping the stamp count per task at four.
+            stages["args_fetched"] = stages["exec_start"] = time.time()
         # Resolve any ObjectRefs that arrived as *resolved values already* — the
         # driver substitutes top-level refs with their value metas, so nothing to
         # do here; nested refs were rebuilt by the unpickler as live ObjectRefs.
@@ -565,16 +575,22 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
                     f"Task {spec.name} declared num_returns={n} but returned "
                     f"{len(values)} values"
                 )
+        if stages is not None:
+            stages["exec_end"] = time.time()
         metas = []
         for oid, value in zip(req.return_ids, values):
             sv = serialization.serialize(value)
             meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
             metas.append(meta)
+        if stages is not None:
+            stages["result_stored"] = time.time()
         # Flush refcount ops BEFORE "done": pipe FIFO guarantees any borrower
         # registration this task made reaches the scheduler before its
         # dependency pins are released.
         worker_mod.flush_ref_ops()
-        rt.wc.send_done((spec.task_id.binary(), True, metas), batch=batch_done)
+        done = (spec.task_id.binary(), True, metas)
+        rt.wc.send_done(done if stages is None else done + (stages,),
+                        batch=batch_done)
     except Exception as e:  # noqa: BLE001 — every task error must be captured
         if exec_span is not None:
             from ray_tpu.util import tracing
@@ -612,7 +628,12 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
                 meta.is_error = True
                 metas.append(meta)
         worker_mod.flush_ref_ops()
-        rt.wc.send_done((spec.task_id.binary(), False, metas), batch=batch_done)
+        if stages is not None:
+            stages.setdefault("exec_end", time.time())
+            stages["result_stored"] = time.time()
+        done = (spec.task_id.binary(), False, metas)
+        rt.wc.send_done(done if stages is None else done + (stages,),
+                        batch=batch_done)
     finally:
         if exec_span is not None:
             from ray_tpu.util import tracing

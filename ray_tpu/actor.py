@@ -240,29 +240,49 @@ class ActorClass:
             runtime_env={k: v for k, v in renv.items() if k != "env_vars"} or None,
         )
         _apply_strategy(spec, opts.get("scheduling_strategy"))
-        entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
-        req = ExecRequest(
-            spec=spec, arg_metas=[], kwarg_metas={}, func_blob=self._blob, return_ids=[]
-        )
-        req._saved_arg_entries = entries
-        req._saved_kwarg_entries = kwentries
-        max_restarts = int(opts.get("max_restarts", 0))
-        if max_restarts < 0:  # -1 = infinite, like the reference
-            max_restarts = 1 << 30
-        ar = ActorRecord(
-            actor_id=actor_id,
-            creation_req=req,
-            resources=resources,
-            max_restarts=max_restarts,
-            detached=(lifetime == "detached"),
-        )
-        info = ActorInfo(
-            actor_id=actor_id,
-            name=name,
-            class_name=self._cls.__name__,
-            max_restarts=max_restarts,
-        )
-        global_worker.context.create_actor((ar, info, name))
+        from ray_tpu.util import tracing
+
+        submit_span = None
+        if tracing.is_enabled():
+            # Creation submit span: the worker-side creation execute span
+            # (worker_main._execute) parents onto it via spec.trace_context,
+            # same as task and method-call submissions.
+            submit_span = tracing.start_span(
+                f"actor_create::{self._cls.__name__}", "submit",
+                attributes={"actor_id": actor_id.hex(), "task_id": task_id.hex()},
+            )
+            spec.trace_context = {
+                "trace_id": submit_span["trace_id"],
+                "parent_id": submit_span["span_id"],
+            }
+            spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
+        try:
+            entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
+            req = ExecRequest(
+                spec=spec, arg_metas=[], kwarg_metas={}, func_blob=self._blob, return_ids=[]
+            )
+            req._saved_arg_entries = entries
+            req._saved_kwarg_entries = kwentries
+            max_restarts = int(opts.get("max_restarts", 0))
+            if max_restarts < 0:  # -1 = infinite, like the reference
+                max_restarts = 1 << 30
+            ar = ActorRecord(
+                actor_id=actor_id,
+                creation_req=req,
+                resources=resources,
+                max_restarts=max_restarts,
+                detached=(lifetime == "detached"),
+            )
+            info = ActorInfo(
+                actor_id=actor_id,
+                name=name,
+                class_name=self._cls.__name__,
+                max_restarts=max_restarts,
+            )
+            global_worker.context.create_actor((ar, info, name))
+        finally:
+            if submit_span is not None:
+                tracing.end_span(submit_span)
         method_meta = {
             n: getattr(m, "__ray_tpu_num_returns__")
             for n, m in vars(self._cls).items()

@@ -18,7 +18,7 @@ class DashboardServer:
         self._loop = None
 
     # ------------------------------------------------------------- handlers
-    def _payload(self, kind: str):
+    def _payload(self, kind: str, limit: Optional[int] = None):
         from ray_tpu.util import state as state_api
 
         if kind == "cluster":
@@ -28,9 +28,13 @@ class DashboardServer:
         if kind == "actors":
             return state_api.list_actors()
         if kind == "tasks":
-            return state_api.list_tasks()
+            return state_api.list_tasks(limit if limit is not None else 1000)
         if kind == "objects":
-            return state_api.list_objects()
+            return state_api.list_objects(limit if limit is not None else 1000)
+        if kind == "timeline":
+            # Unified chrome trace (task stages + spans + collectives):
+            # save the JSON and load it at chrome://tracing / Perfetto.
+            return state_api.timeline()
         if kind == "jobs":
             from ray_tpu.job_submission import JobSubmissionClient
 
@@ -41,9 +45,18 @@ class DashboardServer:
         from aiohttp import web
 
         kind = request.match_info["kind"]
+        limit = None
+        raw_limit = request.query.get("limit")
+        if raw_limit is not None:
+            try:
+                limit = max(0, int(raw_limit))
+            except ValueError:
+                return web.json_response(
+                    {"error": f"invalid limit {raw_limit!r}"}, status=400
+                )
         loop = asyncio.get_event_loop()
         try:
-            payload = await loop.run_in_executor(None, self._payload, kind)
+            payload = await loop.run_in_executor(None, self._payload, kind, limit)
         except KeyError:
             return web.json_response({"error": f"unknown endpoint {kind}"}, status=404)
         return web.json_response(json.loads(json.dumps(payload, default=str)))

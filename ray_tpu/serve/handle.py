@@ -31,6 +31,14 @@ import weakref
 
 _all_routers: "weakref.WeakSet" = weakref.WeakSet()
 
+def _metrics():
+    """Router metric set, or None when enable_metrics is off. The knob is
+    re-read per call (an init/shutdown cycle may flip it); the metric
+    objects themselves are cached inside telemetry.router_metrics()."""
+    from ray_tpu._private import telemetry
+
+    return telemetry.router_metrics() if telemetry.metrics_enabled() else None
+
 
 def close_all_routers() -> None:
     for r in list(_all_routers):
@@ -155,6 +163,19 @@ class Router:
         total = sum(len(v) for v in self._inflight.values()) + sum(
             self._inflight_streams.values()
         )
+        m = _metrics()
+        if m is not None:
+            # Replica saturation: this router's in-flight load over the
+            # replica set's total concurrency capacity. Reported at load-
+            # report cadence, not per request.
+            capacity = sum(
+                max(1, getattr(r, "max_concurrent_queries", 1))
+                for r in self._replicas
+            )
+            tags = {"deployment": self._name}
+            m["inflight"].set(total, tags)
+            if capacity:
+                m["saturation"].set(total / capacity, tags)
         try:
             self._controller.report_load.remote(self._name, self._router_id, total)
         except Exception:
@@ -179,6 +200,7 @@ class Router:
 
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG
 
+        t_route = time.perf_counter()
         model_id = ""
         if kwargs and MODEL_ID_KWARG in kwargs:
             # raw_method calls go straight to the named replica method (ASGI
@@ -258,6 +280,13 @@ class Router:
                 ref = handle.handle_request.remote(method_name, tuple(args), kwargs)
                 self._inflight.setdefault(chosen.replica_id, []).append(ref)
             self._report_load()
+        m = _metrics()
+        if m is not None:
+            tags = {"deployment": self._name}
+            m["requests"].inc(1, tags)
+            # Route wait: table fetch + lock + replica pick + submit — the
+            # router-side queueing a request pays before reaching a replica.
+            m["route_wait"].observe(time.perf_counter() - t_route, tags)
         return ref, chosen.replica_id
 
     def report_failure(self, replica_id: str):

@@ -13,6 +13,7 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 from ray_tpu.util.collective.types import Backend, ReduceOp
@@ -20,6 +21,42 @@ from ray_tpu.util.collective.types import Backend, ReduceOp
 _groups: Dict[str, object] = {}
 _lock = threading.Lock()
 _RESERVED = object()
+
+
+def _timed(op: str, group_name: str, fn):
+    """Record a collective op's wall time: a ray_tpu_collective_op_seconds
+    histogram sample (enable_metrics) and a "collective" span for the unified
+    timeline (enable_timeline or explicit tracing). Both off -> plain call."""
+    from ray_tpu._private.config import get_config
+
+    cfg = get_config()
+    from ray_tpu.util import tracing
+
+    want_span = cfg.enable_timeline or tracing.is_enabled()
+    want_metric = cfg.enable_metrics
+    if not want_span and not want_metric:
+        return fn()
+    span = None
+    if want_span:
+        span = tracing.start_span(
+            f"collective::{op}", "collective", attributes={"group": group_name}
+        )
+    t0 = time.perf_counter()
+    try:
+        out = fn()
+    except BaseException:
+        if span is not None:
+            tracing.end_span(span, "ERROR")
+        raise
+    if want_metric:
+        from ray_tpu._private.telemetry import collective_histogram
+
+        collective_histogram().observe(
+            time.perf_counter() - t0, {"op": op, "group": group_name}
+        )
+    if span is not None:
+        tracing.end_span(span)
+    return out
 
 
 def _kv(op: str, *args):
@@ -101,51 +138,62 @@ def get_collective_group_size(group_name: str = "default") -> int:
 
 
 def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
-    return get_group(group_name).allreduce(tensor, op)
+    return _timed("allreduce", group_name,
+                  lambda: get_group(group_name).allreduce(tensor, op))
 
 
 def barrier(group_name: str = "default") -> None:
-    get_group(group_name).barrier()
+    _timed("barrier", group_name, lambda: get_group(group_name).barrier())
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
-    return get_group(group_name).reduce(tensor, root_rank=dst_rank, op=op)
+    return _timed("reduce", group_name,
+                  lambda: get_group(group_name).reduce(tensor, root_rank=dst_rank, op=op))
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return get_group(group_name).broadcast(tensor, root_rank=src_rank)
+    return _timed("broadcast", group_name,
+                  lambda: get_group(group_name).broadcast(tensor, root_rank=src_rank))
 
 
 def allgather(tensor, group_name: str = "default"):
-    return get_group(group_name).allgather(tensor)
+    return _timed("allgather", group_name,
+                  lambda: get_group(group_name).allgather(tensor))
 
 
 def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
-    return get_group(group_name).reducescatter(tensor, op)
+    return _timed("reducescatter", group_name,
+                  lambda: get_group(group_name).reducescatter(tensor, op))
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
-    return get_group(group_name).send(tensor, dst_rank)
+    return _timed("send", group_name,
+                  lambda: get_group(group_name).send(tensor, dst_rank))
 
 
 def recv(shape, dtype, src_rank: int, group_name: str = "default"):
-    return get_group(group_name).recv(shape, dtype, src_rank)
+    return _timed("recv", group_name,
+                  lambda: get_group(group_name).recv(shape, dtype, src_rank))
 
 
 def sendrecv(tensor, perm, group_name: str = "default"):
     """SPMD permute: all ranks call; rank i receives from j for (j, i) in perm
     (XLA backend only; lowered to lax.ppermute over ICI)."""
-    return get_group(group_name).sendrecv(tensor, perm)
+    return _timed("sendrecv", group_name,
+                  lambda: get_group(group_name).sendrecv(tensor, perm))
 
 
 # Reference-parity aliases for the multi-accelerator-per-process variants.
 def allreduce_multidevice(tensors, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
-    return get_group(group_name).allreduce_multidevice(tensors, op)
+    return _timed("allreduce_multidevice", group_name,
+                  lambda: get_group(group_name).allreduce_multidevice(tensors, op))
 
 
 def allgather_multidevice(tensors, group_name: str = "default"):
-    return get_group(group_name).allgather_multidevice(tensors)
+    return _timed("allgather_multidevice", group_name,
+                  lambda: get_group(group_name).allgather_multidevice(tensors))
 
 
 def reducescatter_multidevice(tensors, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
-    return get_group(group_name).reducescatter_multidevice(tensors, op)
+    return _timed("reducescatter_multidevice", group_name,
+                  lambda: get_group(group_name).reducescatter_multidevice(tensors, op))

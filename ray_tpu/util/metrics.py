@@ -14,7 +14,7 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
 
@@ -23,6 +23,15 @@ class _Registry:
     def __init__(self):
         self.lock = threading.Lock()
         self.metrics: Dict[str, "Metric"] = {}
+        # Called right before each snapshot: the off-hot-path seam for
+        # runtime internals (batching stats, object-store counters) that
+        # accumulate plain ints and only materialize into Metric objects
+        # here, at flush cadence instead of per message.
+        self.collectors: List[Callable[[], None]] = []
+        # Collectors are delta-based (they keep a "last seen" cursor): two
+        # concurrent snapshots (the 1 Hz flusher + a /metrics scrape) must
+        # not run the same collector at once or the delta double-counts.
+        self._collector_lock = threading.Lock()
         self._flusher_started = False
 
     def register(self, metric: "Metric") -> None:
@@ -34,6 +43,12 @@ class _Registry:
         self._ensure_flusher()
 
     def snapshot(self) -> List[dict]:
+        with self._collector_lock:
+            for collect in list(self.collectors):
+                try:
+                    collect()
+                except Exception:
+                    pass  # a broken collector must never break the exposition
         with self.lock:
             return [m._snapshot() for m in self.metrics.values()]
 
@@ -52,6 +67,13 @@ class _Registry:
 
 
 _registry = _Registry()
+
+
+def register_collector(fn: Callable[[], None]) -> None:
+    """Register a pre-snapshot hook that moves accumulated raw counts into
+    Metric objects. Runs at flush cadence (~1 Hz) and on every explicit
+    flush_metrics()/prometheus_text()-triggered snapshot."""
+    _registry.collectors.append(fn)
 
 
 def flush_metrics() -> None:
@@ -87,7 +109,9 @@ def collect_all() -> List[dict]:
 def prometheus_text() -> str:
     """Render merged snapshots as Prometheus exposition text: counters and
     histograms sum across processes; gauges export per-process with a pid tag
-    (summing gauges would be wrong)."""
+    (summing gauges would be wrong). Flushes this process's registry first so
+    a scrape right after an update never reads a stale snapshot."""
+    flush_metrics()
     merged: Dict[Tuple[str, str], dict] = {}
     lines: List[str] = []
     for m in collect_all():
@@ -130,13 +154,18 @@ def prometheus_text() -> str:
         if m["type"] in ("gauge", "counter"):
             lines.append(f"{name}{tagstr} {m['value']}")
         else:
+            # Histogram series keep their tags: the le label joins the
+            # series tags (dropping them would emit duplicate untagged
+            # sample lines once a histogram has two tag sets — an invalid
+            # exposition Prometheus rejects wholesale).
+            inner = tagstr[1:-1] + "," if tagstr else ""
             acc = 0
             for b in sorted(m["buckets"], key=float):
                 acc += m["buckets"][b]
-                lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
-            lines.append(f'{name}_bucket{{le="+Inf"}} {m["count"]}')
-            lines.append(f"{name}_sum {m['sum']}")
-            lines.append(f"{name}_count {m['count']}")
+                lines.append(f'{name}_bucket{{{inner}le="{b}"}} {acc}')
+            lines.append(f'{name}_bucket{{{inner}le="+Inf"}} {m["count"]}')
+            lines.append(f"{name}_sum{tagstr} {m['sum']}")
+            lines.append(f"{name}_count{tagstr} {m['count']}")
     return "\n".join(lines) + "\n"
 
 
@@ -220,6 +249,22 @@ class Histogram(Metric):
                     break
             d["sum"] += value
             d["count"] += 1
+
+    def _merge_counts(self, bucket_counts: Sequence[int], count: int, total: float,
+                      tags: Optional[Dict[str, str]] = None) -> None:
+        """Bulk-add pre-bucketed observations (a collector's delta since its
+        last run). `bucket_counts` aligns with this histogram's boundaries;
+        overflow observations appear only in `count`/`total`, mirroring
+        observe()'s behavior for values above the last boundary."""
+        with self._lock:
+            k = self._key(tags)
+            d = self._data.setdefault(
+                k, {"bucket_counts": [0] * len(self.boundaries), "sum": 0.0, "count": 0}
+            )
+            for i, c in enumerate(bucket_counts[: len(self.boundaries)]):
+                d["bucket_counts"][i] += c
+            d["sum"] += total
+            d["count"] += count
 
     def _snapshot(self) -> dict:
         with self._lock:

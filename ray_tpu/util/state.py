@@ -4,6 +4,13 @@ Reference: `python/ray/experimental/state/api.py` (+ `state_cli.py`,
 `dashboard/state_aggregator.py:133 StateAPIManager`): `ray list
 tasks/actors/objects/nodes`, `ray timeline`. Same surface here, served from
 the scheduler's live tables over the driver connection.
+
+Task records carry a per-stage timestamp pipeline
+(submit -> queued -> lease_granted -> args_fetched -> exec_start ->
+exec_end -> result_stored); `list_tasks` surfaces per-stage durations,
+`summarize()` rolls them into p50/p95 queue-wait and exec latencies, and
+`timeline()` merges stage intervals with tracing spans (submit/execute/
+custom/collective) into one chrome trace on shared trace ids.
 """
 
 from __future__ import annotations
@@ -11,7 +18,13 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private.gcs import TASK_STAGES
 from ray_tpu._private.worker import _auto_init, global_worker
+
+# Interval names between consecutive stages (len(TASK_STAGES) - 1).
+STAGE_INTERVALS = (
+    "submit", "queue_wait", "args_fetch", "prepare", "exec", "store_results",
+)
 
 
 def list_nodes() -> List[Dict[str, Any]]:
@@ -24,9 +37,42 @@ def list_actors() -> List[Dict[str, Any]]:
     return global_worker.context.list_actors()
 
 
+def _monotonic_stages(stages: Dict[str, float]) -> Dict[str, float]:
+    """Stage stamps in canonical order, clamped non-decreasing. Stamps come
+    from three clocks (caller, scheduler, worker — one machine, but time()
+    is not cross-process monotonic); sub-ms skew must not produce negative
+    durations."""
+    out: Dict[str, float] = {}
+    last = None
+    for name in TASK_STAGES:
+        t = stages.get(name)
+        if t is None:
+            continue
+        if last is not None and t < last:
+            t = last
+        out[name] = last = t
+    return out
+
+
+def _stage_durations(stages: Dict[str, float]) -> Dict[str, float]:
+    """Seconds spent between consecutive present stages."""
+    mono = _monotonic_stages(stages)
+    out: Dict[str, float] = {}
+    for i in range(len(TASK_STAGES) - 1):
+        a, b = TASK_STAGES[i], TASK_STAGES[i + 1]
+        if a in mono and b in mono:
+            out[STAGE_INTERVALS[i]] = mono[b] - mono[a]
+    return out
+
+
 def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
     _auto_init()
-    return global_worker.context.list_tasks(limit)
+    out = global_worker.context.list_tasks(limit)
+    for t in out:
+        stages = t.get("stages") or {}
+        if stages:
+            t["stage_durations"] = _stage_durations(stages)
+    return out
 
 
 def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
@@ -35,51 +81,123 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
 
 
 def summarize() -> Dict[str, Any]:
-    """`ray status`-style rollup: resources + entity counts."""
+    """`ray status`-style rollup: resources + entity counts + task-latency
+    percentiles from the per-stage event pipeline. The percentile reduction
+    happens scheduler-side (`task_latency`) so a full event ring is never
+    shipped just to compute two rollups.
+
+    `task_events_max_num_task_in_gcs` is the rollup's listing budget too:
+    tasks_by_state/objects count at most that many entries per call, so
+    shrinking the event ring deliberately shrinks this summary's scan (the
+    knob is the cluster's observability-retention budget, not just the
+    ring size)."""
+    from ray_tpu._private.config import get_config
+
     _auto_init()
     ctx = global_worker.context
-    tasks = ctx.list_tasks(100000)
+    # The GCS task-event store is a ring of task_events_max_num_task_in_gcs;
+    # reading more than that is wasted work by construction.
+    cap = max(1, int(get_config().task_events_max_num_task_in_gcs))
+    tasks = ctx.list_tasks(cap)
     by_state: Dict[str, int] = {}
     for t in tasks:
         by_state[t["state"]] = by_state.get(t["state"], 0) + 1
+    latency: Dict[str, Any] = ctx.task_latency()
     return {
         "cluster_resources": ctx.cluster_resources(),
         "available_resources": ctx.available_resources(),
         "nodes": len(ctx.nodes()),
         "actors": len(ctx.list_actors()),
         "tasks_by_state": by_state,
-        "objects": len(ctx.list_objects(100000)),
+        "objects": len(ctx.list_objects(cap)),
+        "task_latency": latency,
     }
 
 
-def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Chrome-tracing events from the task-event log (reference:
-    `GlobalState.chrome_tracing_dump`, `_private/state.py:435` /
-    `ray timeline`). Returns the event list; writes JSON if `filename`."""
-    _auto_init()
-    events = global_worker.context.task_events()
-    # Pair RUNNING -> FINISHED/FAILED into chrome "X" (complete) events.
-    open_ts: Dict[str, float] = {}
+def _task_timeline_events(events) -> List[Dict[str, Any]]:
+    """Chrome events from the task-event log: stage-aware tasks emit one
+    umbrella "task" event (args carry all stage stamps) plus one
+    "task_stage" event per non-empty interval; tasks recorded without
+    stages (enable_timeline toggled mid-run, legacy events) fall back to
+    RUNNING -> terminal pairing."""
     trace: List[Dict[str, Any]] = []
+    open_ts: Dict[str, float] = {}
     for ev in events:
+        stages = _monotonic_stages(getattr(ev, "stages", None) or {})
+        if ev.state in ("FINISHED", "FAILED", "CANCELLED") and len(stages) >= 2:
+            ordered = [(s, stages[s]) for s in TASK_STAGES if s in stages]
+            first, last = ordered[0][1], ordered[-1][1]
+            tid = ev.task_id[:8]
+            if last > first:
+                trace.append(
+                    {
+                        "name": ev.name,
+                        "cat": "task",
+                        "ph": "X",
+                        "ts": int(first * 1e6),
+                        "dur": max(1, int((last - first) * 1e6)),
+                        "pid": "cluster",
+                        "tid": tid,
+                        "args": {
+                            "state": ev.state,
+                            "task_id": ev.task_id,
+                            "stages": stages,
+                        },
+                    }
+                )
+            for i in range(len(ordered) - 1):
+                (a, ta), (b, tb) = ordered[i], ordered[i + 1]
+                dur = int((tb - ta) * 1e6)
+                if dur <= 0:
+                    continue
+                idx = TASK_STAGES.index(a)
+                trace.append(
+                    {
+                        "name": f"{ev.name}:{STAGE_INTERVALS[idx]}",
+                        "cat": "task_stage",
+                        "ph": "X",
+                        "ts": int(ta * 1e6),
+                        "dur": dur,
+                        "pid": "cluster",
+                        "tid": tid,
+                        "args": {"task_id": ev.task_id, "from": a, "to": b},
+                    }
+                )
+            continue
         if ev.state == "RUNNING":
             open_ts[ev.task_id] = ev.timestamp
         elif ev.state in ("FINISHED", "FAILED", "CANCELLED"):
             start = open_ts.pop(ev.task_id, None)
-            if start is not None:
+            if start is not None and ev.timestamp > start:
                 trace.append(
                     {
                         "name": ev.name,
                         "cat": "task",
                         "ph": "X",
                         "ts": int(start * 1e6),
-                        "dur": int((ev.timestamp - start) * 1e6),
+                        "dur": max(1, int((ev.timestamp - start) * 1e6)),
                         "pid": "cluster",
                         "tid": ev.task_id[:8],
-                        "args": {"state": ev.state},
+                        "args": {"state": ev.state, "task_id": ev.task_id},
                     }
                 )
+    return trace
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Unified chrome trace (reference: `GlobalState.chrome_tracing_dump`,
+    `_private/state.py:435` / `ray timeline`): per-stage task lifecycle
+    intervals from the GCS task-event log MERGED with tracing spans —
+    submit/execute pairs on shared trace ids (so the caller->worker parent
+    link is visible), custom application spans, and collective-op intervals.
+    Returns the event list sorted by start time; writes JSON if `filename`."""
+    from ray_tpu.util import tracing
+
+    _auto_init()
+    events = _task_timeline_events(global_worker.context.task_events())
+    events.extend(tracing.chrome_trace())
+    events.sort(key=lambda e: (e["ts"], e.get("dur", 0)))
     if filename:
         with open(filename, "w") as f:
-            json.dump(trace, f)
-    return trace
+            json.dump(events, f)
+    return events

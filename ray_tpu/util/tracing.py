@@ -171,7 +171,12 @@ def collect_spans() -> List[dict]:
 
 
 def chrome_trace(filename: Optional[str] = None) -> List[dict]:
-    """Spans as chrome://tracing complete events (pid = process, tid = trace)."""
+    """Spans as chrome://tracing complete events (pid = process, tid = trace).
+
+    args carry the span/parent ids so a merged timeline
+    (`ray_tpu.timeline()`) preserves the caller->worker parent links; dur is
+    clamped to 1us so sub-microsecond submit spans stay visible (and valid)
+    in chrome://tracing."""
     events = []
     for s in collect_spans():
         if s.get("end") is None:
@@ -182,10 +187,16 @@ def chrome_trace(filename: Optional[str] = None) -> List[dict]:
                 "cat": s["kind"],
                 "ph": "X",
                 "ts": int(s["start"] * 1e6),
-                "dur": int((s["end"] - s["start"]) * 1e6),
+                "dur": max(1, int((s["end"] - s["start"]) * 1e6)),
                 "pid": s["pid"],
                 "tid": s["trace_id"][:8],
-                "args": {**s.get("attributes", {}), "status": s["status"]},
+                "args": {
+                    **s.get("attributes", {}),
+                    "status": s["status"],
+                    "trace_id": s["trace_id"],
+                    "span_id": s["span_id"],
+                    "parent_id": s.get("parent_id"),
+                },
             }
         )
     if filename:

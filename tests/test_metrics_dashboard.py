@@ -83,8 +83,38 @@ def test_dashboard_rest_and_metrics(ray_start_regular):
         assert len(nodes) == 1
         tasks = json.loads(urllib.request.urlopen(f"{base}/api/tasks", timeout=15).read())
         assert any(t["name"] == "noop" for t in tasks)
+        # ?limit= caps the listing; invalid limits are a 400, not a 500.
+        limited = json.loads(
+            urllib.request.urlopen(f"{base}/api/tasks?limit=1", timeout=15).read()
+        )
+        assert len(limited) == 1
+        objs = json.loads(
+            urllib.request.urlopen(f"{base}/api/objects?limit=0", timeout=15).read()
+        )
+        assert objs == []
+        # limit=0 means none even when the table is non-empty (tasks exist).
+        assert json.loads(
+            urllib.request.urlopen(f"{base}/api/tasks?limit=0", timeout=15).read()
+        ) == []
+        try:
+            urllib.request.urlopen(f"{base}/api/tasks?limit=nope", timeout=15)
+            raise AssertionError("invalid limit must 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # Unified timeline endpoint serves chrome-trace events.
+        tl = json.loads(
+            urllib.request.urlopen(f"{base}/api/timeline", timeout=15).read()
+        )
+        assert any(e["cat"] == "task" for e in tl)
         text = urllib.request.urlopen(f"{base}/metrics", timeout=15).read().decode()
         assert "dash_hits 5" in text
+        # Runtime-internal metrics ride the same exposition. Scheduler
+        # counters materialize at telemetry-tick cadence (0.25s): poll.
+        deadline = time.time() + 10
+        while "ray_tpu_scheduler_tasks_dispatched_total" not in text:
+            assert time.time() < deadline, "scheduler metrics never exported"
+            time.sleep(0.2)
+            text = urllib.request.urlopen(f"{base}/metrics", timeout=15).read().decode()
         # The live web UI: self-contained page whose JS polls the REST
         # endpoints the assertions above proved live — node/actor/task/job
         # tables plus the refresh loop (reference: dashboard/client SPA).

@@ -1,0 +1,335 @@
+"""Runtime telemetry: internal metrics, per-stage task events, the unified
+timeline, and the knob-off parity guarantees.
+
+Reference surfaces: the OpenCensus stats pipeline (`stats/metric_defs.cc`),
+per-state task events (`task_event_buffer.h` / `gcs_task_manager.h`), and
+`ray timeline` — rebuilt here on `util/metrics.py` + `gcs.TaskEvent.stages`
++ `util/state.timeline`.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.gcs import TASK_STAGES
+from ray_tpu.util import metrics as metrics_api
+from ray_tpu.util import state as state_api
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_after():
+    yield
+    tracing._enabled = False
+    os.environ.pop("RAY_TPU_TRACING", None)
+
+
+# ------------------------------------------------------------------ stages
+def test_task_events_carry_all_stages(ray_start_regular):
+    @ray_tpu.remote
+    def work(x):
+        time.sleep(0.01)
+        return x + 1
+
+    assert ray_tpu.get([work.remote(i) for i in range(3)], timeout=30) == [1, 2, 3]
+    from ray_tpu._private.worker import global_worker
+
+    done = [
+        ev for ev in global_worker.context.task_events()
+        if ev.state == "FINISHED" and ev.stages
+    ]
+    assert done, "terminal task events must carry per-stage timestamps"
+    ev = done[-1]
+    assert set(TASK_STAGES) <= set(ev.stages), sorted(ev.stages)
+    ordered = [ev.stages[s] for s in TASK_STAGES]
+    # Stage pipeline is causally ordered (clamping happens at read time in
+    # state.py; the raw stamps on one machine should already be close).
+    mono = state_api._monotonic_stages(ev.stages)
+    vals = [mono[s] for s in TASK_STAGES]
+    assert vals == sorted(vals)
+    assert mono["exec_end"] - mono["exec_start"] >= 0.005  # the sleep
+    assert len(ordered) == 7
+
+
+def test_list_tasks_stage_durations_and_summarize_percentiles(ray_start_regular):
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.02)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(4)], timeout=30)
+    tasks = [t for t in state_api.list_tasks(100) if t["name"] == "work"]
+    assert tasks
+    finished = [t for t in tasks if t["state"] == "FINISHED"]
+    assert finished and all("stage_durations" in t for t in finished)
+    d = finished[0]["stage_durations"]
+    assert d["exec"] >= 0.015
+    assert all(v >= 0 for v in d.values())
+
+    summary = state_api.summarize()
+    lat = summary["task_latency"]
+    assert lat["exec_s"]["samples"] >= 4
+    assert lat["exec_s"]["p50"] >= 0.015
+    assert lat["queue_wait_s"]["p95"] >= lat["queue_wait_s"]["p50"] >= 0.0
+
+
+def test_actor_call_stages(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def m(self, x):
+            return x * 2
+
+    a = A.remote()
+    assert ray_tpu.get(a.m.remote(21), timeout=30) == 42
+    from ray_tpu._private.worker import global_worker
+
+    done = [
+        ev for ev in global_worker.context.task_events()
+        if ev.state == "FINISHED" and ev.name == "A.m" and ev.stages
+    ]
+    assert done
+    # Actor calls skip no stages: submit/queued/lease_granted scheduler-side,
+    # the four worker stages from the done message.
+    assert set(TASK_STAGES) <= set(done[-1].stages)
+
+
+# ------------------------------------------------------------------ timeline
+def test_unified_timeline_stages_and_span_links(ray_start_regular, tmp_path):
+    tracing.enable()
+
+    @ray_tpu.remote
+    def traced(x):
+        time.sleep(0.01)
+        return x
+
+    @ray_tpu.remote
+    class B:
+        def m(self):
+            time.sleep(0.005)
+            return 1
+
+    ray_tpu.get([traced.remote(i) for i in range(3)], timeout=30)
+    b = B.remote()
+    ray_tpu.get(b.m.remote(), timeout=30)
+
+    out = str(tmp_path / "timeline.json")
+    deadline = time.time() + 10
+    events = []
+    while time.time() < deadline:
+        events = ray_tpu.timeline(out)
+        cats = {e["cat"] for e in events}
+        if {"task", "task_stage", "submit", "execute"} <= cats:
+            break
+        time.sleep(0.2)
+    cats = {e["cat"] for e in events}
+    assert {"task", "task_stage", "submit", "execute"} <= cats, cats
+
+    # A sampled task shows all seven lifecycle stages, non-decreasing.
+    stage_tasks = [
+        e for e in events
+        if e["cat"] == "task" and set(TASK_STAGES) <= set(e["args"].get("stages", {}))
+    ]
+    assert stage_tasks
+    st = stage_tasks[0]["args"]["stages"]
+    vals = [st[s] for s in TASK_STAGES]
+    assert vals == sorted(vals)
+
+    # Merge ordering: events sorted by start timestamp.
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+
+    # submit -> execute parent link on a shared trace id.
+    submits = {e["args"]["span_id"]: e for e in events if e["cat"] == "submit"}
+    execs = [e for e in events if e["cat"] == "execute"]
+    linked = [
+        e for e in execs
+        if e["args"].get("parent_id") in submits
+        and submits[e["args"]["parent_id"]]["args"]["trace_id"] == e["args"]["trace_id"]
+    ]
+    assert linked, "execute spans must parent onto submit spans"
+
+    # File output is valid chrome-trace JSON with positive durations.
+    loaded = json.load(open(out))
+    assert loaded and all(e["ph"] == "X" and e["dur"] > 0 for e in loaded)
+
+
+def test_timeline_includes_collective_intervals(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu.util import collective
+
+    collective.init_collective_group(1, 0, backend="tcp", group_name="tl")
+    try:
+        collective.allreduce(np.ones(8), group_name="tl")
+        collective.barrier(group_name="tl")
+    finally:
+        collective.destroy_collective_group("tl")
+    deadline = time.time() + 10
+    names = []
+    while time.time() < deadline:
+        names = [e["name"] for e in ray_tpu.timeline() if e["cat"] == "collective"]
+        if "collective::allreduce" in names and "collective::barrier" in names:
+            break
+        time.sleep(0.2)
+    assert "collective::allreduce" in names and "collective::barrier" in names
+
+
+# ------------------------------------------------------------------ metrics
+def test_internal_metrics_exported(ray_start_regular):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(20)], timeout=30)
+    # Scheduler counters materialize at telemetry-tick cadence (0.25s floor),
+    # and dispatch/terminal counts can land on different ticks: poll the
+    # exposition for the full set instead of racing a fixed sleep.
+    wanted = (
+        "ray_tpu_scheduler_pending_tasks",
+        "ray_tpu_scheduler_tasks_submitted_total",
+        "ray_tpu_scheduler_tasks_dispatched_total",
+        'ray_tpu_scheduler_tasks_terminal_total{state="FINISHED"}',
+        "ray_tpu_scheduler_dispatch_wait_s_bucket",
+        "ray_tpu_scheduler_lease_occupancy",
+        "ray_tpu_object_store_objects",
+    )
+    deadline = time.time() + 15
+    while True:
+        text = metrics_api.prometheus_text()
+        missing = [n for n in wanted if n not in text]
+        if not missing:
+            break
+        assert time.time() < deadline, f"{missing} missing from exposition"
+        time.sleep(0.2)
+    # Dispatched counter actually counted the burst.
+    for line in text.splitlines():
+        if line.startswith("ray_tpu_scheduler_tasks_dispatched_total "):
+            assert float(line.rsplit(" ", 1)[1]) >= 1
+            break
+    else:
+        raise AssertionError("dispatched counter missing")
+
+
+def test_batching_metrics_and_coalesce_ratio(ray_start_regular):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    # A pipelined burst through one worker coalesces completions.
+    ray_tpu.get([nop.remote() for _ in range(50)], timeout=30)
+    deadline = time.time() + 10
+    msgs = frames = 0.0
+    while time.time() < deadline:
+        text = metrics_api.prometheus_text()
+        msgs = frames = 0.0
+        for line in text.splitlines():
+            if line.startswith("ray_tpu_batch_messages_total "):
+                msgs = float(line.rsplit(" ", 1)[1])
+            elif line.startswith("ray_tpu_batch_frames_total "):
+                frames = float(line.rsplit(" ", 1)[1])
+        if msgs and frames:
+            break
+        time.sleep(0.3)  # worker registries flush at 1 Hz
+    assert msgs and frames, "batching counters must reach the exposition"
+    assert msgs >= frames, "coalesce ratio must be >= 1"
+    assert "ray_tpu_batch_flush_size_bucket" in text
+
+
+# --------------------------------------------------- exposition edge cases
+def test_prometheus_histogram_bucket_union_mismatched_boundaries(ray_start_regular):
+    """Two processes exporting the same histogram with DIFFERENT boundaries
+    (rolling code changes) must union buckets, not KeyError."""
+    from ray_tpu._private.worker import global_worker
+
+    snap_a = [{
+        "name": "union_lat_s", "type": "histogram", "help": "h",
+        "buckets": [0.1, 1.0],
+        "series": [[[], {"bucket_counts": [2, 1], "sum": 1.2, "count": 3}]],
+    }]
+    snap_b = [{
+        "name": "union_lat_s", "type": "histogram", "help": "h",
+        "buckets": [0.5, 1.0, 5.0],
+        "series": [[[], {"bucket_counts": [1, 1, 1], "sum": 4.0, "count": 3}]],
+    }]
+    ctx = global_worker.context
+    ctx.kv("put", b"metrics::900001", json.dumps(snap_a).encode())
+    ctx.kv("put", b"metrics::900002", json.dumps(snap_b).encode())
+    text = metrics_api.prometheus_text()
+    lines = [l for l in text.splitlines() if l.startswith("union_lat_s")]
+    # Union of boundaries, cumulative counts, merged sum/count.
+    assert 'union_lat_s_bucket{le="0.1"} 2' in lines
+    assert 'union_lat_s_bucket{le="0.5"} 3' in lines
+    assert 'union_lat_s_bucket{le="1.0"} 5' in lines
+    assert 'union_lat_s_bucket{le="5.0"} 6' in lines
+    assert 'union_lat_s_bucket{le="+Inf"} 6' in lines
+    assert "union_lat_s_count 6" in lines
+    le_vals = []
+    for l in lines:
+        if "_bucket{le=" in l and "+Inf" not in l:
+            le_vals.append(float(l.split('le="')[1].split('"')[0]))
+    assert le_vals == sorted(le_vals), "buckets must render in boundary order"
+
+
+# ------------------------------------------------------------------ knobs off
+def test_knob_off_parity():
+    """enable_timeline=False + enable_metrics=False: tasks still run, no
+    events/metrics accumulate, state API and timeline degrade gracefully."""
+    ray_tpu.init(num_cpus=2, _system_config={
+        "enable_timeline": False, "enable_metrics": False,
+    })
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get([f.remote(i) for i in range(10)], timeout=30) == [
+            i * 2 for i in range(10)
+        ]
+
+        @ray_tpu.remote
+        class C:
+            def m(self):
+                return "ok"
+
+        c = C.remote()
+        assert ray_tpu.get(c.m.remote(), timeout=30) == "ok"
+
+        from ray_tpu._private.worker import global_worker
+
+        assert global_worker.context.task_events() == []
+        assert ray_tpu.timeline() == []
+        # The scheduler never materialized Metric objects (the registry is
+        # process-global and may hold entries from earlier tests, so check
+        # the telemetry object itself).
+        sched = global_worker.node
+        assert sched.telemetry._metrics is None
+        assert not sched.telemetry.enabled
+        # State API still serves summaries (without latency rollups).
+        s = state_api.summarize()
+        assert s["task_latency"] == {}
+        assert s["nodes"] == 1
+        tasks = state_api.list_tasks(50)
+        assert any(t["name"] == "f" for t in tasks)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_task_event_ring_buffer_cap():
+    ray_tpu.init(num_cpus=2, _system_config={"task_events_max_num_task_in_gcs": 30})
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x
+
+        assert ray_tpu.get([f.remote(i) for i in range(40)], timeout=30) == list(range(40))
+        from ray_tpu._private.worker import global_worker
+
+        evs = global_worker.context.task_events()
+        assert len(evs) == 30  # ring full: oldest dropped, newest kept
+        # The newest terminal events survive.
+        assert any(ev.state == "FINISHED" for ev in evs[-10:])
+    finally:
+        ray_tpu.shutdown()
