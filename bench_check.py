@@ -27,8 +27,15 @@ from typing import Dict
 # Metrics every bench_core run MUST produce, baseline or not: a run that
 # silently drops one of these is a broken bench, not a clean pass. The
 # telemetry ratio is the overhead guard — telemetry-on throughput within
-# `threshold` of telemetry-off (default 20%).
-REQUIRED_METRICS = ("task_throughput_telemetry_ratio",)
+# `threshold` of telemetry-off (default 20%). The invariants ratio guards
+# the RAY_TPU_DEBUG_INVARIANTS decorators the same way: off-mode (the
+# default) must stay within `threshold` of guards-on throughput, and — via
+# the ordinary task_throughput_async trajectory against the pre-annotation
+# baseline — add no measurable overhead at all.
+REQUIRED_METRICS = (
+    "task_throughput_telemetry_ratio",
+    "task_throughput_invariants_ratio",
+)
 
 
 def load_metrics(path: str) -> Dict[str, float]:

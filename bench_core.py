@@ -258,6 +258,59 @@ def main():
         }
     )
 
+    # ------------------------------------------------- debug-invariant guards
+    # RAY_TPU_DEBUG_INVARIANTS is read at import (concurrency.py), so each
+    # mode needs a fresh interpreter. Off-mode decorators return the function
+    # object unchanged — the recorded ratio (off/on throughput) documents the
+    # guards' cost, and the unchanged task_throughput_async above (vs the
+    # pre-annotation baseline in BENCH_CORE.json) is the proof that off-mode
+    # adds no measurable overhead. bench_check REQUIREs this metric so the
+    # probe can't silently vanish.
+    import os
+    import subprocess
+    import sys
+
+    _probe = (
+        "import time, ray_tpu\n"
+        "ray_tpu.init(num_cpus=4)\n"
+        "@ray_tpu.remote\n"
+        "def _nop():\n"
+        "    return None\n"
+        "ray_tpu.get([_nop.remote() for _ in range(200)])\n"
+        "t0 = time.perf_counter()\n"
+        "ray_tpu.get([_nop.remote() for _ in range(2000)])\n"
+        "print('OPS', 2000 / (time.perf_counter() - t0))\n"
+        "ray_tpu.shutdown()\n"
+    )
+
+    def invariants_throughput(flag: str) -> float:
+        env = dict(os.environ, RAY_TPU_DEBUG_INVARIANTS=flag)
+        proc = subprocess.run(
+            [sys.executable, "-c", _probe], env=env, capture_output=True,
+            text=True, timeout=600,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("OPS "):
+                return float(line.split()[1])
+        raise RuntimeError(
+            f"invariants probe (flag={flag}) produced no OPS line:\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+
+    inv_off = inv_on = 0.0
+    for _ in range(2):  # alternating best-of-2: same noise story as above
+        inv_off = max(inv_off, invariants_throughput("0"))
+        inv_on = max(inv_on, invariants_throughput("1"))
+    results.append(
+        {
+            "metric": "task_throughput_invariants_ratio",
+            "value": round(inv_off / inv_on, 3),
+            "unit": "ratio",
+            "invariants_off_ops_s": round(inv_off, 1),
+            "invariants_on_ops_s": round(inv_on, 1),
+        }
+    )
+
     notes = [
         {
             "note": (

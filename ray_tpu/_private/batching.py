@@ -38,6 +38,7 @@ import time
 from typing import Any, Callable, List, Optional
 
 from ray_tpu._private import serialization
+from ray_tpu._private.concurrency import any_thread, lock_guarded
 
 # Process-wide batching stats, exported as ray_tpu_batch_* metrics by the
 # telemetry collector (telemetry.ensure_batching_metrics). Plain ints bumped
@@ -145,6 +146,7 @@ class BatchedSender:
         self._timer_started = not (start_timer and self.enabled)
 
     # ------------------------------------------------------------------ sends
+    @any_thread
     def send(self, msg: Any) -> None:
         """Flush buffered messages, then write `msg` — FIFO with everything
         queued before it. Raises on a dead connection."""
@@ -154,6 +156,7 @@ class BatchedSender:
                 _record_flush(1, approx_msg_nbytes(msg))
             self._raw_send(serialization.dumps(msg))
 
+    @any_thread
     def send_async(self, msg: Any) -> None:
         """Enqueue a fire-and-forget message; flushes on threshold, else the
         timer (or the next send()/flush()) delivers it. Adaptive: after a
@@ -163,6 +166,7 @@ class BatchedSender:
         wakeups cost ~15% of a roundtrip on small hosts)."""
         self._enqueue(msg, adaptive=True)
 
+    @any_thread
     def buffer(self, msg: Any) -> None:
         """Enqueue WITHOUT the adaptive immediate-send: for messages whose
         natural flush point is a caller-owned boundary (a pipelined worker's
@@ -172,6 +176,7 @@ class BatchedSender:
         would defeat exactly the coalescing these messages exist for."""
         self._enqueue(msg, adaptive=False)
 
+    @any_thread
     def _enqueue(self, msg: Any, adaptive: bool) -> None:
         if not self.enabled:
             try:
@@ -203,6 +208,7 @@ class BatchedSender:
         if arm:
             self._arm_timer()
 
+    @any_thread
     def flush(self) -> None:
         """Flush buffered messages now (the explicit flush-before-blocking /
         loop-idle hook). Connection errors are swallowed — the reader's EOF
@@ -218,6 +224,7 @@ class BatchedSender:
         self._dirty.set()
 
     # --------------------------------------------------------------- internals
+    @lock_guarded("_lock")
     def _flush_locked(self) -> None:
         msgs, self._buf = self._buf, []
         nbytes, self._nbytes = self._nbytes, 0

@@ -14,6 +14,32 @@ import os
 from typing import Any
 
 
+# Environment keys the runtime honors BESIDE the `RAY_TPU_<Config field>`
+# override form. Machine-readable on purpose: the rt-lint config pass
+# (ray_tpu.devtools) checks every RAY_TPU_* environ access in the tree
+# against Config's fields plus this registry, so a typo'd or undeclared env
+# knob fails lint. Add the key here (with its one-line doc) when introducing
+# one.
+ENV_VARS = {
+    "RAY_TPU_ADDRESS": "head TCP address exported to tasks' subprocesses / CLI",
+    "RAY_TPU_AUTHKEY_HEX": "cluster auth key, inherited by workers/daemons",
+    "RAY_TPU_CONTAINER_BINARY": "explicit podman/docker binary for container envs",
+    "RAY_TPU_DAEMON_RECONNECT_S": "node-daemon head-rejoin grace (0 disables)",
+    "RAY_TPU_DEBUG_INVARIANTS": "1 = runtime thread-affinity/lock guard asserts",
+    "RAY_TPU_FAKE_MEMORY_USAGE_FILE": "test hook: fake /proc memory sampling",
+    "RAY_TPU_IN_CONTAINER": "marker set inside containerized workers",
+    "RAY_TPU_JOB_ID": "job id a driver attributes its tasks to",
+    "RAY_TPU_LOG_TO_DRIVER": "worker-side marker for stdout/stderr shipping",
+    "RAY_TPU_NUM_CHIPS": "override detected TPU chip count",
+    "RAY_TPU_RESULTS_DIR": "root dir for train/tune results",
+    "RAY_TPU_RUNTIME_ENV_CACHE": "cache dir for provisioned runtime envs",
+    "RAY_TPU_RUNTIME_ENV_PLUGINS": "extra runtime_env plugin entry points",
+    "RAY_TPU_TRACING": "1 = enable util/tracing span collection",
+    "RAY_TPU_USAGE_STATS_ENABLED": "0 disables the usage-stats stamp",
+    "RAY_TPU_WORKFLOW_ROOT": "workflow storage root directory",
+}
+
+
 def _coerce(value: str, typ: type) -> Any:
     if typ is bool:
         return value.lower() in ("1", "true", "yes", "on")
@@ -32,8 +58,6 @@ class Config:
     # Cap on the total bytes of shared-memory objects per node before puts raise
     # ObjectStoreFullError (plasma's footprint limit).
     object_store_memory: int = 2 * 1024 * 1024 * 1024
-    # LRU-evict sealed-but-unreferenced secondary copies when full.
-    object_store_full_delay_ms: int = 100
     # Ceiling on one inter-node object pull (relay through the head).
     object_pull_timeout_s: float = 300.0
     # Store large objects in the node's native C++ shm arena (ray_tpu/_native/
@@ -68,8 +92,6 @@ class Config:
     # task toward the node holding them (reference: LocalityAwareLeasePolicy,
     # `lease_policy.h:56`).
     scheduler_locality_min_bytes: int = 100_000
-    # How long a leased idle worker is kept before being returned to the pool.
-    idle_worker_killing_time_threshold_ms: int = 1000
     # Max stateless workers started per node beyond num_cpus (oversubscription to
     # break ray.get deadlocks, reference worker_pool prestart behaviour).
     maximum_startup_concurrency: int = 4
@@ -92,7 +114,6 @@ class Config:
     # batch covers its whole in-flight window, so deeper pipelines mean
     # fewer scheduler round trips per task.
     worker_pipeline_depth: int = 16
-    max_io_workers: int = 2
 
     # --- control-plane micro-batching (batching.py) ---
     # Coalesce small control-plane messages (task submissions, actor-call
@@ -117,9 +138,9 @@ class Config:
 
     # --- fault tolerance ---
     task_max_retries: int = 3
+    # Default restart budget for actors created without an explicit
+    # max_restarts option (-1 = infinite, like the per-actor option).
     actor_max_restarts: int = 0
-    health_check_period_ms: int = 1000
-    health_check_failure_threshold: int = 5
 
     # --- task events / tracing (reference: task_event_buffer.h, gcs_task_manager.h) ---
     # Ring-buffer capacity of the GCS task-event store; oldest events drop
@@ -147,13 +168,13 @@ class Config:
     internal_metrics_interval_s: float = 0.25
 
     # --- collective ---
+    # Rendezvous wait ceiling for collective group formation (KV-based
+    # barrier in util/collective/rendezvous.py).
     collective_timeout_s: float = 120.0
 
     # --- worker process ---
     # Stream worker stdout/stderr to subscribed drivers (init(log_to_driver=)).
     log_to_driver: bool = True
-    worker_register_timeout_s: float = 60.0
-    worker_nice: int = 0
 
     def apply_overrides(self, system_config: dict | None = None) -> "Config":
         for f in dataclasses.fields(self):

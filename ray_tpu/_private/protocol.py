@@ -6,32 +6,22 @@ machine and the control plane lives in the driver process, so messages are pickl
 tuples over `multiprocessing` duplex pipes — payload bytes for large objects never
 travel on these pipes (they go through the shared-memory store; see object_store.py).
 
-Message grammar (all pickled with cloudpickle):
-  worker -> driver:
-    ("register", worker_id_hex, pid)
-    ("done", task_id_bytes, ok: bool, result_metas: list[ObjectMeta]
-           [, stage_ts: dict[str, float]])
-                            # Worker-side lifecycle stamps (args_fetched /
-                            # exec_start / exec_end / result_stored) ride the
-                            # completion message when enable_timeline is on —
-                            # per-stage task events cost zero extra round
-                            # trips. Readers treat the 5th element as optional.
-    ("req", req_id: int, method: str, payload)        # blocking control-plane RPC
-    ("actor_exit", reason)
-  driver -> worker:
-    ("exec", ExecRequest)
-    ("resp", req_id: int, ok: bool, payload)
-    ("shutdown",)
-  either direction:
-    ("batch", [msg, ...])   # micro-batched control frame: any of the above
-                            # (and ref_ops/stream/cmd/... messages) coalesced
-                            # by a per-connection BatchedSender (batching.py).
-                            # Receivers process every contained message before
-                            # running scheduling/wakeup work once; per-
-                            # connection FIFO holds because blocking sends
-                            # flush the batch buffer first. Config knobs:
-                            # control_plane_batching / _batch_max_msgs /
-                            # _batch_max_bytes / _batch_flush_interval_s.
+The wire grammar is MACHINE-READABLE: ``MESSAGE_GRAMMAR`` below is the single
+source of truth for every message tag, its tuple arity, its direction, and
+the dispatch loops required to handle it. ``ray_tpu.devtools.lint`` (the
+protocol-conformance pass) cross-checks every sender site and every reader
+dispatch loop in the tree against it, so a tag that is sent-but-unhandled,
+handled-but-never-sent, or sent with the wrong arity fails lint (and tier-1,
+via tests/test_static_analysis.py). Keep the registry exactly in sync with
+the code — that is now enforced, not aspirational.
+
+Batching note: any message below may arrive wrapped in a ``("batch", [msg,
+...])`` frame — control messages coalesce per connection (BatchedSender in
+batching.py, scheduler-side `_send_to`/`_flush_outbound`). Receivers process
+every contained message before running scheduling/wakeup work once; per-
+connection FIFO holds because blocking sends flush the batch buffer first.
+Config knobs: control_plane_batching / _batch_max_msgs / _batch_max_bytes /
+_batch_flush_interval_s.
 """
 
 from __future__ import annotations
@@ -42,6 +32,178 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.ids import ActorID, ObjectID, PlacementGroupID, TaskID
 from ray_tpu._private.object_store import ObjectMeta
+
+# --------------------------------------------------------------------------
+# Wire-message registry. PURE LITERAL by design: ray_tpu.devtools.lint reads
+# it with ast.literal_eval straight from this file's source, so the linter
+# never has to import the runtime (and stays usable in a bare CI venv).
+#
+# Per tag:
+#   dir     -- who speaks it ("worker->head", "head->worker", "daemon->head",
+#              "head->daemon", "driver->head", "head->driver", "handshake",
+#              "any"); documentation only, not checked.
+#   arity   -- (min, max) tuple length INCLUDING the tag. Senders whose
+#              message is a static tuple literal are checked against this;
+#              dynamically-built tuples (e.g. ("done",) + payload) only
+#              register the tag as sent.
+#   readers -- dispatcher keys (see DISPATCHERS) that must each handle the
+#              tag in their dispatch chain. Empty for handshake messages,
+#              which are consumed inline by connection-setup code.
+#   doc     -- one-line payload description.
+#
+# DISPATCHERS maps dispatcher keys to "module:Class.method" of the dispatch
+# loop that routes on the tag (the functions the lint pass scans for
+# `kind == "..."` / `msg[0] == "..."` comparisons).
+# --------------------------------------------------------------------------
+
+DISPATCHERS = {
+    "scheduler.worker": "ray_tpu._private.scheduler:Scheduler._on_worker_message",
+    "scheduler.daemon": "ray_tpu._private.scheduler:Scheduler._on_daemon_message",
+    "scheduler.driver": "ray_tpu._private.scheduler:Scheduler._on_driver_message",
+    "worker.reader": "ray_tpu._private.worker_main:WorkerConnection.reader_loop",
+    "worker.dispatch": "ray_tpu._private.worker_main:WorkerConnection._dispatch",
+    "driver.misc": "ray_tpu._private.worker:RemoteDriverContext._on_misc",
+    "daemon.dispatch": "ray_tpu._private.node_daemon:NodeDaemon._dispatch",
+}
+
+MESSAGE_GRAMMAR = {
+    # ---- worker/driver -> head -------------------------------------------
+    "register": {
+        "dir": "worker->head", "arity": (3, 3),
+        "readers": ("scheduler.worker",),
+        "doc": "(worker_id_hex, pid) — worker announces itself on its conn",
+    },
+    "done": {
+        "dir": "worker->head", "arity": (4, 5),
+        "readers": ("scheduler.worker",),
+        "doc": "(task_id_bytes, ok, result_metas[, stage_ts]) — stage_ts "
+               "(args_fetched/exec_start/exec_end/result_stored) rides along "
+               "when enable_timeline/enable_metrics is on; readers treat the "
+               "5th element as optional",
+    },
+    "req": {
+        "dir": "worker->head", "arity": (4, 4),
+        "readers": ("scheduler.worker", "scheduler.driver"),
+        "doc": "(req_id, method, payload) — blocking control-plane RPC",
+    },
+    "cmd": {
+        "dir": "worker->head", "arity": (3, 3),
+        "readers": ("scheduler.worker", "scheduler.driver"),
+        "doc": "(method, payload) — one-way request, no ack (pipelined submits)",
+    },
+    "stream": {
+        "dir": "worker->head", "arity": (4, 4),
+        "readers": ("scheduler.worker",),
+        "doc": "(task_id_bytes, index, meta) — generator task item sealed",
+    },
+    "log": {
+        "dir": "worker->head", "arity": (6, 6),
+        "readers": ("scheduler.worker",),
+        "doc": "(worker_id_hex, pid, stream, task_name, lines) — stdout/err ship",
+    },
+    "ref_ops": {
+        "dir": "worker->head", "arity": (2, 2),
+        "readers": ("scheduler.worker", "scheduler.driver"),
+        "doc": "([(op, key), ...],) — batched refcount ops",
+    },
+    "object_data": {
+        "dir": "any->head", "arity": (4, 4),
+        "readers": ("scheduler.daemon", "scheduler.driver"),
+        "doc": "(token, ok, data) — reply to a read_object pull",
+    },
+    # ---- daemon -> head ---------------------------------------------------
+    "worker_exit": {
+        "dir": "daemon->head", "arity": (2, 2),
+        "readers": ("scheduler.daemon",),
+        "doc": "(worker_id_hex,) — a daemon-managed worker process exited",
+    },
+    "spawn_failed": {
+        "dir": "daemon->head", "arity": (3, 3),
+        "readers": ("scheduler.daemon",),
+        "doc": "(worker_id_hex, error_repr) — spawn_worker exec failed",
+    },
+    "memory_pressure": {
+        "dir": "daemon->head", "arity": (3, 3),
+        "readers": ("scheduler.daemon",),
+        "doc": "(used_bytes, total_bytes) — node crossed the memory threshold",
+    },
+    # ---- head -> worker ---------------------------------------------------
+    "exec": {
+        "dir": "head->worker", "arity": (2, 2),
+        "readers": ("worker.dispatch",),
+        "doc": "(ExecRequest,) — task pushed to a leased worker",
+    },
+    "resp": {
+        "dir": "head->worker", "arity": (4, 4),
+        "readers": ("worker.dispatch",),
+        "doc": "(req_id, ok, payload) — reply to a blocking req",
+    },
+    "cancel_queued": {
+        "dir": "head->worker", "arity": (2, 2),
+        "readers": ("worker.dispatch",),
+        "doc": "(task_id_bytes,) — drop a lease-queued task unrun",
+    },
+    "shutdown": {
+        "dir": "head->any", "arity": (1, 1),
+        "readers": ("worker.dispatch", "daemon.dispatch"),
+        "doc": "() — orderly teardown of a worker/daemon connection",
+    },
+    # ---- head -> driver ---------------------------------------------------
+    "pub": {
+        "dir": "head->driver", "arity": (3, 3),
+        "readers": ("driver.misc",),
+        "doc": "(channel, payload) — pubsub push (logs/errors channels)",
+    },
+    # ---- head -> daemon/driver data plane --------------------------------
+    "read_object": {
+        "dir": "head->source", "arity": (3, 5),
+        "readers": ("daemon.dispatch", "driver.misc"),
+        "doc": "(token, path[, offset, length]) — serve a segment read for a "
+               "relayed pull; offset/length present for arena-backed objects",
+    },
+    "delete_object": {
+        "dir": "head->source", "arity": (2, 3),
+        "readers": ("daemon.dispatch", "driver.misc"),
+        "doc": "(path[, arena_offset]) — free a sealed segment at its owner",
+    },
+    # ---- head -> daemon ---------------------------------------------------
+    "spawn_worker": {
+        "dir": "head->daemon", "arity": (2, 2),
+        "readers": ("daemon.dispatch",),
+        "doc": "({worker_id_hex, args_blob[, container_env]},) — exec a worker",
+    },
+    "kill_worker": {
+        "dir": "head->daemon", "arity": (2, 2),
+        "readers": ("daemon.dispatch",),
+        "doc": "(worker_id_hex,) — kill a daemon-managed worker process",
+    },
+    # ---- batching ---------------------------------------------------------
+    "batch": {
+        "dir": "any", "arity": (2, 2),
+        "readers": ("scheduler.worker", "scheduler.daemon", "scheduler.driver",
+                    "worker.reader", "daemon.dispatch"),
+        "doc": "([msg, ...],) — micro-batched control frame; receivers apply "
+               "every contained message before waking scheduling work once",
+    },
+    # ---- connection handshakes (consumed inline at accept/connect) -------
+    "worker": {
+        "dir": "handshake", "arity": (2, 2), "readers": (),
+        "doc": "(worker_id_hex,) — first frame on a worker's connect-back",
+    },
+    "daemon": {
+        "dir": "handshake", "arity": (2, 2), "readers": (),
+        "doc": "({resources, labels, shm_dir, data_address},) — daemon hello",
+    },
+    "driver": {
+        "dir": "handshake", "arity": (2, 2), "readers": (),
+        "doc": "({pull_node_id},) — client-mode driver hello",
+    },
+    "ok": {
+        "dir": "handshake", "arity": (2, 4), "readers": (),
+        "doc": "(payload, ...) — registration accepted (daemon: node_id_hex + "
+               "monitor settings; driver: session info dict)",
+    },
+}
 
 
 @dataclass

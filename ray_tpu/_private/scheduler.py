@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization
 from ray_tpu._private.batching import approx_msg_nbytes as _approx_msg_nbytes
+from ray_tpu._private.concurrency import any_thread, loop_thread_only
 from ray_tpu._private.config import Config
 from ray_tpu._private.gcs import GCS, ActorInfo
 from ray_tpu._private.ids import (
@@ -685,6 +686,7 @@ class Scheduler:
         )
         return True
 
+    @loop_thread_only
     def _on_daemon_death(self, daemon: DaemonHandle):
         self._drop_outbound(daemon)
         self._conn_to_daemon.pop(daemon.conn, None)
@@ -705,6 +707,7 @@ class Scheduler:
         for holders in self._subscriptions.values():
             holders.discard(dh.holder_id)
 
+    @loop_thread_only
     def _on_driver_death(self, dh: DriverHandle):
         self._drop_outbound(dh)
         self._conn_to_driver.pop(dh.conn, None)
@@ -749,6 +752,7 @@ class Scheduler:
 
         shutil.rmtree(self._spill_dir, ignore_errors=True)
 
+    @any_thread
     def call(self, method: str, payload: Any) -> concurrent.futures.Future:
         """Thread-safe entry for driver API threads. Fails fast once the
         scheduler has stopped — a caller blocked on .result() of a command no
@@ -771,6 +775,7 @@ class Scheduler:
                 pass  # settled by the loop in the meantime
         return fut
 
+    @any_thread
     def call_nowait(self, method: str, payload: Any) -> None:
         """Fire-and-forget command: enqueue and return without waiting for
         the loop to process it. Used by the hot submission path — pipelined
@@ -787,6 +792,7 @@ class Scheduler:
         if self._stopped.is_set():
             raise RuntimeError("scheduler is stopped")
 
+    @any_thread
     def _wake(self):
         if self._wake_pending:
             return  # racy fast-path read; re-checked under the lock
@@ -800,6 +806,7 @@ class Scheduler:
                 pass
 
     # -------------------------------------------------- outbound micro-batching
+    @any_thread
     def _send_to(self, handle, msg) -> None:
         """Send a control message to a worker/driver/daemon handle, coalescing
         per connection while the scheduler thread is inside a loop iteration
@@ -809,7 +816,17 @@ class Scheduler:
         buf = self._out_buffer
         if buf is None or threading.get_ident() != self._loop_tid:
             if not handle.send(msg):
-                self._on_send_failure(handle)
+                if threading.get_ident() == self._loop_tid:
+                    self._on_send_failure(handle)
+                else:
+                    # Death handlers mutate loop-owned tables (worker maps,
+                    # pending queue, leases): an off-thread caller (e.g. a
+                    # pull-read responder) must hand the failure to the loop
+                    # instead of running them here (rt-lint affinity rule).
+                    try:
+                        self.call_nowait("handle_send_failure", handle)
+                    except RuntimeError:
+                        pass  # scheduler stopped; nothing left to clean up
             return
         ent = buf.get(id(handle))
         if ent is None:
@@ -827,6 +844,7 @@ class Scheduler:
         if not handle.send(msg):
             self._on_send_failure(handle)
 
+    @loop_thread_only
     def _flush_outbound(self) -> None:
         buf = self._out_buffer
         if buf is None:
@@ -843,12 +861,18 @@ class Scheduler:
             for handle, msgs, _nbytes in entries:
                 self._send_many(handle, msgs)
 
+    @loop_thread_only
     def _drop_outbound(self, handle) -> None:
         """Forget buffered messages for a dying connection (flushing to the
         corpse would re-enter the death path)."""
         if self._out_buffer is not None:
             self._out_buffer.pop(id(handle), None)
 
+    def _cmd_handle_send_failure(self, handle) -> None:
+        # Loop-thread re-entry for off-thread _send_to failures.
+        self._on_send_failure(handle)
+
+    @loop_thread_only
     def _on_send_failure(self, handle) -> None:
         # Liveness guards make the failure path idempotent: a flush may fail
         # for a handle whose death was already handled this iteration.
@@ -863,6 +887,7 @@ class Scheduler:
                 self._on_daemon_death(handle)
 
     # ------------------------------------------------------------------ main loop
+    @loop_thread_only
     def _loop(self):
         import multiprocessing.connection as mpc
 
@@ -979,6 +1004,7 @@ class Scheduler:
             if fut is not None and not fut.done():
                 fut.set_exception(RuntimeError("scheduler is stopped"))
 
+    @loop_thread_only
     def _drain_worker(self, wh: WorkerHandle):
         try:
             while wh.conn.poll():
@@ -987,6 +1013,7 @@ class Scheduler:
         except (EOFError, OSError):
             self._on_worker_death(wh)
 
+    @loop_thread_only
     def _drain_daemon(self, daemon: DaemonHandle):
         try:
             while daemon.conn.poll():
@@ -995,6 +1022,7 @@ class Scheduler:
         except (EOFError, OSError):
             self._on_daemon_death(daemon)
 
+    @loop_thread_only
     def _on_daemon_message(self, daemon: DaemonHandle, msg):
         kind = msg[0]
         if kind == "batch":
@@ -1028,9 +1056,8 @@ class Scheduler:
                 )
                 if node is not None and node.alive:
                     self._oom_kill_one([node], snap)
-        elif kind == "heartbeat":
-            pass
 
+    @loop_thread_only
     def _drain_driver(self, dh: DriverHandle):
         try:
             while dh.conn.poll():
@@ -1039,6 +1066,7 @@ class Scheduler:
         except (EOFError, OSError):
             self._on_driver_death(dh)
 
+    @loop_thread_only
     def _on_driver_message(self, dh: DriverHandle, msg):
         kind = msg[0]
         if kind == "batch":
@@ -1055,6 +1083,7 @@ class Scheduler:
         elif kind == "ref_ops":
             self._apply_ref_ops(msg[1], dh.holder_id)
 
+    @loop_thread_only
     def _shutdown_workers(self):
         # Deliver anything still coalesced before the shutdown frames — a
         # direct send must never overtake buffered messages on a connection.
@@ -1253,6 +1282,7 @@ class Scheduler:
             wh.process.mark_dead()
         return wh
 
+    @loop_thread_only
     def _on_worker_death(self, wh: WorkerHandle):
         self._drop_outbound(wh)
         node = self.nodes.get(wh.node_id)
@@ -1326,6 +1356,7 @@ class Scheduler:
             )
 
     # -------------------------------------------------------------- OOM killer
+    @loop_thread_only
     def _memory_monitor_tick(self, now: float) -> None:
         """Sample host/cgroup usage; above the threshold, kill one worker by
         the configured policy (reference: MemoryMonitor callback ->
@@ -1511,6 +1542,7 @@ class Scheduler:
             ar.backlog.clear()
 
     # ------------------------------------------------------------------ messages
+    @loop_thread_only
     def _on_worker_message(self, wh: WorkerHandle, msg):
         kind = msg[0]
         if kind == "batch":
@@ -1543,6 +1575,7 @@ class Scheduler:
         elif kind == "ref_ops":
             self._apply_ref_ops(msg[1], wh.worker_id.hex())
 
+    @any_thread
     def _respond(self, wh: WorkerHandle, req_id: Optional[int], ok: bool, payload):
         # req_id None = one-way "cmd" message: no ack is expected.
         if req_id is None:
@@ -1638,6 +1671,7 @@ class Scheduler:
             },
         )
 
+    @loop_thread_only
     def _on_task_done(self, wh: WorkerHandle, task_id: TaskID, ok: bool,
                       metas: List[ObjectMeta],
                       stages: Optional[Dict[str, float]] = None):
@@ -3449,6 +3483,7 @@ class Scheduler:
         return None
 
     # --- main scheduling pass ---
+    @loop_thread_only
     def _schedule(self):
         self._try_schedule_pgs()
         if not self.pending:

@@ -557,12 +557,20 @@ class RemoteDriverContext:
             elif channel == "errors":
                 _print_worker_error(payload)
         elif msg[0] == "read_object":
-            _, token, path = msg
+            # (token, path[, offset, length]) — offset/length arrive for
+            # arena-backed objects (MESSAGE_GRAMMAR "read_object"). The old
+            # 3-tuple unpack here crashed the reader thread on any arena
+            # object pulled from this driver's store; rt-lint's arity check
+            # now pins both ends to the grammar.
+            _, token, path = msg[:3]
+            offset = msg[3] if len(msg) > 3 else None
+            length = msg[4] if len(msg) > 4 else None
 
             def _read():
+                from ray_tpu._private.object_store import read_segment
+
                 try:
-                    with open(path, "rb") as f:
-                        data = f.read()
+                    data = read_segment(path, offset, length)
                     self.wc.send(("object_data", token, True, data))
                 except OSError as e:
                     self.wc.send(("object_data", token, False, repr(e)))

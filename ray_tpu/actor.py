@@ -263,7 +263,11 @@ class ActorClass:
             )
             req._saved_arg_entries = entries
             req._saved_kwarg_entries = kwentries
-            max_restarts = int(opts.get("max_restarts", 0))
+            from ray_tpu._private.config import get_config
+
+            max_restarts = int(
+                opts.get("max_restarts", get_config().actor_max_restarts)
+            )
             if max_restarts < 0:  # -1 = infinite, like the reference
                 max_restarts = 1 << 30
             ar = ActorRecord(

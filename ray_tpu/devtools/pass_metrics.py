@@ -1,0 +1,118 @@
+"""Metrics discipline.
+
+  M1 bad-name        a Counter/Gauge/Histogram constructed with a name not
+                     matching ``ray_tpu_[a-z0-9_]+``
+  M2 undocumented    an exported metric name missing from the COMPONENTS.md
+                     Observability table (the doc is the metrics contract)
+  M3 hot-path        a hot-path module (scheduler/batching/object store/
+                     worker/wire layers) importing util.metrics or calling
+                     Metric methods (.inc/.observe) directly — hot paths bump
+                     plain ints; materialization belongs in telemetry.py at
+                     snapshot cadence
+
+`.set()` is not policed: the name collides with threading.Event.set, and the
+import ban (M3) already keeps Metric objects out of hot modules entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Set
+
+from ray_tpu.devtools.astutil import (
+    Package, Violation, call_name, const_str, make_key,
+)
+
+METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
+
+# Modules on the task hot path: one frame per message/object flows through
+# these, so Metric-object work (dict lookups, lock, float math) is banned.
+DEFAULT_HOT_MODULES = (
+    "ray_tpu._private.scheduler",
+    "ray_tpu._private.batching",
+    "ray_tpu._private.object_store",
+    "ray_tpu._private.worker",
+    "ray_tpu._private.worker_main",
+    "ray_tpu._private.serialization",
+    "ray_tpu._private.protocol",
+    "ray_tpu._private.gcs",
+)
+
+_METRIC_METHODS = {"inc", "observe"}
+
+
+def _doc_text(doc_path: Optional[str]) -> Optional[str]:
+    if doc_path and os.path.exists(doc_path):
+        with open(doc_path, "r", encoding="utf-8") as fh:
+            return fh.read()
+    return None
+
+
+def run(pkg: Package, hot_modules=DEFAULT_HOT_MODULES,
+        doc_text: Optional[str] = None,
+        doc_path: Optional[str] = None) -> List[Violation]:
+    violations: List[Violation] = []
+    if doc_text is None:
+        doc_text = _doc_text(doc_path)
+
+    reported: Set[str] = set()
+    for module, tree in pkg.modules.items():
+        path = pkg.paths[module]
+        hot = module in hot_modules
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import) and hot:
+                for alias in node.names:
+                    if "util.metrics" in alias.name:
+                        violations.append(Violation(
+                            "metrics", path, node.lineno,
+                            make_key("metrics", path, "hot-import"),
+                            f"hot-path module {module} imports {alias.name}: "
+                            f"hot paths bump plain ints, Metric objects live "
+                            f"in telemetry.py",
+                        ))
+                continue
+            if isinstance(node, ast.ImportFrom) and hot:
+                if node.module and "util.metrics" in node.module:
+                    violations.append(Violation(
+                        "metrics", path, node.lineno,
+                        make_key("metrics", path, "hot-import"),
+                        f"hot-path module {module} imports {node.module}: "
+                        f"hot paths bump plain ints, Metric objects live in "
+                        f"telemetry.py",
+                    ))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            recv, meth = call_name(node)
+            if hot and meth in _METRIC_METHODS and recv is not None:
+                key = make_key("metrics", path, f"hot-call.{recv}.{meth}")
+                if key not in reported:
+                    reported.add(key)
+                    violations.append(Violation(
+                        "metrics", path, node.lineno, key,
+                        f"hot-path module {module} calls {recv}.{meth}(): "
+                        f"metric materialization belongs in telemetry.py "
+                        f"collectors, not on the hot path",
+                    ))
+            if meth in METRIC_CTORS and recv is None and node.args:
+                name = const_str(node.args[0])
+                if name is None:
+                    continue
+                if not NAME_RE.match(name):
+                    violations.append(Violation(
+                        "metrics", path, node.lineno,
+                        make_key("metrics", path, f"name.{name}"),
+                        f"metric name {name!r} does not match "
+                        f"ray_tpu_[a-z0-9_]+",
+                    ))
+                elif doc_text is not None and name not in doc_text:
+                    violations.append(Violation(
+                        "metrics", path, node.lineno,
+                        make_key("metrics", path, f"undocumented.{name}"),
+                        f"metric {name!r} is not listed in the COMPONENTS.md "
+                        f"Observability table",
+                    ))
+    return violations

@@ -7,14 +7,18 @@ from __future__ import annotations
 
 import time
 
-DEFAULT_TIMEOUT_S = 120.0
-
 
 def publish(kv, key: bytes, value: bytes) -> None:
     kv("put", key, value)
 
 
-def wait_for(kv, key: bytes, timeout: float = DEFAULT_TIMEOUT_S) -> bytes:
+def wait_for(kv, key: bytes, timeout: float = None) -> bytes:
+    if timeout is None:
+        # Config-governed ceiling (Config.collective_timeout_s / the
+        # RAY_TPU_collective_timeout_s override).
+        from ray_tpu._private.config import get_config
+
+        timeout = float(get_config().collective_timeout_s)
     deadline = time.time() + timeout
     while time.time() < deadline:
         value = kv("get", key)

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# One-stop pre-merge gate: rt-lint (static invariants) then the tier-1 test
+# suite (ROADMAP.md "Tier-1 verify"). Usage: tools/check.sh [--lint-only]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== rt-lint (ray_tpu.devtools) =="
+python -m ray_tpu.devtools.lint ray_tpu
+
+if [[ "${1:-}" == "--lint-only" ]]; then
+    exit 0
+fi
+
+echo
+echo "== tier-1 tests =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
