@@ -8,8 +8,9 @@ Usage:
                           [--threshold 0.2]
 
 Both inputs are JSON-lines; non-metric lines (tables, notes) are ignored.
-Every recorded metric is higher-is-better (ops/s, GB/s, rows/s). A metric
-below baseline by more than `threshold` (default 20% — microbenchmarks on
+Recorded metrics are higher-is-better (ops/s, GB/s, rows/s) except those in
+LOWER_IS_BETTER (recovery latencies), whose check inverts. A metric worse
+than baseline by more than `threshold` (default 20% — microbenchmarks on
 shared hosts are noisy) fails the check; new metrics are reported
 informationally; metrics missing from the new run fail (a deleted metric is
 how a regression hides).
@@ -35,7 +36,18 @@ from typing import Dict
 REQUIRED_METRICS = (
     "task_throughput_telemetry_ratio",
     "task_throughput_invariants_ratio",
+    # Failpoint hooks are compiled in permanently: the ratio guards the
+    # armed-but-inert mode, and the ordinary task_throughput_async trajectory
+    # guards hooks-off against the pre-failpoints baseline.
+    "task_throughput_failpoints_ratio",
+    # Worker death -> detection -> respawn -> re-run wall time.
+    "worker_kill_recovery_s",
 )
+
+# Metrics where SMALLER is better (seconds of recovery, not ops/s): the
+# regression test inverts — a value above baseline by more than the
+# threshold fails, a drop is an improvement.
+LOWER_IS_BETTER = frozenset({"worker_kill_recovery_s"})
 
 
 def load_metrics(path: str) -> Dict[str, float]:
@@ -82,11 +94,13 @@ def main() -> int:
         new_v = new[name]
         delta = (new_v - old_v) / old_v if old_v else 0.0
         status = "ok"
-        if delta < -ns.threshold:
+        worse = delta > ns.threshold if name in LOWER_IS_BETTER else delta < -ns.threshold
+        if worse:
             status = "REGRESSION"
+            sign = "+" if name in LOWER_IS_BETTER else "-"
             failures.append(
                 f"{name}: {old_v:g} -> {new_v:g} ({delta:+.1%}, "
-                f"threshold -{ns.threshold:.0%})"
+                f"threshold {sign}{ns.threshold:.0%})"
             )
         print(f"{name:35s} {old_v:>12g} -> {new_v:>12g}  {delta:+7.1%}  {status}")
     for name in sorted(set(new) - set(base)):

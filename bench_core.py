@@ -311,6 +311,86 @@ def main():
         }
     )
 
+    # ---------------------------------------------------- failpoint hook cost
+    # Hooks are compiled in permanently (batching sends, reader loops, exec
+    # stages, scheduler drains, segment reads); when nothing is armed each
+    # site costs one module-attribute load + branch, and the ordinary
+    # task_throughput_async trajectory vs the pre-failpoints baseline proves
+    # that stays free. This ratio prices the ARMED-but-inert mode (registry
+    # lookup + seeded-RNG draw per hit, never firing: prob 0.0): armed/off,
+    # ~1.0 when arming is cheap — oriented so an armed-mode regression DROPS
+    # the ratio and fails bench_check's higher-is-better gate. Fresh
+    # interpreters per mode — the env spec is parsed at failpoints import.
+    def failpoints_throughput(spec: str) -> float:
+        env = dict(os.environ)
+        env.pop("RAY_TPU_FAILPOINTS", None)
+        if spec:
+            env["RAY_TPU_FAILPOINTS"] = spec
+        proc = subprocess.run(
+            [sys.executable, "-c", _probe], env=env, capture_output=True,
+            text=True, timeout=600,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("OPS "):
+                return float(line.split()[1])
+        raise RuntimeError(
+            f"failpoints probe (spec={spec!r}) produced no OPS line:\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+
+    fp_off = fp_on = 0.0
+    for _ in range(2):
+        fp_off = max(fp_off, failpoints_throughput(""))
+        fp_on = max(
+            fp_on, failpoints_throughput("conn.send=drop@prob:0.0:1")
+        )
+    results.append(
+        {
+            "metric": "task_throughput_failpoints_ratio",
+            "value": round(fp_on / fp_off, 3),
+            "unit": "ratio",
+            "failpoints_off_ops_s": round(fp_off, 1),
+            "failpoints_armed_inert_ops_s": round(fp_on, 1),
+        }
+    )
+
+    # ------------------------------------------------- worker-kill recovery
+    # End-to-end price of one worker death: first attempt hard-exits, the
+    # scheduler must detect the death, respawn a worker, and re-run — the
+    # submit -> recovered-get wall time. LOWER is better (bench_check treats
+    # it as such); median of 3.
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote(max_retries=2)
+    def _flaky(i):
+        from ray_tpu._private.worker import global_worker
+
+        ctx = global_worker.context
+        key = f"bench_flaky_{i}".encode()
+        if ctx.kv("get", key) is None:
+            ctx.kv("put", key, b"1")
+            import os as _os
+
+            _os._exit(1)
+        return i
+
+    recov = []
+    for i in range(3):
+        t0 = time.perf_counter()
+        assert ray_tpu.get(_flaky.remote(i), timeout=120) == i
+        recov.append(time.perf_counter() - t0)
+    recov.sort()
+    results.append(
+        {
+            "metric": "worker_kill_recovery_s",
+            "value": round(recov[1], 3),
+            "unit": "s (lower is better)",
+            "min": round(recov[0], 3),
+            "max": round(recov[-1], 3),
+        }
+    )
+    ray_tpu.shutdown()
+
     notes = [
         {
             "note": (

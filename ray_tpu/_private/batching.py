@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
-from ray_tpu._private import serialization
+from ray_tpu._private import failpoints, serialization
 from ray_tpu._private.concurrency import any_thread, lock_guarded
 
 # Process-wide batching stats, exported as ray_tpu_batch_* metrics by the
@@ -123,12 +123,16 @@ class BatchedSender:
     """
 
     def __init__(self, raw_send: Callable[[bytes], None], cfg=None,
-                 start_timer: bool = True):
+                 start_timer: bool = True,
+                 close_fn: Optional[Callable[[], None]] = None):
         if cfg is None:
             from ray_tpu._private.config import get_config
 
             cfg = get_config()
         self._raw_send = raw_send
+        # For the "close" failpoint action: abruptly close the underlying
+        # connection so the PEER sees a real mid-stream EOF (half-open case).
+        self._close_fn = close_fn
         self._stats = bool(getattr(cfg, "enable_metrics", False))
         if self._stats:
             _enable_stats()
@@ -154,7 +158,12 @@ class BatchedSender:
             self._flush_locked()
             if self._stats:
                 _record_flush(1, approx_msg_nbytes(msg))
-            self._raw_send(serialization.dumps(msg))
+            data = serialization.dumps(msg)
+            if failpoints.ENABLED and failpoints.inject_send(
+                "conn.send", self._raw_send, data, self._close_fn
+            ):
+                return  # frame consumed (dropped) by the failpoint
+            self._raw_send(data)
 
     @any_thread
     def send_async(self, msg: Any) -> None:
@@ -234,9 +243,14 @@ class BatchedSender:
         if self._stats:
             _record_flush(len(msgs), nbytes)
         if len(msgs) == 1:
-            self._raw_send(serialization.dumps(msgs[0]))
+            data = serialization.dumps(msgs[0])
         else:
-            self._raw_send(serialization.dumps(("batch", msgs)))
+            data = serialization.dumps(("batch", msgs))
+        if failpoints.ENABLED and failpoints.inject_send(
+            "batch.flush", self._raw_send, data, self._close_fn
+        ):
+            return
+        self._raw_send(data)
 
     def _arm_timer(self) -> None:
         self._dirty.set()

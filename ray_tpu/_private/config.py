@@ -26,6 +26,7 @@ ENV_VARS = {
     "RAY_TPU_CONTAINER_BINARY": "explicit podman/docker binary for container envs",
     "RAY_TPU_DAEMON_RECONNECT_S": "node-daemon head-rejoin grace (0 disables)",
     "RAY_TPU_DEBUG_INVARIANTS": "1 = runtime thread-affinity/lock guard asserts",
+    "RAY_TPU_FAILPOINTS": "armed fault-injection schedule (name=kind[:arg][@trigger];...)",
     "RAY_TPU_FAKE_MEMORY_USAGE_FILE": "test hook: fake /proc memory sampling",
     "RAY_TPU_IN_CONTAINER": "marker set inside containerized workers",
     "RAY_TPU_JOB_ID": "job id a driver attributes its tasks to",
@@ -141,6 +142,28 @@ class Config:
     # Default restart budget for actors created without an explicit
     # max_restarts option (-1 = infinite, like the per-actor option).
     actor_max_restarts: int = 0
+    # Heartbeat/health-check channel (reference: health_check_* in
+    # ray_config_def.h): node daemons and workers beat every period over
+    # their control connections; a peer silent for TWO periods (at least one
+    # genuinely missed beat — one period would flap on delivery jitter) is
+    # marked SUSPECT, for period * threshold it is declared DEAD. Daemons: the node
+    # is removed (tasks fail over; a SIGSTOP'd/hung daemon is detected, not
+    # just a closed socket — it rejoins as a fresh node when it wakes).
+    # Workers: SUSPECT is surfaced for observability only; process liveness
+    # and connection EOF stay the kill signals (a GIL-bound compile must not
+    # get its worker shot). 0 disables the channel.
+    health_check_period_ms: int = 1000
+    health_check_failure_threshold: int = 5
+    # Unified retry/backoff policy (_private/retry.py): exponential backoff
+    # with deterministic jitter + deadline budget, adopted by object
+    # reconstruct, Serve resubmit, daemon rejoin, and collective rendezvous.
+    retry_backoff_base_ms: int = 50
+    retry_backoff_max_ms: int = 2000
+    # Attempt budget for the lost-segment path: reconstruct-from-lineage
+    # retries before a typed ObjectLostError surfaces at the API boundary.
+    object_reconstruct_attempts: int = 3
+    # Bounded dead-replica resubmits per Serve request (was hard-coded 1).
+    serve_resubmit_attempts: int = 2
 
     # --- task events / tracing (reference: task_event_buffer.h, gcs_task_manager.h) ---
     # Ring-buffer capacity of the GCS task-event store; oldest events drop

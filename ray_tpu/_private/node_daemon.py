@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Dict
 
-from ray_tpu._private import serialization
+from ray_tpu._private import failpoints, serialization
 
 
 class NodeDaemon:
@@ -159,6 +159,9 @@ class NodeDaemon:
         self.memory_monitor_refresh_ms = int(
             monitor.get("memory_monitor_refresh_ms", 500)
         )
+        self.health_check_period_ms = int(
+            monitor.get("health_check_period_ms", 1000)
+        )
 
     def _send(self, msg) -> bool:
         with self._lock:
@@ -245,8 +248,26 @@ class NodeDaemon:
         monitor's per-node sampling — the kill DECISION runs in the head's
         scheduler, which knows tasks and retry budgets)."""
         last_mem = 0.0
+        last_beat = 0.0
         while not self._stop.is_set():
+            # Liveness heartbeat at the head-configured cadence (its config
+            # governs; pushed at registration). Stops beating only when this
+            # PROCESS stops — a SIGSTOP/hang stops the beats while the socket
+            # stays open, which is exactly what the head's detector catches.
+            hb_period = getattr(self, "health_check_period_ms", 1000)
+            now_hb = time.time()
+            if hb_period > 0 and now_hb - last_beat >= hb_period / 1000.0:
+                last_beat = now_hb
+                if not (failpoints.ENABLED
+                        and failpoints.fire("daemon.heartbeat")):
+                    self._send(("heartbeat",))
             dead = []
+            # Tick fast enough that sub-second heartbeat periods are honored
+            # (a fixed 0.2s floor would make grace settings near 2x period
+            # false-kill a healthy daemon); reap cadence floor stays 0.2s.
+            tick = (
+                max(0.02, min(0.2, hb_period / 2000.0)) if hb_period > 0 else 0.2
+            )
             with self._lock:
                 for wid, popen in list(self.procs.items()):
                     if popen.poll() is not None:
@@ -272,7 +293,7 @@ class NodeDaemon:
                     self._send(
                         ("memory_pressure", snap.used_bytes, snap.total_bytes)
                     )
-            time.sleep(0.2)
+            time.sleep(tick)
 
     def _dispatch(self, msg) -> bool:
         """Handle one head->daemon message; False means shutdown."""
@@ -339,8 +360,19 @@ class NodeDaemon:
             self.conn.close()
         except Exception:
             pass
-        deadline = time.time() + grace
-        while time.time() < deadline and not self._stop.is_set():
+        # Unified retry policy: backoff 0.2s -> 2s with deterministic jitter
+        # under the grace deadline (was a fixed 1s loop). Seeded from the
+        # node id so a chaos run's rejoin cadence replays.
+        from ray_tpu._private.retry import RetryPolicy, attempts
+
+        policy = RetryPolicy(
+            max_attempts=1_000_000, base_delay_s=0.2, max_delay_s=2.0,
+            deadline_s=grace,
+        )
+        seed = int(self.node_id_hex[:8] or "0", 16)
+        for _ in attempts(policy, seed=seed):
+            if self._stop.is_set():
+                return False
             try:
                 self.connect()
                 with self._lock:
@@ -359,7 +391,6 @@ class NodeDaemon:
                         self.conn.close()
                 except Exception:
                     pass
-                time.sleep(1.0)
         return False
 
 

@@ -27,6 +27,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import failpoints
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import SerializedValue, deserialize, serialize
 
@@ -342,6 +343,15 @@ def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn,
 
     if meta.segment is None:
         return meta
+    if failpoints.ENABLED and meta.arena_offset is None:
+        # "object.lose_segment": delete the bytes out from under this reader
+        # — the deterministic stand-in for a node dying after seal. The read
+        # below fails and the caller's reconstruct-from-lineage path runs.
+        if failpoints.fire("object.lose_segment"):
+            try:
+                os.unlink(meta.segment)
+            except OSError:
+                pass
     remote = force_remote and meta.node_id is not None and meta.node_id != store.node_id
     if not remote and os.path.exists(meta.segment):
         if _stats_enabled():

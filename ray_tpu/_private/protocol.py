@@ -111,6 +111,14 @@ MESSAGE_GRAMMAR = {
         "readers": ("scheduler.daemon", "scheduler.driver"),
         "doc": "(token, ok, data) — reply to a read_object pull",
     },
+    "heartbeat": {
+        "dir": "any->head", "arity": (1, 1),
+        "readers": ("scheduler.worker", "scheduler.daemon"),
+        "doc": "() — liveness beat from a worker/daemon (the connection "
+               "identifies the peer); the scheduler's staleness detector "
+               "drives the ALIVE -> SUSPECT -> DEAD transitions "
+               "(health_check_period_ms / health_check_failure_threshold)",
+    },
     # ---- daemon -> head ---------------------------------------------------
     "worker_exit": {
         "dir": "daemon->head", "arity": (2, 2),

@@ -32,7 +32,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private import serialization
+from ray_tpu._private import failpoints, serialization
 from ray_tpu._private.batching import approx_msg_nbytes as _approx_msg_nbytes
 from ray_tpu._private.concurrency import any_thread, loop_thread_only
 from ray_tpu._private.config import Config
@@ -114,6 +114,10 @@ class _ConnSender:
         self._send_lock = threading.Lock()
 
     def send(self, msg) -> bool:
+        if failpoints.ENABLED:
+            verdict = failpoints.inject_handle_send("sched.send")
+            if verdict is not None:
+                return verdict
         data = serialization.dumps(msg)
         with self._send_lock:
             try:
@@ -175,8 +179,17 @@ class WorkerHandle:
     inflight_tasks: List[TaskID] = field(default_factory=list)
     # Why this worker is blocked ("dep" | "throttle"); see _mark_blocked.
     blocked_kind: str = "dep"
+    # Heartbeat channel: last beat received + detector verdict. For workers
+    # the verdict is OBSERVATIONAL ("ALIVE"/"SUSPECT" — surfaced, counted,
+    # never a kill signal; a GIL-bound compile must not get its worker shot).
+    last_heartbeat: float = field(default_factory=time.time)
+    health: str = "ALIVE"
 
     def send(self, msg) -> bool:
+        if failpoints.ENABLED:
+            verdict = failpoints.inject_handle_send("sched.send")
+            if verdict is not None:
+                return verdict
         data = serialization.dumps(msg)
         with self.send_lock:
             if self.conn is None:
@@ -224,6 +237,11 @@ class NodeState:
     data_address: Optional[str] = None
     # Last time work was dispatched here (autoscaler idle detection).
     last_active: float = field(default_factory=time.time)
+    # Heartbeat channel (daemon-backed nodes only): last beat received and
+    # the detector verdict ALIVE -> SUSPECT (one period silent) -> DEAD
+    # (period * threshold silent => node removed, tasks fail over).
+    last_heartbeat: float = field(default_factory=time.time)
+    health: str = "ALIVE"
 
     def utilization(self) -> float:
         """Critical-resource utilization: the max used-fraction over resource
@@ -519,6 +537,7 @@ class Scheduler:
         # idle / death transitions): O(1) pipeline-candidate lookup.
         self._leases: Dict[tuple, List[WorkerHandle]] = {}
         self._last_memory_check = 0.0
+        self._last_hb_check = 0.0
         # (when, rec) pairs re-queued after a delay (OOM retry backoff).
         self._delayed_retries: List[Tuple[float, TaskRecord]] = []
         # Pubsub plane (reference: src/ray/pubsub/publisher.h — long-poll
@@ -660,6 +679,9 @@ class Scheduler:
                 {
                     "memory_usage_threshold": self.config.memory_usage_threshold,
                     "memory_monitor_refresh_ms": self.config.memory_monitor_refresh_ms,
+                    # Daemons beat at the head's configured cadence — this
+                    # process never saw the driver's _system_config.
+                    "health_check_period_ms": self.config.health_check_period_ms,
                 },
             )
         )
@@ -951,6 +973,11 @@ class Scheduler:
                 dh = self._conn_to_driver.get(obj)
                 if dh is not None:
                     self._drain_driver(dh)
+            # Heartbeat staleness detector — AFTER the drains, so beats that
+            # queued while the loop was busy are applied before staleness is
+            # judged (a slow loop iteration must not false-kill live peers).
+            # Self-gated by its own period, honoring sub-500ms settings.
+            self._check_heartbeats(time.time())
             # Drain commands (a fire-and-forget submit has fut=None: the whole
             # burst is processed in ONE wakeup instead of one ack round trip
             # per submission — the pipelined-submission fast path).
@@ -965,6 +992,14 @@ class Scheduler:
                     self._stopped.set()
                     break
                 try:
+                    if failpoints.ENABLED and failpoints.fire(
+                        "sched.cmd." + method
+                    ):
+                        # Injected mid-handler crash: follows the real error
+                        # path (future rejection / submit-failure sealing).
+                        raise failpoints.FailpointInjected(
+                            f"sched.cmd.{method}"
+                        )
                     result = getattr(self, "_cmd_" + method)(payload)
                     # _ASYNC handlers resolve a caller-provided inner future later;
                     # the command future just acknowledges receipt.
@@ -1028,6 +1063,12 @@ class Scheduler:
         if kind == "batch":
             for m in msg[1]:
                 self._on_daemon_message(daemon, m)
+            return
+        if kind == "heartbeat":
+            node = self.nodes.get(daemon.node_id)
+            if node is not None:
+                node.last_heartbeat = time.time()
+                node.health = "ALIVE"
             return
         if kind == "worker_exit" or kind == "spawn_failed":
             wh = self._workers_by_id.get(msg[1])
@@ -1157,6 +1198,7 @@ class Scheduler:
                 "resources": dict(n.resources),
                 "available": dict(n.available),
                 "alive": n.alive,
+                "health": n.health,
                 "labels": dict(n.labels),
                 "num_workers": len(n.workers),
             }
@@ -1499,6 +1541,60 @@ class Scheduler:
         # Local processes reap via conn EOF / liveness check; daemon workers
         # via the daemon's worker_exit notification.
 
+    # ------------------------------------------------------------- heartbeats
+    @loop_thread_only
+    def _check_heartbeats(self, now: float) -> None:
+        """ALIVE -> SUSPECT -> DEAD staleness detector over the heartbeat
+        channel. Connection EOF only catches CLEAN deaths; a SIGSTOP'd,
+        wedged, or partitioned peer keeps its socket open forever — this is
+        the path that catches those. Daemon-backed nodes: one silent period
+        marks the node SUSPECT, period * threshold declares it DEAD (node
+        removed, in-flight tasks fail over; the daemon rejoins as a fresh
+        node if it ever wakes). Workers: SUSPECT is observational only —
+        liveness/EOF stays the kill signal, so a long GIL-bound compile is
+        never shot by its own slowness."""
+        period = self.config.health_check_period_ms / 1000.0
+        if period <= 0:
+            return
+        if now - self._last_hb_check < min(period / 2.0, 0.25):
+            return
+        self._last_hb_check = now
+        grace = period * max(1, self.config.health_check_failure_threshold)
+        # SUSPECT at two silent periods (not one): beats arrive AT period
+        # cadence, so a one-period threshold would flap ALIVE<->SUSPECT on
+        # ordinary jitter. Two periods = at least one genuinely missed beat.
+        suspect_after = 2.0 * period
+        tel = self.telemetry
+        for node in list(self.nodes.values()):
+            if node.daemon is None or not node.alive:
+                continue
+            stale = now - node.last_heartbeat
+            if stale > grace:
+                node.health = "DEAD"
+                tel.hb_dead_daemon += 1
+                self._publish(
+                    "errors",
+                    {
+                        "task": "health_check",
+                        "type": "NodeHeartbeatTimeout",
+                        "message": (
+                            f"node {node.node_id.hex()[:8]} sent no heartbeat "
+                            f"for {stale:.1f}s (grace {grace:.1f}s): "
+                            "declaring it DEAD"
+                        ),
+                    },
+                )
+                self._on_daemon_death(node.daemon)
+            elif stale > suspect_after and node.health == "ALIVE":
+                node.health = "SUSPECT"
+                tel.hb_suspect_daemon += 1
+        for wh in self._workers_by_id.values():
+            if wh.conn is None:
+                continue  # still connecting: spawn latency is not a hang
+            if now - wh.last_heartbeat > suspect_after and wh.health == "ALIVE":
+                wh.health = "SUSPECT"
+                tel.hb_suspect_worker += 1
+
     def _handle_actor_worker_death(self, wh: WorkerHandle):
         from ray_tpu.exceptions import RayActorError
 
@@ -1552,6 +1648,14 @@ class Scheduler:
                 self._on_worker_message(wh, m)
             return
         if kind == "register":
+            # Restart the staleness clock: last_heartbeat was stamped at
+            # SPAWN, and a slow cold start (interpreter + imports) must not
+            # count as silence — the first beat is one period away from HERE.
+            wh.last_heartbeat = time.time()
+            return
+        if kind == "heartbeat":
+            wh.last_heartbeat = time.time()
+            wh.health = "ALIVE"
             return
         if kind == "done":
             # Lease-pipelined workers coalesce dones into "batch" frames
@@ -1590,6 +1694,8 @@ class Scheduler:
             self._respond(wh, req_id, False, ValueError(f"unknown request {method}"))
             return
         try:
+            if failpoints.ENABLED and failpoints.fire("sched.req." + method):
+                raise failpoints.FailpointInjected(f"sched.req.{method}")
             handler(wh, req_id, payload)
         except Exception as e:  # noqa: BLE001
             if req_id is None:

@@ -60,6 +60,11 @@ class SchedulerTelemetry:
         # Scheduler-side outbound coalescing (_send_to/_flush_outbound).
         self.out_msgs = 0
         self.out_frames = 0
+        # Heartbeat detector transitions (_check_heartbeats): plain ints,
+        # materialized into the tagged counters below per tick.
+        self.hb_suspect_daemon = 0
+        self.hb_suspect_worker = 0
+        self.hb_dead_daemon = 0
 
     # ---------------------------------------------------------------- ticks
     def on_iteration(self, sched, now: float) -> None:
@@ -85,6 +90,15 @@ class SchedulerTelemetry:
         self._drain_counter(m["spilled_bytes"], "spilled_bytes")
         self._drain_counter(m["out_msgs"], "out_msgs")
         self._drain_counter(m["out_frames"], "out_frames")
+        if self.hb_suspect_daemon:
+            m["hb_suspect"].inc(self.hb_suspect_daemon, {"kind": "daemon"})
+            self.hb_suspect_daemon = 0
+        if self.hb_suspect_worker:
+            m["hb_suspect"].inc(self.hb_suspect_worker, {"kind": "worker"})
+            self.hb_suspect_worker = 0
+        if self.hb_dead_daemon:
+            m["hb_dead"].inc(self.hb_dead_daemon, {"kind": "daemon"})
+            self.hb_dead_daemon = 0
         if self.finished:
             m["terminal"].inc(self.finished, {"state": "FINISHED"})
             self.finished = 0
@@ -140,6 +154,12 @@ class SchedulerTelemetry:
                                 "control messages coalesced by the scheduler loop"),
             "out_frames": Counter("ray_tpu_scheduler_outbound_frames_total",
                                   "frames the scheduler loop actually wrote"),
+            "hb_suspect": Counter("ray_tpu_heartbeat_suspect_total",
+                                  "peers marked SUSPECT by the heartbeat "
+                                  "staleness detector", ("kind",)),
+            "hb_dead": Counter("ray_tpu_heartbeat_dead_total",
+                               "peers declared DEAD by the heartbeat "
+                               "staleness detector", ("kind",)),
             "dispatch_wait": Histogram(
                 "ray_tpu_scheduler_dispatch_wait_s",
                 "queued -> lease_granted wait per task",
@@ -288,5 +308,8 @@ def router_metrics() -> dict:
             "inflight": Gauge("ray_tpu_serve_router_inflight",
                               "requests in flight through this router",
                               ("deployment",)),
+            "resubmits": Counter("ray_tpu_serve_resubmit_total",
+                                 "requests resubmitted to another replica "
+                                 "after a replica death", ("deployment",)),
         }
     return _router_metrics

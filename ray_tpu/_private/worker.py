@@ -1151,6 +1151,21 @@ def put(value: Any) -> ObjectRef:
     return ObjectRef(oid)
 
 
+def _recover_lost_object(ctx, meta: ObjectMeta, first_err: BaseException):
+    """Lost-segment path: the object is sealed but its bytes are gone (node
+    died, file deleted, arena segment lost under a reader). The shared
+    recovery loop in `_private/retry.py` reconstructs from lineage with a
+    configurable budget and surfaces a typed ObjectLostError on exhaustion."""
+    from ray_tpu._private import retry
+
+    return retry.reconstruct_object_with_retry(
+        get_config(), meta,
+        ctx.reconstruct_object,
+        lambda m: global_worker.store.get(ctx.ensure_local(m)),
+        first_err,
+    )
+
+
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
     """Fetch object values, raising remote errors (reference: `worker.py:2424`)."""
     _auto_init()
@@ -1168,11 +1183,10 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
             value = global_worker.store.get(ctx.ensure_local(meta))
         except exceptions.GetTimeoutError:
             raise
-        except (OSError, ConnectionError):
-            # Segment bytes lost (node died, file deleted): reconstruct from
-            # lineage and retry once (reference: ObjectRecoveryManager).
-            meta = ctx.reconstruct_object(meta.object_id.binary())
-            value = global_worker.store.get(ctx.ensure_local(meta))
+        except (OSError, ConnectionError) as lost:
+            # Segment bytes lost: reconstruct from lineage under the unified
+            # retry policy (reference: ObjectRecoveryManager).
+            meta, value = _recover_lost_object(ctx, meta, lost)
         if meta.is_error:
             if isinstance(value, exceptions.RayTaskError):
                 raise value.as_instanceof_cause()

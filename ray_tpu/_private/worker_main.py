@@ -21,7 +21,7 @@ import traceback
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
-from ray_tpu._private import serialization
+from ray_tpu._private import failpoints, serialization
 from ray_tpu._private.config import Config, set_config
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import LocalObjectStore, ObjectMeta
@@ -47,6 +47,30 @@ class WorkerArgs:
     head_address: Optional[str] = None
 
 
+def _abrupt_close(conn) -> None:
+    """Hard-close a multiprocessing Connection so BOTH ends observe EOF
+    immediately (the failpoint "close" action). `conn.close()` alone is not
+    enough: a reader thread blocked in recv keeps the underlying file
+    description referenced, so no FIN is sent and neither side ever wakes —
+    shutdown(SHUT_RDWR) on a dup'd fd tears the socket down for real."""
+    import socket as _socket
+
+    try:
+        s = _socket.socket(fileno=os.dup(conn.fileno()))
+    except OSError:
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return
+    try:
+        s.shutdown(_socket.SHUT_RDWR)
+    except OSError:
+        pass
+    finally:
+        s.close()
+
+
 class WorkerConnection:
     """Request/response multiplexing over the driver pipe.
 
@@ -59,7 +83,9 @@ class WorkerConnection:
         from ray_tpu._private.batching import BatchedSender
 
         self.conn = conn
-        self.batch = BatchedSender(conn.send_bytes)
+        self.batch = BatchedSender(
+            conn.send_bytes, close_fn=lambda: _abrupt_close(conn)
+        )
         self._req_lock = threading.Lock()
         self._next_req_id = 0
         self._pending: Dict[int, "queue.SimpleQueue"] = {}
@@ -154,6 +180,10 @@ class WorkerConnection:
         try:
             while True:
                 data = self.conn.recv_bytes()
+                if failpoints.ENABLED and failpoints.inject_recv(
+                    "conn.recv", lambda: _abrupt_close(self.conn)
+                ) == "drop":
+                    continue  # frame discarded by the failpoint
                 msg = serialization.loads(data)
                 if msg[0] == "batch":
                     # Coalesced frame: process every contained message before
@@ -400,16 +430,25 @@ class WorkerRuntime:
 
     def fetch_value(self, meta: ObjectMeta):
         """Read an object value, reconstructing from lineage if its bytes were
-        lost (reference: ObjectRecoveryManager re-submitting the creating task)."""
+        lost (reference: ObjectRecoveryManager re-submitting the creating
+        task). The shared recovery loop in `_private/retry.py` runs the
+        reconstruction under the unified policy and surfaces a typed
+        ObjectLostError on budget exhaustion."""
         try:
             return self.store.get(self.ensure_local(meta))
-        except (OSError, ConnectionError):
-            fresh = self.wc.request(
-                "reconstruct_object",
-                meta.object_id.binary(),
-                timeout=self.args.config.object_pull_timeout_s,
+        except (OSError, ConnectionError) as first_err:
+            from ray_tpu._private import retry
+
+            cfg = self.args.config
+            _fresh, value = retry.reconstruct_object_with_retry(
+                cfg, meta,
+                lambda key: self.wc.request(
+                    "reconstruct_object", key, timeout=cfg.object_pull_timeout_s
+                ),
+                lambda m: self.store.get(self.ensure_local(m)),
+                first_err,
             )
-            return self.store.get(self.ensure_local(fresh))
+            return value
 
     def load_function(self, function_id: str, blob: Optional[bytes]):
         fn = self.functions.get(function_id)
@@ -513,6 +552,10 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
             raise exceptions.RuntimeEnvSetupError(
                 f"runtime_env setup failed for this worker: {rt.setup_error!r}"
             )
+        if failpoints.ENABLED:
+            # Partial-failure injection: die before any argument bytes are
+            # touched — the task must retry cleanly with its deps re-pinned.
+            failpoints.maybe_crash("worker.crash_before_args_fetched")
         args = [rt.fetch_value(m) for m in req.arg_metas]
         kwargs = {k: rt.fetch_value(m) for k, m in req.kwarg_metas.items()}
         if stages is not None:
@@ -577,11 +620,21 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
                 )
         if stages is not None:
             stages["exec_end"] = time.time()
+        if failpoints.ENABLED:
+            # Crash AFTER the user code ran but before any result byte is
+            # stored: the work is done yet invisible — exactly the window the
+            # exec_end/result_stored pipeline makes observable.
+            failpoints.maybe_crash("worker.crash_after_exec_end")
         metas = []
         for oid, value in zip(req.return_ids, values):
             sv = serialization.serialize(value)
             meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
             metas.append(meta)
+        if failpoints.ENABLED:
+            # Crash with results IN the store but the done message unsent:
+            # the scheduler must treat the task as dead (segments orphaned),
+            # and the retry must overwrite them without corruption.
+            failpoints.maybe_crash("worker.crash_before_result_stored")
         if stages is not None:
             stages["result_stored"] = time.time()
         # Flush refcount ops BEFORE "done": pipe FIFO guarantees any borrower
@@ -674,6 +727,25 @@ def worker_loop(conn, args: WorkerArgs):
     if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
         _install_output_tee(wc, rt, args.worker_id_hex)
     wc.send(("register", args.worker_id_hex, os.getpid()))
+    hb_period = args.config.health_check_period_ms / 1000.0
+    if hb_period > 0:
+        # Liveness beat on its own daemon thread: keeps ticking while the
+        # dispatch loop runs user code, so the scheduler distinguishes a
+        # SLOW task (beats keep coming) from a hung/stopped process (beats
+        # stop while the socket stays open).
+        def _heartbeat_loop():
+            while not wc._closed.is_set():
+                time.sleep(hb_period)
+                if failpoints.ENABLED and failpoints.fire("worker.heartbeat"):
+                    continue  # simulated hang: swallow the beat
+                try:
+                    wc.send_async(("heartbeat",))
+                except Exception:  # noqa: BLE001 — connection gone
+                    return
+
+        threading.Thread(
+            target=_heartbeat_loop, daemon=True, name="heartbeat"
+        ).start()
     while True:
         # Flush the batch buffer (completions, stream items, ref ops) on
         # EVERY pass with an empty queue — a skipped (cancelled) task or any

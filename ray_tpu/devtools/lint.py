@@ -1,9 +1,9 @@
-"""rt-lint CLI: run the five invariant passes over the ray_tpu tree.
+"""rt-lint CLI: run the six invariant passes over the ray_tpu tree.
 
 Usage::
 
     python -m ray_tpu.devtools.lint [package_dir] [--allowlist FILE]
-        [--passes protocol,blocking,affinity,config,metrics] [-q]
+        [--passes protocol,blocking,affinity,config,metrics,failpoints] [-q]
 
 Exit status: 0 = clean (after allowlist), 1 = violations / allowlist format
 errors / unused allowlist entries. Designed for CI (tools/check.sh) and for
@@ -26,7 +26,8 @@ import sys
 from typing import Callable, Dict, List
 
 from ray_tpu.devtools import (
-    pass_affinity, pass_blocking, pass_config, pass_metrics, pass_protocol,
+    pass_affinity, pass_blocking, pass_config, pass_failpoints, pass_metrics,
+    pass_protocol,
 )
 from ray_tpu.devtools.astutil import (
     Package, Violation, apply_allowlist, load_allowlist, load_package,
@@ -38,6 +39,7 @@ PASSES: Dict[str, Callable[[Package], List[Violation]]] = {
     "affinity": pass_affinity.run,
     "config": pass_config.run,
     "metrics": pass_metrics.run,
+    "failpoints": pass_failpoints.run,
 }
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -58,6 +60,8 @@ def run_all(package_dir: str, passes=None, doc_path: str = None,
         fn = PASSES[name]
         if name == "metrics":
             violations.extend(pass_metrics.run(pkg, doc_path=doc_path))
+        elif name == "failpoints":
+            violations.extend(pass_failpoints.run(pkg, doc_path=doc_path))
         else:
             violations.extend(fn(pkg))
     errors: List[str] = []

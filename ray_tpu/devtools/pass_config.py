@@ -35,6 +35,7 @@ DEFAULT_CONFIG_MODULES = (
     "ray_tpu._private.worker_main",
     "ray_tpu._private.node_daemon",
     "ray_tpu._private.batching",
+    "ray_tpu._private.retry",
     "ray_tpu._private.telemetry",
     "ray_tpu._private.object_store",
     "ray_tpu._private.head",

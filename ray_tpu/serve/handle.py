@@ -311,8 +311,11 @@ class DeploymentResponse:
     (reference: `serve/handle.py` DeploymentResponse).
 
     On actor-death at fetch time the dead replica is reported to the
-    controller (which replaces it) and the request is resubmitted once to
-    another replica (reference: router replica recovery)."""
+    controller (which replaces it) and the request is resubmitted to another
+    replica under the unified retry policy (`_private/retry.py`):
+    `Config.serve_resubmit_attempts` bounded attempts with seeded backoff,
+    all inside the caller's timeout budget. Each failover increments
+    `ray_tpu_serve_resubmit_total{deployment}`."""
 
     def __init__(
         self,
@@ -328,50 +331,92 @@ class DeploymentResponse:
 
     def result(self, timeout: Optional[float] = None):
         import ray_tpu
+        from ray_tpu._private import retry
+        from ray_tpu._private.config import get_config
         from ray_tpu.exceptions import RayActorError, WorkerCrashedError
 
+        cfg = get_config()
         deadline = None if timeout is None else time.monotonic() + timeout
-        try:
-            return ray_tpu.get(self.ref, timeout=timeout)
-        except (RayActorError, WorkerCrashedError):
-            if self._request is None or self._replica_id is None:
-                raise
-            # The retry's controller round-trips are not individually bounded;
-            # at minimum don't start them with the caller's budget already
-            # spent.
-            if deadline is not None and time.monotonic() >= deadline:
-                from ray_tpu.exceptions import GetTimeoutError
-
-                raise GetTimeoutError(
-                    f"request to dead replica {self._replica_id} had no "
-                    f"budget left to retry within timeout={timeout}s"
-                )
-            self._router.report_failure(self._replica_id)
-            method, args, kwargs = self._request
-            self.ref, self._replica_id = self._router.route(
-                method, args, kwargs, force_refresh=True
-            )
+        attempts_left = max(0, int(cfg.serve_resubmit_attempts))
+        # Deterministic backoff between failovers (seeded from the request's
+        # first replica via retry.seed_from — stable across processes):
+        # replacing replicas need a beat to come up.
+        delays = retry.backoff_delays(
+            retry.RetryPolicy.from_config(cfg, max_attempts=attempts_left + 1),
+            seed=retry.seed_from(self._replica_id or ""),
+        )
+        while True:
             remaining = (
-                None if deadline is None else max(0.0, deadline - time.monotonic())
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
             )
-            return ray_tpu.get(self.ref, timeout=remaining)
+            try:
+                return ray_tpu.get(
+                    self.ref, timeout=remaining if timeout is not None else None
+                )
+            except (RayActorError, WorkerCrashedError):
+                if self._request is None or self._replica_id is None:
+                    raise
+                if attempts_left <= 0:
+                    raise
+                # The retry's controller round-trips are not individually
+                # bounded; at minimum don't start them with the caller's
+                # budget already spent.
+                if deadline is not None and time.monotonic() >= deadline:
+                    from ray_tpu.exceptions import GetTimeoutError
+
+                    raise GetTimeoutError(
+                        f"request to dead replica {self._replica_id} had no "
+                        f"budget left to retry within timeout={timeout}s"
+                    )
+                attempts_left -= 1
+                m = _metrics()
+                if m is not None:
+                    m["resubmits"].inc(
+                        1, {"deployment": self._router._name}
+                    )
+                # Report the dead replica FIRST so the controller starts the
+                # replacement during the backoff sleep, not after it.
+                self._router.report_failure(self._replica_id)
+                delay = next(delays, 0.0)
+                if deadline is not None:
+                    delay = min(delay, max(0.0, deadline - time.monotonic()))
+                if delay > 0:
+                    time.sleep(delay)
+                method, args, kwargs = self._request
+                self.ref, self._replica_id = self._router.route(
+                    method, args, kwargs, force_refresh=True
+                )
 
 
 class _ReplicaStream:
     """One streaming call to a replica: pulls values off the core
-    ObjectRefGenerator, retries ONCE on another replica if the chosen one died
-    before producing anything, and releases the router's stream load unit when
-    the stream ends, errors, or is closed."""
+    ObjectRefGenerator, resubmits on another replica under the unified retry
+    policy (`serve_resubmit_attempts` bounded attempts with seeded backoff,
+    counted in `ray_tpu_serve_resubmit_total`) if the chosen one died before
+    producing anything, and releases the router's stream load unit when the
+    stream ends, errors, or is closed. Mid-stream death (items already
+    delivered) is never transparently retried."""
 
     def __init__(self, router: Router, method_name: str, args, kwargs,
                  raw_method: bool = False):
+        from ray_tpu._private import retry
+        from ray_tpu._private.config import get_config
+
         self._router = router
         self._call = (method_name, args, kwargs, raw_method)
         self._gen, self._rid = router.route(
             method_name, args, kwargs, stream=True, raw_method=raw_method
         )
         self._got_first = False
-        self._retried = False
+        cfg = get_config()
+        self._resubmits_left = max(0, int(cfg.serve_resubmit_attempts))
+        self._delays = retry.backoff_delays(
+            retry.RetryPolicy.from_config(
+                cfg, max_attempts=self._resubmits_left + 1
+            ),
+            seed=retry.seed_from(self._rid or ""),
+        )
         self._done = False
 
     @property
@@ -393,14 +438,22 @@ class _ReplicaStream:
                 self._finish()
                 return None
             except (RayActorError, WorkerCrashedError):
-                if self._got_first or self._retried:
+                if self._got_first or self._resubmits_left <= 0:
                     # Mid-stream death is not transparently retryable (items
                     # already delivered); surface it.
                     self._finish()
                     raise
-                self._retried = True
+                self._resubmits_left -= 1
+                m = _metrics()
+                if m is not None:
+                    m["resubmits"].inc(1, {"deployment": self._router._name})
+                # Report first (controller starts the replacement during the
+                # backoff sleep), then back off, then re-route.
                 self._router.report_failure(self._rid)
                 self._router.stream_done(self._rid)
+                delay = next(self._delays, 0.0)
+                if delay > 0:
+                    time.sleep(delay)
                 method, args, kwargs, raw = self._call
                 self._gen, self._rid = self._router.route(
                     method, args, kwargs, force_refresh=True,

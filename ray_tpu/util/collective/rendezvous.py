@@ -19,13 +19,32 @@ def wait_for(kv, key: bytes, timeout: float = None) -> bytes:
         from ray_tpu._private.config import get_config
 
         timeout = float(get_config().collective_timeout_s)
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        value = kv("get", key)
+    # Unified retry policy: backoff 5ms -> 250ms with key-seeded jitter under
+    # the timeout budget (was a fixed 50ms poll). Only INJECTED handler
+    # faults (chaos schedules) count as transient and retry in budget —
+    # connection-level errors mean the control plane is gone and the client
+    # conn never heals, so they propagate immediately (hanging every rank
+    # for collective_timeout_s on a dead head would be strictly worse).
+    # Seeded via retry.seed_from (stable across processes, unlike hash()).
+    from ray_tpu._private import failpoints, retry
+
+    policy = retry.RetryPolicy(
+        max_attempts=1_000_000, base_delay_s=0.005, max_delay_s=0.25,
+        multiplier=1.6, deadline_s=timeout,
+    )
+    last_err = None
+    transient = (failpoints.FailpointInjected,)
+    for _ in retry.attempts(policy, seed=retry.seed_from(key)):
+        try:
+            value = kv("get", key)
+        except transient as e:
+            last_err = e
+            continue
         if value:
             return value
-        time.sleep(0.05)
-    raise TimeoutError(f"rendezvous on {key!r} timed out after {timeout}s")
+    raise TimeoutError(
+        f"rendezvous on {key!r} timed out after {timeout}s"
+    ) from last_err
 
 
 def clear(kv, key: bytes) -> None:

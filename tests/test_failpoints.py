@@ -1,0 +1,509 @@
+"""Chaos matrix: failpoint x workload, deterministic by construction.
+
+Every matrix combo runs TWICE with the same seeded schedule and must produce
+the same outcome summary; combos whose failpoint hits happen in this process
+on a deterministic hit sequence (scheduler command drains, driver-side
+segment reads) additionally assert byte-identical injection traces
+(`failpoints.trace()` — the replay contract). Worker-side fires (crash
+stages, env-armed schedules) are deterministic per process but their traces
+live in the worker; those combos assert deterministic recovery outcomes.
+
+Notes on schedule design (real semantics the matrix documents):
+ - a worker crash kills the worker's whole in-flight window INCLUDING
+   completed-but-unflushed batched dones, so dense crash schedules over deep
+   pipelines amplify; matrix combos run worker_pipeline_depth=1 so each
+   injected crash costs exactly one attempt;
+ - `drop` on non-idempotent control frames (a done, a submit) is a designed
+   hang — the control plane assumes reliable FIFO pipes; recoverable drop
+   targets are heartbeats (detector catches the silence) and `sched.send`
+   errors (the send-failure death path retries).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import failpoints
+from ray_tpu._private.worker import global_worker
+
+SYS_CFG = {
+    # File segments so object.lose_segment can unlink bytes under a reader.
+    "use_native_object_arena": False,
+    # One injected crash == one lost attempt (see module docstring).
+    "worker_pipeline_depth": 1,
+}
+
+
+# --------------------------------------------------------------- workloads
+def _tasks_recover():
+    @ray_tpu.remote(max_retries=8)
+    def sq(i):
+        time.sleep(0.01)
+        return i * i
+
+    out = ray_tpu.get([sq.remote(i) for i in range(10)], timeout=120)
+    return ("tasks", out)
+
+
+def _tasks_injected_submit():
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(9)]
+    outcome = []
+    for r in refs:
+        try:
+            outcome.append(("ok", ray_tpu.get(r, timeout=60)))
+        except failpoints.FailpointInjected:
+            outcome.append(("injected", None))
+    return ("submit", outcome)
+
+
+def _reconstruct_get():
+    @ray_tpu.remote(max_retries=4)
+    def big():
+        return np.arange(50_000)
+
+    ref = big.remote()
+    v1 = ray_tpu.get(ref, timeout=60)
+    failpoints.arm("object.lose_segment", "lose")  # one-shot, driver-side
+    v2 = ray_tpu.get(ref, timeout=60)
+    return ("reconstruct", bool((v1 == v2).all()))
+
+
+def _put_lost():
+    ref = ray_tpu.put(np.zeros(50_000))
+    _ = ray_tpu.get(ref)
+    failpoints.arm("object.lose_segment", "lose")
+    with pytest.raises(ray_tpu.exceptions.ObjectLostError):
+        ray_tpu.get(ref, timeout=30)
+    return ("put_lost", True)
+
+
+def _worker_arg_fetch():
+    # Loss fires in the CONSUMER worker's arg fetch (env-armed): its
+    # fetch_value retry reconstructs the producer's output from lineage.
+    @ray_tpu.remote(max_retries=4)
+    def produce():
+        return np.ones(50_000)
+
+    @ray_tpu.remote(max_retries=4)
+    def consume(a):
+        return float(a.sum())
+
+    return ("args", ray_tpu.get(consume.remote(produce.remote()), timeout=120))
+
+
+def _actor_restart():
+    @ray_tpu.remote(max_restarts=1)
+    class A:
+        def __init__(self):
+            self.n = 0
+
+        def ping(self):
+            self.n += 1
+            return self.n
+
+        def arm_crash(self):
+            # Programmatic in-replica arming: this very call's exec_end hook
+            # fires the crash, so the call dies mid-flight and the actor
+            # restarts (fresh process, nothing armed).
+            from ray_tpu._private import failpoints as fp
+
+            fp.arm("worker.crash_after_exec_end", "crash")
+            return True
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(a.arm_crash.remote(), timeout=60)
+    # Restarted actor serves again (fresh state: __init__ re-ran).
+    deadline = time.time() + 60
+    value = None
+    while time.time() < deadline:
+        try:
+            value = ray_tpu.get(a.ping.remote(), timeout=10)
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            time.sleep(0.2)
+    return ("actor", value)
+
+
+def _serve_resubmit():
+    from ray_tpu import serve
+    from ray_tpu._private import telemetry
+
+    @serve.deployment(num_replicas=2)
+    class D:
+        def __call__(self, x):
+            # One-shot cluster-wide replica kill (KV-flagged): the first
+            # request's replica hard-exits mid-call — the worker-crash fault
+            # class — and the resubmit policy fails the request over.
+            from ray_tpu._private.worker import global_worker
+
+            ctx = global_worker.context
+            if ctx.kv("get", b"serve_boom") is None:
+                ctx.kv("put", b"serve_boom", b"1")
+                os._exit(1)
+            return x * 2
+
+    handle = serve.run(D.bind(), _blocking_http=False)
+    before = sum(telemetry.router_metrics()["resubmits"]._values.values())
+    out = handle.remote(21).result(timeout=90)
+    after = sum(telemetry.router_metrics()["resubmits"]._values.values())
+    serve.shutdown()
+    return ("serve", out, after > before)
+
+
+def _rendezvous():
+    from ray_tpu.util.collective import rendezvous
+
+    @ray_tpu.remote(max_retries=4)
+    def publisher():
+        import time as t
+
+        from ray_tpu._private.worker import global_worker
+
+        t.sleep(0.2)
+        global_worker.context.kv("put", b"rdv_key", b"payload")
+        return True
+
+    ref = publisher.remote()
+
+    def kv(op, *args):
+        return global_worker.context.kv(op, *args)
+
+    value = rendezvous.wait_for(kv, b"rdv_key", timeout=60)
+    ray_tpu.get(ref, timeout=60)
+    fired = [t for t in failpoints.trace() if t[0] == "sched.cmd.kv"]
+    return ("rendezvous", value, bool(fired))
+
+
+# ----------------------------------------------------------------- matrix
+# (id, env_schedule_or_None, programmatic_arm_or_None, workload,
+#  trace_deterministic) — env schedules arm spawned workers; programmatic
+# arming targets driver/scheduler-side seams in THIS process.
+MATRIX = [
+    # Worker execution-stage crashes x tasks: every worker's 2nd exec dies
+    # at the given stage; retries recover.
+    ("tasks-crash-before-args",
+     "worker.crash_before_args_fetched=crash@nth:2", None,
+     _tasks_recover, False),
+    ("tasks-crash-after-exec",
+     "worker.crash_after_exec_end=crash@nth:2", None,
+     _tasks_recover, False),
+    ("tasks-crash-before-store",
+     "worker.crash_before_result_stored=crash@nth:2", None,
+     _tasks_recover, False),
+    # Scheduler handler crash mid-drain x tasks: every 3rd submit raises;
+    # typed FailpointInjected surfaces through the return refs, others run.
+    # Hit sequence == submit order -> trace is byte-identical across runs.
+    ("tasks-sched-cmd-submit", None,
+     lambda: failpoints.arm("sched.cmd.submit", "error", trigger="nth", nth=3),
+     _tasks_injected_submit, True),
+    # Head-side send failure x tasks: every 12th outbound send "fails", the
+    # send-failure death path reaps the worker, retries recover.
+    ("tasks-sched-send-error", None,
+     lambda: failpoints.arm("sched.send", "error", trigger="nth", nth=7),
+     _tasks_recover, False),
+    # Worker-side abrupt connection close mid-stream x tasks: every 4th
+    # coalesced flush closes the worker's socket (peer sees real EOF).
+    ("tasks-conn-close",
+     "batch.flush=close@nth:4", None,
+     _tasks_recover, False),
+    # Segment loss under the DRIVER reader x reconstruction.
+    ("reconstruct-lose-segment", None, None, _reconstruct_get, True),
+    # Segment loss on a put object: no lineage -> typed ObjectLostError.
+    ("put-lose-segment", None, None, _put_lost, True),
+    # Segment loss under a WORKER's arg fetch x reconstruction.
+    ("args-lose-segment",
+     "object.lose_segment=lose@once", None,
+     _worker_arg_fetch, False),
+    # Actor worker crash (programmatically armed in-replica) x restart.
+    ("actor-crash-restart", None, None, _actor_restart, False),
+    # Replica death mid-request x Serve resubmit policy (+ metric).
+    ("serve-replica-death", None, None, _serve_resubmit, False),
+    # Injected scheduler kv faults x collective rendezvous retry policy.
+    ("rendezvous-kv-error", None,
+     lambda: failpoints.arm("sched.cmd.kv", "error", trigger="nth", nth=2),
+     _rendezvous, False),
+]
+
+
+def _run_combo(env_spec, arm, workload):
+    failpoints.reset()
+    if env_spec:
+        os.environ["RAY_TPU_FAILPOINTS"] = env_spec
+    try:
+        ray_tpu.init(num_cpus=2, _system_config=dict(SYS_CFG))
+        if arm is not None:
+            arm()
+        result = workload()
+        return result, failpoints.trace()
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            failpoints.reset()
+            os.environ.pop("RAY_TPU_FAILPOINTS", None)
+
+
+@pytest.mark.parametrize(
+    "env_spec,arm,workload,det_trace",
+    [m[1:] for m in MATRIX],
+    ids=[m[0] for m in MATRIX],
+)
+def test_chaos_matrix(env_spec, arm, workload, det_trace):
+    r1, t1 = _run_combo(env_spec, arm, workload)
+    r2, t2 = _run_combo(env_spec, arm, workload)
+    assert r1 == r2, f"outcome diverged across seeded runs: {r1} vs {r2}"
+    if det_trace:
+        assert t1, "deterministic combo never fired its failpoint"
+        assert t1 == t2, f"injection trace diverged: {t1} vs {t2}"
+
+
+# ------------------------------------------------- exception taxonomy
+def _taxonomy_worker_crash():
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        return 1  # crash injected at exec_end by the env schedule
+
+    ray_tpu.get(die.remote(), timeout=60)
+
+
+def _taxonomy_put_lost():
+    ref = ray_tpu.put(np.zeros(50_000))
+    _ = ray_tpu.get(ref)
+    failpoints.arm("object.lose_segment", "lose")
+    ray_tpu.get(ref, timeout=30)
+
+
+def _taxonomy_actor_died():
+    @ray_tpu.remote(max_restarts=0)
+    class A:
+        def boom(self):
+            from ray_tpu._private import failpoints as fp
+
+            fp.arm("worker.crash_after_exec_end", "crash")
+            return True
+
+    a = A.remote()
+    ray_tpu.get(a.boom.remote(), timeout=60)
+
+
+def _taxonomy_injected_handler():
+    failpoints.arm("sched.cmd.submit", "error")
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote(), timeout=30)
+
+
+TAXONOMY = [
+    ("worker-crash", "worker.crash_after_exec_end=crash@always",
+     _taxonomy_worker_crash, ray_tpu.exceptions.WorkerCrashedError),
+    ("object-lost", None, _taxonomy_put_lost,
+     ray_tpu.exceptions.ObjectLostError),
+    ("actor-died", None, _taxonomy_actor_died,
+     ray_tpu.exceptions.ActorDiedError),
+    ("injected-handler", None, _taxonomy_injected_handler,
+     failpoints.FailpointInjected),
+]
+
+
+@pytest.mark.parametrize(
+    "env_spec,workload,expected",
+    [t[1:] for t in TAXONOMY],
+    ids=[t[0] for t in TAXONOMY],
+)
+def test_exception_taxonomy(env_spec, workload, expected):
+    """Every injected failure class surfaces the MATCHING typed exception at
+    the API boundary — never a bare RuntimeError."""
+    failpoints.reset()
+    if env_spec:
+        os.environ["RAY_TPU_FAILPOINTS"] = env_spec
+    try:
+        ray_tpu.init(num_cpus=2, _system_config=dict(SYS_CFG))
+        with pytest.raises(expected) as exc_info:
+            workload()
+        assert type(exc_info.value) is not RuntimeError
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            failpoints.reset()
+            os.environ.pop("RAY_TPU_FAILPOINTS", None)
+
+
+# ------------------------------------------------- registry determinism
+def test_seeded_probability_replays_exactly():
+    """Same seed + same hit sequence -> identical fire/skip decisions: the
+    core determinism contract behind prob-triggered chaos schedules."""
+    failpoints.reset()
+    try:
+        failpoints.arm("unit.prob", "drop", trigger="prob", prob=0.3, seed=123)
+        for _ in range(500):
+            failpoints.fire("unit.prob")
+        t1 = failpoints.trace()
+        failpoints.reset()
+        failpoints.arm("unit.prob", "drop", trigger="prob", prob=0.3, seed=123)
+        for _ in range(500):
+            failpoints.fire("unit.prob")
+        t2 = failpoints.trace()
+        assert t1 == t2
+        assert 50 < len(t1) < 250  # ~30% of 500
+    finally:
+        failpoints.reset()
+
+
+def test_trigger_semantics_and_env_parse():
+    failpoints.reset()
+    try:
+        failpoints.parse_and_arm(
+            "a.once=error@once;b.nth=drop@nth:3;c.delay=delay:0.5;d.prob=dup@prob:1.0:9"
+        )
+        assert failpoints.armed() == ["a.once", "b.nth", "c.delay", "d.prob"]
+        assert failpoints.ENABLED
+        # once: first hit only
+        assert failpoints.fire("a.once") is not None
+        assert failpoints.fire("a.once") is None
+        # nth:3 fires on hits 3, 6, ...
+        fires = [failpoints.fire("b.nth") is not None for _ in range(6)]
+        assert fires == [False, False, True, False, False, True]
+        # delay arg parsed
+        fp = failpoints.fire("c.delay")
+        assert fp.kind == "delay" and fp.arg == 0.5
+        # prob:1.0 always fires
+        assert all(failpoints.fire("d.prob") is not None for _ in range(5))
+        # unarmed names never fire
+        assert failpoints.fire("nope") is None
+    finally:
+        failpoints.reset()
+        assert not failpoints.ENABLED
+
+
+# ------------------------------------------------- heartbeat detection
+def _hb_env(period_ms="200", threshold="3"):
+    os.environ["RAY_TPU_health_check_period_ms"] = period_ms
+    os.environ["RAY_TPU_health_check_failure_threshold"] = threshold
+
+
+def _hb_env_clear():
+    os.environ.pop("RAY_TPU_health_check_period_ms", None)
+    os.environ.pop("RAY_TPU_health_check_failure_threshold", None)
+
+
+def test_heartbeat_detects_hung_daemon_sigstop():
+    """The acceptance case: a SIGSTOP'd (not killed) node daemon keeps its
+    socket open but stops beating — the detector must declare it DEAD within
+    the configured grace, and the woken daemon rejoins as a fresh node."""
+    import signal
+
+    from ray_tpu.cluster_utils import Cluster
+
+    _hb_env()
+    cluster = None
+    try:
+        cluster = Cluster(head_node_args={"num_cpus": 1}, real=True)
+        n2 = cluster.add_node(num_cpus=2)
+        proc = cluster._daemons[n2]
+        grace = 0.2 * 3
+        t0 = time.time()
+        os.kill(proc.pid, signal.SIGSTOP)
+        detected = None
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if n2.hex() not in {n["node_id"] for n in ray_tpu.nodes()}:
+                detected = time.time() - t0
+                break
+            time.sleep(0.05)
+        os.kill(proc.pid, signal.SIGCONT)
+        assert detected is not None, "hung daemon never declared DEAD"
+        # Within grace plus scheduling slack (loop tick + drain cadence).
+        assert detected < grace + 5.0, detected
+        # The woken daemon rejoins as a fresh (differently-named) node.
+        deadline = time.time() + 20
+        rejoined = False
+        while time.time() < deadline:
+            others = [
+                n for n in ray_tpu.nodes()
+                if n["alive"] and n["labels"].get("head") != "1"
+            ]
+            if others:
+                rejoined = True
+                break
+            time.sleep(0.1)
+        assert rejoined, "SIGCONT'd daemon did not rejoin"
+    finally:
+        if cluster is not None:
+            cluster.shutdown()
+        _hb_env_clear()
+
+
+def test_heartbeat_dropped_beats_fail_over_tasks():
+    """daemon.heartbeat=drop@always (env-armed in the daemon process) is the
+    signal-free hang simulation: the node is removed within grace and its
+    pending work fails over to a healthy node."""
+    from ray_tpu.cluster_utils import Cluster
+
+    _hb_env()
+    cluster = None
+    try:
+        cluster = Cluster(head_node_args={"num_cpus": 1}, real=True)
+        healthy = cluster.add_node(num_cpus=2)  # noqa: F841 — failover target
+        os.environ["RAY_TPU_FAILPOINTS"] = "daemon.heartbeat=drop@always"
+        try:
+            mute = cluster.add_node(num_cpus=2)
+        finally:
+            os.environ.pop("RAY_TPU_FAILPOINTS", None)
+        deadline = time.time() + 20
+        removed = False
+        while time.time() < deadline:
+            if mute.hex() not in {n["node_id"] for n in ray_tpu.nodes()}:
+                removed = True
+                break
+            time.sleep(0.05)
+        assert removed, "beat-dropping daemon was never declared DEAD"
+
+        @ray_tpu.remote(max_retries=4)
+        def sq(i):
+            return i * i
+
+        out = ray_tpu.get([sq.remote(i) for i in range(6)], timeout=120)
+        assert out == [i * i for i in range(6)]
+    finally:
+        if cluster is not None:
+            cluster.shutdown()
+        _hb_env_clear()
+
+
+# ------------------------------------------------- NodeKiller satellites
+def test_node_killer_timeline_events_and_dead_guard():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.chaos import NodeKiller
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        for _ in range(4):
+            cluster.add_node(num_cpus=1)
+        # respawn=False: dead nodes are never replaced, so the guard must
+        # stop the killer at max_concurrent_dead, NOT at max_kills.
+        killer = NodeKiller(
+            cluster, interval_s=0.05, respawn=False, max_kills=10,
+            max_concurrent_dead=2,
+        ).start()
+        time.sleep(1.0)
+        killer.stop()
+        assert len(killer.kills) == 2, killer.kills
+        # Each kill landed in the unified timeline as a chaos event.
+        chaos = [e for e in ray_tpu.timeline() if e.get("cat") == "chaos"]
+        assert len(chaos) >= 2
+        assert {e["args"]["node_id"] for e in chaos} >= set(killer.kills)
+    finally:
+        cluster.shutdown()

@@ -23,8 +23,8 @@ import textwrap
 import pytest
 
 from ray_tpu.devtools import (
-    lint, pass_affinity, pass_blocking, pass_config, pass_metrics,
-    pass_protocol,
+    lint, pass_affinity, pass_blocking, pass_config, pass_failpoints,
+    pass_metrics, pass_protocol,
 )
 from ray_tpu.devtools.astutil import (
     Package, apply_allowlist, load_allowlist,
@@ -452,6 +452,42 @@ def test_metrics_plain_int_bumps_are_fine_in_hot_modules():
         hot=True,
     )
     assert violations == []
+
+
+# -------------------------------------------------------------- failpoints
+def run_failpoints(src: str, doc="`conn.send` | `sched.cmd.<method>` |"):
+    pkg = make_pkg(fix=src)
+    return pass_failpoints.run(pkg, doc_text=doc)
+
+
+def test_failpoints_documented_names_are_clean():
+    violations = run_failpoints(
+        """
+        from ray_tpu._private import failpoints
+
+        def hook(method):
+            failpoints.fire("conn.send")
+            failpoints.fire("sched.cmd." + method)   # documented prefix
+            failpoints.fire(method)                  # dynamic: skipped
+        """
+    )
+    assert violations == []
+
+
+def test_failpoints_undocumented_and_bad_names_flagged():
+    violations = run_failpoints(
+        """
+        from ray_tpu._private import failpoints
+
+        def hook():
+            failpoints.fire("not.in.the.table")
+            failpoints.maybe_crash("Bad-Name")
+        """
+    )
+    keys = sorted(v.key for v in violations)
+    assert any("undocumented.not.in.the.table" in k for k in keys)
+    assert any("name.Bad-Name" in k for k in keys)
+    assert len(violations) == 2
 
 
 # --------------------------------------------------------------- allowlist

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Chaos smoke: a seeded failpoint schedule over the mini-cluster.
+
+The fast pre-merge gate (tools/check.sh runs this between rt-lint and
+tier-1): worker crashes, injected scheduler-handler faults, and object-loss
+all recover (or surface typed errors) under one deterministic schedule.
+The full failpoint x workload matrix lives in tests/test_failpoints.py —
+this is the 30-second canary.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Worker-side schedule rides the env so spawned workers inherit it: a seeded
+# 6% chance each exec crashes after user code ran but before results stored.
+os.environ["RAY_TPU_FAILPOINTS"] = "worker.crash_after_exec_end=crash@prob:0.06:7"
+
+import numpy as np  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu._private import failpoints  # noqa: E402
+
+
+def main() -> int:
+    # worker_pipeline_depth=1: a worker crash kills exactly the running task.
+    # With deep pipelining a crash also wipes the window's BUFFERED dones
+    # (completed work whose commit message died with the process), so a dense
+    # crash schedule over instant tasks re-kills whole windows faster than
+    # retries drain — real semantics the failpoint matrix covers separately
+    # (tests/test_failpoints.py); the smoke wants convergence, not amplification.
+    ray_tpu.init(num_cpus=2, _system_config={
+        "use_native_object_arena": False,
+        "worker_pipeline_depth": 1,
+    })
+
+    # --- 1) tasks survive seeded worker crashes -------------------------------
+    @ray_tpu.remote(max_retries=8)
+    def sq(i):
+        return i * i
+
+    out = ray_tpu.get([sq.remote(i) for i in range(24)], timeout=180)
+    assert out == [i * i for i in range(24)], out
+    print("chaos-smoke: seeded worker crashes recovered")
+
+    # --- 2) lost segment under the driver reader -> lineage reconstruction ---
+    @ray_tpu.remote(max_retries=8)
+    def big():
+        return np.arange(100_000)
+
+    ref = big.remote()
+    v1 = ray_tpu.get(ref, timeout=60)
+    failpoints.arm("object.lose_segment", "lose")  # one-shot
+    v2 = ray_tpu.get(ref, timeout=60)
+    assert (v1 == v2).all()
+    print("chaos-smoke: injected segment loss reconstructed, trace:",
+          failpoints.trace())
+
+    # --- 3) injected scheduler-handler crash surfaces typed, others proceed --
+    failpoints.arm("sched.cmd.submit", "error", trigger="nth", nth=5)
+    refs = [sq.remote(i) for i in range(10)]
+    injected = ok = 0
+    for r in refs:
+        try:
+            ray_tpu.get(r, timeout=60)
+            ok += 1
+        except failpoints.FailpointInjected:
+            injected += 1
+    assert injected == 2 and ok == 8, (injected, ok)
+    print(f"chaos-smoke: sched.cmd.submit nth:5 -> {injected} typed "
+          f"injections, {ok} completions")
+    failpoints.reset()
+
+    ray_tpu.shutdown()
+    print("chaos-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

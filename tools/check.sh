@@ -12,6 +12,10 @@ if [[ "${1:-}" == "--lint-only" ]]; then
 fi
 
 echo
+echo "== chaos smoke (seeded failpoint schedule) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
+echo
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
