@@ -36,6 +36,9 @@ from typing import Dict
 REQUIRED_METRICS = (
     "task_throughput_telemetry_ratio",
     "task_throughput_invariants_ratio",
+    # Idle-profiler vs profiler-disabled throughput: the introspection layer
+    # must stay free when no profile session is running.
+    "task_throughput_profiler_ratio",
     # Failpoint hooks are compiled in permanently: the ratio guards the
     # armed-but-inert mode, and the ordinary task_throughput_async trajectory
     # guards hooks-off against the pre-failpoints baseline.

@@ -258,6 +258,30 @@ def main():
         }
     )
 
+    # ---------------------------------------------------- profiler off-path
+    # The introspection layer must be free when idle: with enable_profiler
+    # left at its default (enabled, no session running) there is no sampler
+    # thread and nothing on the task path, so throughput must match the
+    # fully-disabled knob. Ratio = idle-enabled / disabled (~1.0); a drop
+    # means the off-path grew a cost. The ordinary task_throughput_async
+    # trajectory against the pre-introspection baseline guards the absolute
+    # number.
+    # Best-of-4 alternating pairs: this workload swings >20% run-to-run on a
+    # shared 1-core host, and the ratio guard must not fire on noise.
+    prof_idle = prof_off = 0.0
+    for _ in range(4):
+        prof_idle = max(prof_idle, task_throughput({}))
+        prof_off = max(prof_off, task_throughput({"enable_profiler": False}))
+    results.append(
+        {
+            "metric": "task_throughput_profiler_ratio",
+            "value": round(prof_idle / prof_off, 3),
+            "unit": "ratio",
+            "profiler_idle_ops_s": prof_idle,
+            "profiler_disabled_ops_s": prof_off,
+        }
+    )
+
     # ------------------------------------------------- debug-invariant guards
     # RAY_TPU_DEBUG_INVARIANTS is read at import (concurrency.py), so each
     # mode needs a fresh interpreter. Off-mode decorators return the function

@@ -177,6 +177,20 @@ class Config:
     # ride back on the existing done/batch messages (no extra round trips).
     enable_timeline: bool = True
 
+    # --- live introspection (introspection.py / profiler.py / util/state) ---
+    # Cluster-wide sampling profiler (state.profile(duration_s)): per-process
+    # background samplers over sys._current_frames(), folded-stack output.
+    # False disables the whole surface — state.profile errors, the scheduler
+    # never broadcasts profile_start/stop, and no process ever starts a
+    # sampler thread (zero overhead, same contract as failpoints).
+    enable_profiler: bool = True
+    # Default sampling rate for state.profile (overridable per call).
+    profiler_hz: int = 99
+    # How long a cluster stack-dump / profile-collect fan-out waits for every
+    # peer before falling back (stacks: SIGUSR1 faulthandler out-of-band
+    # dump; both: "unavailable: <reason>" entries for silent peers).
+    introspection_timeout_s: float = 5.0
+
     # --- internal runtime metrics (util/metrics.py registry) ---
     # Instrument the scheduler loop (queue depth, dispatch wait, lease
     # occupancy), control-plane batching (flush sizes, coalesce ratio,

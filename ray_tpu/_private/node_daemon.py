@@ -228,6 +228,32 @@ class NodeDaemon:
             except ProcessLookupError:
                 pass
 
+    def _dump_worker_oob(self, token: int, worker_id_hex: str):
+        """Out-of-band stack capture for a worker that did not answer an
+        in-band dump_stacks: SIGUSR1 triggers the worker's registered
+        faulthandler dump (async-signal-safe C — works even with the GIL
+        wedged), then the dump file tails back as stacks_data. Off-thread:
+        the settle wait must not block spawn/kill commands."""
+        from ray_tpu._private import introspection
+
+        with self._lock:
+            popen = self.procs.get(worker_id_hex)
+        path = introspection.stack_file_path(self.shm_dir, worker_id_hex)
+
+        def _dump():
+            if popen is None:
+                payload = {
+                    "transport": "unavailable",
+                    "error": "worker process is not managed by this daemon "
+                             "(already reaped?)",
+                }
+            else:
+                payload = introspection.oob_dump_worker(popen.pid, path)
+            payload["worker_id"] = worker_id_hex
+            self._send(("stacks_data", token, payload))
+
+        threading.Thread(target=_dump, daemon=True, name="oob-dump").start()
+
     def _read_object(self, token: int, path: str, offset=None, length=None):
         # Off-thread: a large segment read must not block spawn/kill commands.
         # Arena objects read [offset, offset+length) of the arena file.
@@ -309,6 +335,28 @@ class NodeDaemon:
             self._spawn_worker(msg[1])
         elif kind == "kill_worker":
             self._kill_worker(msg[1])
+        elif kind == "dump_stacks":
+            from ray_tpu._private import introspection
+
+            self._send(
+                (
+                    "stacks_data",
+                    msg[1],
+                    introspection.thread_stacks(
+                        extra={"role": "daemon", "node_id": self.node_id_hex}
+                    ),
+                )
+            )
+        elif kind == "dump_worker_oob":
+            self._dump_worker_oob(msg[1], msg[2])
+        elif kind == "profile_start":
+            from ray_tpu._private import profiler
+
+            profiler.start(msg[1])
+        elif kind == "profile_stop":
+            from ray_tpu._private import profiler
+
+            self._send(("profile_data", msg[1], profiler.stop()))
         elif kind == "read_object":
             self._read_object(msg[1], msg[2], *msg[3:])
         elif kind == "delete_object":

@@ -119,6 +119,19 @@ MESSAGE_GRAMMAR = {
                "drives the ALIVE -> SUSPECT -> DEAD transitions "
                "(health_check_period_ms / health_check_failure_threshold)",
     },
+    "stacks_data": {
+        "dir": "any->head", "arity": (3, 3),
+        "readers": ("scheduler.worker", "scheduler.daemon"),
+        "doc": "(token, payload) — all-thread stack dump reply (in-band from "
+               "the peer's dispatch thread, or a daemon tailing back a "
+               "SIGUSR1 faulthandler dump for a wedged worker)",
+    },
+    "profile_data": {
+        "dir": "any->head", "arity": (3, 3),
+        "readers": ("scheduler.worker", "scheduler.daemon"),
+        "doc": "(token, payload) — sampling-profiler folded stacks reply "
+               "to a profile_stop",
+    },
     # ---- daemon -> head ---------------------------------------------------
     "worker_exit": {
         "dir": "daemon->head", "arity": (2, 2),
@@ -156,6 +169,26 @@ MESSAGE_GRAMMAR = {
         "readers": ("worker.dispatch", "daemon.dispatch"),
         "doc": "() — orderly teardown of a worker/daemon connection",
     },
+    # ---- introspection (head fan-out; see util/state.stacks/profile) ------
+    "dump_stacks": {
+        "dir": "head->any", "arity": (2, 2),
+        "readers": ("worker.dispatch", "daemon.dispatch"),
+        "doc": "(token,) — request an all-thread stack dump; the peer's "
+               "reader/dispatch thread replies stacks_data (it stays "
+               "responsive while the main thread runs user code)",
+    },
+    "profile_start": {
+        "dir": "head->any", "arity": (2, 2),
+        "readers": ("worker.dispatch", "daemon.dispatch"),
+        "doc": "(hz,) — start the process-local sampling profiler "
+               "(profiler.py); never sent when enable_profiler is off",
+    },
+    "profile_stop": {
+        "dir": "head->any", "arity": (2, 2),
+        "readers": ("worker.dispatch", "daemon.dispatch"),
+        "doc": "(token,) — stop the sampler; the peer replies profile_data "
+               "with its folded stacks",
+    },
     # ---- head -> driver ---------------------------------------------------
     "pub": {
         "dir": "head->driver", "arity": (3, 3),
@@ -184,6 +217,14 @@ MESSAGE_GRAMMAR = {
         "dir": "head->daemon", "arity": (2, 2),
         "readers": ("daemon.dispatch",),
         "doc": "(worker_id_hex,) — kill a daemon-managed worker process",
+    },
+    "dump_worker_oob": {
+        "dir": "head->daemon", "arity": (3, 3),
+        "readers": ("daemon.dispatch",),
+        "doc": "(token, worker_id_hex) — out-of-band stack capture for a "
+               "worker that did not answer dump_stacks: the daemon sends "
+               "SIGUSR1 (faulthandler dump to the worker's stack file) and "
+               "tails the file back as stacks_data",
     },
     # ---- batching ---------------------------------------------------------
     "batch": {

@@ -184,6 +184,11 @@ class WorkerHandle:
     # never a kill signal; a GIL-bound compile must not get its worker shot).
     last_heartbeat: float = field(default_factory=time.time)
     health: str = "ALIVE"
+    # Flight-recorder stack dump auto-captured at the ALIVE -> SUSPECT
+    # transition (or {"dump": {"transport": "unavailable", ...}} when the
+    # process couldn't answer) — surfaced on the node's worker entries in
+    # get_nodes so a postmortem doesn't start with log spelunking.
+    flight_recorder: Optional[dict] = None
 
     def send(self, msg) -> bool:
         if failpoints.ENABLED:
@@ -242,6 +247,10 @@ class NodeState:
     # (period * threshold silent => node removed, tasks fail over).
     last_heartbeat: float = field(default_factory=time.time)
     health: str = "ALIVE"
+    # Stack dump auto-captured when the daemon went SUSPECT (see
+    # WorkerHandle.flight_recorder); carried into the node's postmortem
+    # entry if it is later declared DEAD.
+    flight_recorder: Optional[dict] = None
 
     def utilization(self) -> float:
         """Critical-resource utilization: the max used-fraction over resource
@@ -478,6 +487,28 @@ def _acquire(avail: Dict[str, float], req: Dict[str, float]) -> None:
         avail[k] = avail.get(k, 0.0) - v
 
 
+class _Introspection:
+    """One in-flight cluster introspection fan-out (stack dump or profile
+    collect). Loop-thread-owned: created by a _cmd/_req handler, filled by
+    stacks_data/profile_data replies, finished by the reply that empties
+    `pending` or by the loop's deadline tick (which, for stack dumps, first
+    escalates silent workers to the out-of-band SIGUSR1 path)."""
+
+    __slots__ = ("kind", "results", "pending", "respond", "deadline",
+                 "oob_fired")
+
+    def __init__(self, kind: str, respond: Callable[[dict], None],
+                 deadline: float):
+        self.kind = kind            # "stacks" | "profile"
+        self.results: Dict[str, Any] = {}
+        # key -> ("worker", WorkerHandle) | ("daemon", DaemonHandle): what is
+        # still owed a reply, with enough context to escalate out-of-band.
+        self.pending: Dict[str, tuple] = {}
+        self.respond = respond
+        self.deadline = deadline
+        self.oob_fired = False
+
+
 def _release(avail: Dict[str, float], req: Dict[str, float]) -> None:
     for k, v in req.items():
         avail[k] = avail.get(k, 0.0) + v
@@ -578,6 +609,17 @@ class Scheduler:
 
         self._gc_task_summaries: "deque" = deque(maxlen=1000)
         self._reconstructing: Dict[bytes, List[Callable[[bool, Any], None]]] = {}
+        # Live-introspection fan-outs (stack dumps / profile collects):
+        # reply token -> (collection, target key), plus the collections the
+        # loop's deadline tick watches. Empty (and therefore free) unless an
+        # introspection call is actually in flight.
+        self._introspect_token = 0
+        self._introspect_pending: Dict[int, Tuple[_Introspection, str]] = {}
+        self._introspections: List[_Introspection] = []
+        # Bounded postmortems for heartbeat-DEAD daemon nodes: node entry +
+        # the flight-recorder dump captured at SUSPECT time, queryable via
+        # get_nodes(include_postmortems) after the node itself is gone.
+        self._node_postmortems: "deque" = deque(maxlen=16)
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._acceptors: List[threading.Thread] = []
@@ -978,6 +1020,10 @@ class Scheduler:
             # judged (a slow loop iteration must not false-kill live peers).
             # Self-gated by its own period, honoring sub-500ms settings.
             self._check_heartbeats(time.time())
+            # Deadline watcher for in-flight stack-dump / profile fan-outs
+            # (an empty list — the steady state — costs one attribute check).
+            if self._introspections:
+                self._tick_introspection(time.time())
             # Drain commands (a fire-and-forget submit has fut=None: the whole
             # burst is processed in ONE wakeup instead of one ack round trip
             # per submission — the pipelined-submission fast path).
@@ -1081,6 +1127,8 @@ class Scheduler:
         elif kind == "object_data":
             _, token, ok, data = msg
             self._finish_pull(token, ok, data)
+        elif kind == "stacks_data" or kind == "profile_data":
+            self._on_introspect_reply(msg[1], msg[2])
         elif kind == "memory_pressure":
             from ray_tpu._private.memory_monitor import MemorySnapshot
 
@@ -1191,8 +1239,8 @@ class Scheduler:
                         self.pending_pgs.append(pg)
         return True
 
-    def _cmd_get_nodes(self, _):
-        return [
+    def _cmd_get_nodes(self, payload=None):
+        out = [
             {
                 "node_id": n.node_id.hex(),
                 "resources": dict(n.resources),
@@ -1201,9 +1249,28 @@ class Scheduler:
                 "health": n.health,
                 "labels": dict(n.labels),
                 "num_workers": len(n.workers),
+                "flight_recorder": n.flight_recorder,
+                "workers": [
+                    {
+                        "worker_id": w.worker_id.hex(),
+                        "pid": w.process.pid,
+                        "state": w.state,
+                        "health": w.health,
+                        "actor_id": w.actor_id.hex() if w.actor_id else None,
+                        "current_task": w.current_task.hex()
+                        if w.current_task else None,
+                        "flight_recorder": w.flight_recorder,
+                    }
+                    for w in n.workers.values()
+                ],
             }
             for n in self.nodes.values()
         ]
+        if isinstance(payload, dict) and payload.get("include_postmortems"):
+            # Heartbeat-DEAD daemon nodes: gone from the live table, but the
+            # postmortem (with its flight-recorder dump) is still wanted.
+            out.extend(dict(p) for p in self._node_postmortems)
+        return out
 
     def _cmd_available_resources(self, _):
         out: Dict[str, float] = {}
@@ -1572,6 +1639,30 @@ class Scheduler:
             if stale > grace:
                 node.health = "DEAD"
                 tel.hb_dead_daemon += 1
+                # Postmortem entry: the node is about to vanish from the
+                # table, but the flight recorder captured at SUSPECT time
+                # (or its "unavailable" verdict) must stay queryable.
+                self._node_postmortems.append(
+                    {
+                        "node_id": node.node_id.hex(),
+                        "alive": False,
+                        "health": "DEAD",
+                        "postmortem": True,
+                        "died_at": now,
+                        "labels": dict(node.labels),
+                        "flight_recorder": node.flight_recorder
+                        or {
+                            "trigger": "DEAD",
+                            "captured_at": now,
+                            "dump": {
+                                "transport": "unavailable",
+                                "error": f"no heartbeat for {stale:.1f}s and "
+                                         "no stack capture completed before "
+                                         "the node was declared DEAD",
+                            },
+                        },
+                    }
+                )
                 self._publish(
                     "errors",
                     {
@@ -1588,12 +1679,26 @@ class Scheduler:
             elif stale > suspect_after and node.health == "ALIVE":
                 node.health = "SUSPECT"
                 tel.hb_suspect_daemon += 1
+                # Flight recorder: grab a stack dump the MOMENT the process
+                # goes quiet — by DEAD time there may be nothing left to ask.
+                self._capture_flight_recorder(
+                    f"daemon:{node.node_id.hex()}",
+                    node.daemon,
+                    ("daemon", node.daemon),
+                    lambda d, n=node: self._store_node_flight_recorder(n, d),
+                )
         for wh in self._workers_by_id.values():
             if wh.conn is None:
                 continue  # still connecting: spawn latency is not a hang
             if now - wh.last_heartbeat > suspect_after and wh.health == "ALIVE":
                 wh.health = "SUSPECT"
                 tel.hb_suspect_worker += 1
+                self._capture_flight_recorder(
+                    f"worker:{wh.worker_id.hex()}",
+                    wh,
+                    ("worker", wh),
+                    lambda d, w=wh: setattr(w, "flight_recorder", d),
+                )
 
     def _handle_actor_worker_death(self, wh: WorkerHandle):
         from ray_tpu.exceptions import RayActorError
@@ -1678,6 +1783,8 @@ class Scheduler:
             self._on_worker_log(wh, msg)
         elif kind == "ref_ops":
             self._apply_ref_ops(msg[1], wh.worker_id.hex())
+        elif kind == "stacks_data" or kind == "profile_data":
+            self._on_introspect_reply(msg[1], msg[2])
 
     @any_thread
     def _respond(self, wh: WorkerHandle, req_id: Optional[int], ok: bool, payload):
@@ -2863,6 +2970,120 @@ class Scheduler:
             )
         return out
 
+    # How many per-object rows memory_summary ships (aggregates always cover
+    # the WHOLE table; only the detailed listing truncates, largest-first).
+    _MEMORY_SUMMARY_TOP = 200
+
+    def _cmd_memory_summary(self, _):
+        """`ray memory` analogue over the ownership tables: every object's
+        holders/pins/location/size joined with the on-disk store state,
+        grouped by creation site, with leak suspects.
+
+        Two leak classes:
+         - table-level: objects whose every holder is a dead process and
+           that no live task pins (reached via a holder/pin/containment
+           mark-sweep from the live-process roots) — the "owner died with
+           borrowed refs outstanding" case;
+         - bytes-level (store scan, introspection.scan_store_dir): segment
+           files no live meta references — e.g. results a worker stored
+           right before crashing, whose done message never arrived.
+        """
+        from ray_tpu._private import introspection
+
+        live_holders = {self._INPROC_DRIVER}
+        live_holders.update(self._workers_by_id)
+        live_holders.update(dh.holder_id for dh in self._conn_to_driver.values())
+
+        # Mark: objects directly held by a live process, or pinned as a
+        # dependency of a task whose pins are still held.
+        reachable: set = set()
+        for key, hs in self.holders.items():
+            for h in hs:
+                # Interim "gen:<task>" holders are the scheduler's own and
+                # are swept with their stream: treat as live roots.
+                if h in live_holders or h.startswith("gen:"):
+                    reachable.add(key)
+                    break
+        for rec in self.tasks.values():
+            if not rec.pins_released:
+                reachable.update(rec.dep_ids)
+        # Sweep containment: a reachable container keeps its children alive.
+        stack = list(reachable)
+        while stack:
+            k = stack.pop()
+            for child in self.contained_pins.get(k, ()):
+                if child not in reachable:
+                    reachable.add(child)
+                    stack.append(child)
+
+        objects = []
+        shm_bytes = inline_bytes = spilled_bytes = 0
+        by_site: Dict[str, Dict[str, float]] = {}
+        known_segments: set = set()
+        known_oids: set = set()
+        for key, meta in self.object_table.items():
+            if meta.segment and meta.owns_payload:
+                if meta.spilled:
+                    spilled_bytes += meta.size
+                else:
+                    shm_bytes += meta.size
+            elif meta.segment is None:
+                inline_bytes += meta.size
+            if meta.segment:
+                known_segments.add(os.path.basename(meta.segment))
+            known_oids.add(meta.object_id.hex())
+            rec = self.tasks.get(meta.object_id.task_id)
+            site = (
+                rec.spec.name or rec.spec.func.name
+                if rec is not None else "(driver put / GC'd task)"
+            )
+            agg = by_site.setdefault(site, {"count": 0, "bytes": 0})
+            agg["count"] += 1
+            agg["bytes"] += meta.size
+            objects.append(
+                {
+                    "object_id": meta.object_id.hex(),
+                    "size": meta.size,
+                    "in_shm": meta.segment is not None,
+                    "spilled": meta.spilled,
+                    "node_id": meta.node_id.hex() if meta.node_id else None,
+                    "holders": sorted(self.holders.get(key, ())),
+                    "pins": self.pins.get(key, 0),
+                    "is_error": meta.is_error,
+                    "site": site,
+                    "leak_suspect": key not in reachable,
+                }
+            )
+        objects.sort(key=lambda o: o["size"], reverse=True)
+        leak_suspects = [o for o in objects if o["leak_suspect"]]
+        top_sites = dict(
+            sorted(by_site.items(), key=lambda kv: kv[1]["bytes"],
+                   reverse=True)[:20]
+        )
+        # On-disk join for the head's store dir (every non-daemon node
+        # shares it). Daemon nodes' bytes are covered by node_usage; their
+        # file-level scan would need a daemon round trip — out of scope.
+        scan = introspection.scan_store_dir(
+            os.path.join(self.session_dir, "shm"), known_segments, known_oids
+        )
+        return {
+            "num_objects": len(self.object_table),
+            "objects": objects[: self._MEMORY_SUMMARY_TOP],
+            "by_site": top_sites,
+            "shm_bytes": shm_bytes,
+            "inline_bytes": inline_bytes,
+            "spilled_bytes": spilled_bytes,
+            # The value ray_tpu_object_store_bytes reports; shm_bytes is the
+            # per-object reconstruction of the same quantity — the two must
+            # agree (the acceptance bar is >= 95%).
+            "gauge_bytes": float(sum(self.node_usage.values())),
+            "node_usage": {
+                nid.hex(): usage for nid, usage in self.node_usage.items()
+            },
+            "leak_suspects": leak_suspects,
+            "store_scan": scan,
+        }
+
     def _cmd_list_actors(self, _):
         return [
             {
@@ -2976,6 +3197,7 @@ class Scheduler:
             "free", "register_function", "remove_pg", "cancel", "task_events",
             "task_latency", "list_actors", "list_tasks", "list_objects",
             "get_nodes", "add_node", "remove_node", "autoscaler_state",
+            "memory_summary",
         }
     )
 
@@ -3094,6 +3316,260 @@ class Scheduler:
             respond(True, (meta, data))
         else:
             respond(False, OSError(f"remote segment read failed: {data}"))
+
+    # ------------------------------------------------------------------ introspection
+    # Cluster-wide "what is every process doing RIGHT NOW" (the `ray stack` /
+    # per-worker profiling surface): the loop thread broadcasts
+    # dump_stacks/profile_stop with per-target tokens, replies fill an
+    # _Introspection, and the loop's deadline tick escalates silent workers
+    # to the out-of-band SIGUSR1 faulthandler path (daemon-relayed for
+    # remote workers, a helper thread for head-local ones) before marking
+    # the rest "unavailable: <reason>".
+
+    # Extra window after the in-band deadline for the SIGUSR1 dump + tail.
+    _OOB_WINDOW_S = 1.5
+
+    def _introspect_targets(self) -> List[tuple]:
+        """(key, handle, descriptor) for every connected peer process."""
+        out: List[tuple] = []
+        for wh in self._workers_by_id.values():
+            if wh.conn is not None:
+                out.append((f"worker:{wh.worker_id.hex()}", wh, ("worker", wh)))
+        for daemon in self._conn_to_daemon.values():
+            out.append(
+                (f"daemon:{daemon.node_id.hex()}", daemon, ("daemon", daemon))
+            )
+        return out
+
+    def _introspect_token_for(self, coll: _Introspection, key: str) -> int:
+        """Allocate a reply token routing back to (collection, target)."""
+        self._introspect_token += 1
+        self._introspect_pending[self._introspect_token] = (coll, key)
+        return self._introspect_token
+
+    def _start_stack_collection(self, respond: Callable[[dict], None],
+                                timeout_s=None, targets=None) -> None:
+        from ray_tpu._private import introspection
+
+        timeout_s = float(timeout_s or self.config.introspection_timeout_s)
+        coll = _Introspection("stacks", respond, time.time() + timeout_s)
+        if targets is None:
+            # Full-cluster dump: include this (head) process directly — its
+            # threads ARE the control plane (scheduler loop, acceptors,
+            # driver API threads). lookup_lines=False: this runs ON the loop
+            # thread, which must not do per-frame linecache file reads.
+            coll.results["head"] = introspection.thread_stacks(
+                extra={"role": "head"}, lookup_lines=False
+            )
+            targets = self._introspect_targets()
+        for key, handle, desc in targets:
+            coll.pending[key] = desc
+            self._send_to(
+                handle, ("dump_stacks", self._introspect_token_for(coll, key))
+            )
+        self.telemetry.stack_dump_requests += len(coll.pending)
+        if coll.pending:
+            self._introspections.append(coll)
+        else:
+            respond(coll.results)
+
+    def _start_profile_collection(self, respond: Callable[[dict], None]) -> None:
+        from ray_tpu._private import profiler
+
+        timeout_s = float(self.config.introspection_timeout_s)
+        coll = _Introspection("profile", respond, time.time() + timeout_s)
+        coll.results["head"] = profiler.stop()
+        for key, handle, desc in self._introspect_targets():
+            coll.pending[key] = desc
+            self._send_to(
+                handle, ("profile_stop", self._introspect_token_for(coll, key))
+            )
+        if coll.pending:
+            self._introspections.append(coll)
+        else:
+            respond(coll.results)
+
+    @loop_thread_only
+    def _on_introspect_reply(self, token: int, payload) -> None:
+        ent = self._introspect_pending.pop(token, None)
+        if ent is None:
+            return  # late reply for a finished/abandoned collection
+        coll, key = ent
+        if key not in coll.pending:
+            return  # already resolved (e.g. in-band answer beat the OOB one)
+        del coll.pending[key]
+        coll.results[key] = payload
+        if coll.kind == "stacks":
+            transport = (
+                payload.get("transport", "inband")
+                if isinstance(payload, dict) else "inband"
+            )
+            if transport == "oob":
+                self.telemetry.stack_dumps_oob += 1
+            elif transport == "unavailable":
+                self.telemetry.stack_dumps_unavailable += 1
+            else:
+                self.telemetry.stack_dumps_inband += 1
+        self._maybe_finish_introspection(coll)
+
+    def _maybe_finish_introspection(self, coll: _Introspection) -> None:
+        if coll.pending:
+            return
+        if coll in self._introspections:
+            self._introspections.remove(coll)
+        # GC tokens still pointing here (e.g. the in-band token of a worker
+        # that was answered out-of-band).
+        stale = [t for t, (c, _k) in self._introspect_pending.items() if c is coll]
+        for t in stale:
+            del self._introspect_pending[t]
+        try:
+            coll.respond(coll.results)
+        except Exception:  # noqa: BLE001 — a dead requester must not kill the loop
+            pass
+
+    @loop_thread_only
+    def _tick_introspection(self, now: float) -> None:
+        for coll in list(self._introspections):
+            if now < coll.deadline:
+                continue
+            if coll.kind == "stacks" and not coll.oob_fired:
+                # In-band deadline passed: escalate silent WORKERS to the
+                # SIGUSR1 faulthandler path (a wedged interpreter can't run
+                # its reader thread, but faulthandler's C handler still
+                # dumps). Daemons have no out-of-band channel — they go
+                # straight to "unavailable" below if the window lapses too.
+                coll.oob_fired = True
+                fired = False
+                for key, desc in list(coll.pending.items()):
+                    fired = self._fire_oob_dump(coll, key, desc) or fired
+                if fired:
+                    coll.deadline = now + self._OOB_WINDOW_S
+                    continue
+            for key in list(coll.pending):
+                del coll.pending[key]
+                coll.results[key] = {
+                    "transport": "unavailable",
+                    "error": "no reply before the introspection deadline "
+                             "(process wedged, stopped, or gone)",
+                }
+                if coll.kind == "stacks":
+                    self.telemetry.stack_dumps_unavailable += 1
+            self._maybe_finish_introspection(coll)
+
+    def _fire_oob_dump(self, coll: _Introspection, key: str, desc) -> bool:
+        kind, obj = desc
+        if kind != "worker":
+            return False
+        wh: WorkerHandle = obj
+        node = self.nodes.get(wh.node_id)
+        if node is None:
+            return False
+        if node.daemon is not None:
+            # Remote worker: the daemon owns the pid and the shared stack
+            # file — it signals and tails back.
+            self._send_to(
+                node.daemon,
+                (
+                    "dump_worker_oob",
+                    self._introspect_token_for(coll, key),
+                    wh.worker_id.hex(),
+                ),
+            )
+            return True
+        # Head-local worker: signal + tail on a helper thread (the settle
+        # wait must not stall the loop); the result re-enters through the
+        # command queue like any off-thread event.
+        from ray_tpu._private import introspection
+
+        token = self._introspect_token_for(coll, key)
+        pid = wh.process.pid
+        path = introspection.stack_file_path(node.shm_dir, wh.worker_id.hex())
+
+        def _dump():
+            payload = introspection.oob_dump_worker(pid, path)
+            payload["worker_id"] = wh.worker_id.hex()
+            try:
+                self.call_nowait("stacks_oob_result", (token, payload))
+            except RuntimeError:
+                pass  # scheduler stopped
+        threading.Thread(target=_dump, daemon=True, name="oob-dump").start()
+        return True
+
+    def _cmd_stacks_oob_result(self, payload):
+        token, data = payload
+        self._on_introspect_reply(token, data)
+
+    def _store_node_flight_recorder(self, node: NodeState, fr: dict) -> None:
+        """A node's flight-recorder capture resolved — possibly AFTER the
+        node was declared DEAD and postmortem'd (a short grace can lapse
+        while the capture window is still open). The dump must land on the
+        postmortem entry too, or the placeholder hides a capture we have."""
+        node.flight_recorder = fr
+        node_hex = node.node_id.hex()
+        for p in self._node_postmortems:
+            if p["node_id"] == node_hex:
+                p["flight_recorder"] = fr
+
+    def _capture_flight_recorder(self, key: str, handle, desc,
+                                 store: Callable[[dict], None]) -> None:
+        """SUSPECT-transition hook: single-target stack collection whose
+        result lands on the worker/node entry instead of a caller."""
+        def respond(results: dict) -> None:
+            store({
+                "trigger": "SUSPECT",
+                "captured_at": time.time(),
+                "dump": results.get(key),
+            })
+
+        self._start_stack_collection(
+            respond,
+            timeout_s=min(float(self.config.introspection_timeout_s), 3.0),
+            targets=[(key, handle, desc)],
+        )
+
+    def _cmd_dump_stacks(self, payload):
+        timeout_s, inner = payload
+        self._start_stack_collection(inner.set_result, timeout_s)
+        return _ASYNC
+
+    def _req_dump_stacks(self, wh, req_id: int, timeout_s):
+        self._start_stack_collection(
+            lambda res: self._respond(wh, req_id, True, res), timeout_s
+        )
+
+    def _cmd_profile_start(self, hz):
+        if not self.config.enable_profiler:
+            raise RuntimeError(
+                "the sampling profiler is disabled (enable_profiler=False)"
+            )
+        from ray_tpu._private import profiler
+
+        hz = float(hz or self.config.profiler_hz)
+        profiler.start(hz)  # the head process profiles itself too
+        self.telemetry.profile_sessions += 1
+        for _key, handle, _desc in self._introspect_targets():
+            self._send_to(handle, ("profile_start", hz))
+        return True
+
+    def _req_profile_start(self, wh, req_id: int, hz):
+        self._respond(wh, req_id, True, self._cmd_profile_start(hz))
+
+    def _cmd_profile_collect(self, inner):
+        if not self.config.enable_profiler:
+            raise RuntimeError(
+                "the sampling profiler is disabled (enable_profiler=False)"
+            )
+        self._start_profile_collection(inner.set_result)
+        return _ASYNC
+
+    def _req_profile_collect(self, wh, req_id: int, _):
+        if not self.config.enable_profiler:
+            raise RuntimeError(
+                "the sampling profiler is disabled (enable_profiler=False)"
+            )
+        self._start_profile_collection(
+            lambda res: self._respond(wh, req_id, True, res)
+        )
 
     # ------------------------------------------------------------------ reconstruction
     def _req_reconstruct_object(self, wh, req_id: int, object_key: bytes):

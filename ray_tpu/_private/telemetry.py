@@ -65,6 +65,13 @@ class SchedulerTelemetry:
         self.hb_suspect_daemon = 0
         self.hb_suspect_worker = 0
         self.hb_dead_daemon = 0
+        # Live-introspection traffic (stack dumps / profiler sessions):
+        # bumped by the scheduler's fan-out machinery, materialized per tick.
+        self.stack_dump_requests = 0
+        self.stack_dumps_inband = 0
+        self.stack_dumps_oob = 0
+        self.stack_dumps_unavailable = 0
+        self.profile_sessions = 0
 
     # ---------------------------------------------------------------- ticks
     def on_iteration(self, sched, now: float) -> None:
@@ -90,6 +97,17 @@ class SchedulerTelemetry:
         self._drain_counter(m["spilled_bytes"], "spilled_bytes")
         self._drain_counter(m["out_msgs"], "out_msgs")
         self._drain_counter(m["out_frames"], "out_frames")
+        self._drain_counter(m["stack_dump_requests"], "stack_dump_requests")
+        self._drain_counter(m["profile_sessions"], "profile_sessions")
+        for attr, transport in (
+            ("stack_dumps_inband", "inband"),
+            ("stack_dumps_oob", "oob"),
+            ("stack_dumps_unavailable", "unavailable"),
+        ):
+            v = getattr(self, attr)
+            if v:
+                m["stack_dumps"].inc(v, {"transport": transport})
+                setattr(self, attr, 0)
         if self.hb_suspect_daemon:
             m["hb_suspect"].inc(self.hb_suspect_daemon, {"kind": "daemon"})
             self.hb_suspect_daemon = 0
@@ -160,6 +178,16 @@ class SchedulerTelemetry:
             "hb_dead": Counter("ray_tpu_heartbeat_dead_total",
                                "peers declared DEAD by the heartbeat "
                                "staleness detector", ("kind",)),
+            "stack_dump_requests": Counter(
+                "ray_tpu_stack_dump_requests_total",
+                "per-process stack-dump requests fanned out by the head"),
+            "stack_dumps": Counter(
+                "ray_tpu_stack_dumps_total",
+                "stack-dump outcomes by transport "
+                "(inband/oob/unavailable)", ("transport",)),
+            "profile_sessions": Counter(
+                "ray_tpu_profile_sessions_total",
+                "cluster-wide sampling-profiler sessions started"),
             "dispatch_wait": Histogram(
                 "ray_tpu_scheduler_dispatch_wait_s",
                 "queued -> lease_granted wait per task",
@@ -226,6 +254,41 @@ def ensure_batching_metrics() -> None:
             flush_size._merge_counts(deltas, d_frames, float(d_msgs))
         last.update(msgs=s["msgs"], frames=s["frames"], bytes=s["bytes"],
                     straggler_fires=s["straggler_fires"], sizes=sizes)
+
+    register_collector(collect)
+
+
+# ---------------------------------------------------------------- log shipper
+_logshipper_installed = False
+
+
+def ensure_logshipper_metrics() -> None:
+    """Expose the _LogShipper overflow counter (worker_main._LOG_STATS —
+    previously only surfaced as a '...dropped' text line in the log stream)
+    as ray_tpu_log_lines_dropped_total. Installed once per worker process
+    when the output tee goes in and metrics are enabled."""
+    global _logshipper_installed
+    if _logshipper_installed:
+        return
+    _logshipper_installed = True
+    from ray_tpu._private import worker_main
+    from ray_tpu.util.metrics import Counter, register_collector
+
+    dropped = Counter(
+        "ray_tpu_log_lines_dropped_total",
+        "worker log lines dropped by the bounded shipper queue "
+        "(backpressure on the out-of-band log channel)",
+    )
+    last = {"dropped": 0}
+
+    def collect():
+        # Snapshot once; diff and advance the cursor from the snapshot (see
+        # the batching collector for why).
+        s = worker_main._LOG_STATS["dropped"]
+        d = s - last["dropped"]
+        if d:
+            dropped.inc(d)
+        last["dropped"] = s
 
     register_collector(collect)
 

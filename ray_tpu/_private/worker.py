@@ -463,8 +463,24 @@ class DriverContext:
     def cluster_resources(self):
         return self.scheduler.call("cluster_resources", None).result()
 
-    def nodes(self):
-        return self.scheduler.call("get_nodes", None).result()
+    def nodes(self, payload=None):
+        return self.scheduler.call("get_nodes", payload).result()
+
+    def dump_stacks(self, timeout_s=None):
+        inner: concurrent.futures.Future = concurrent.futures.Future()
+        self.scheduler.call("dump_stacks", (timeout_s, inner)).result()
+        return inner.result(timeout=(timeout_s or 30.0) + 15.0)
+
+    def profile_start(self, hz=None):
+        return self.scheduler.call("profile_start", hz).result()
+
+    def profile_collect(self):
+        inner: concurrent.futures.Future = concurrent.futures.Future()
+        self.scheduler.call("profile_collect", inner).result()
+        return inner.result(timeout=60.0)
+
+    def memory_summary(self):
+        return self.scheduler.call("memory_summary", None).result()
 
     def task_events(self):
         return self.scheduler.call("task_events", None).result()
@@ -663,8 +679,22 @@ class RemoteDriverContext:
     def cluster_resources(self):
         return self.wc.request("cluster_resources", None)
 
-    def nodes(self):
-        return self.wc.request("driver_cmd", ("get_nodes", None))
+    def nodes(self, payload=None):
+        return self.wc.request("driver_cmd", ("get_nodes", payload))
+
+    def dump_stacks(self, timeout_s=None):
+        return self.wc.request(
+            "dump_stacks", timeout_s, timeout=(timeout_s or 30.0) + 15.0
+        )
+
+    def profile_start(self, hz=None):
+        return self.wc.request("profile_start", hz)
+
+    def profile_collect(self):
+        return self.wc.request("profile_collect", None, timeout=60.0)
+
+    def memory_summary(self):
+        return self.wc.request("driver_cmd", ("memory_summary", None))
 
     def task_events(self):
         return self.wc.request("driver_cmd", ("task_events", None))
@@ -808,8 +838,22 @@ class WorkerProcContext:
     def cluster_resources(self):
         return self.rt.wc.request("cluster_resources", None)
 
-    def nodes(self):
-        return self.rt.wc.request("driver_cmd", ("get_nodes", None))
+    def nodes(self, payload=None):
+        return self.rt.wc.request("driver_cmd", ("get_nodes", payload))
+
+    def dump_stacks(self, timeout_s=None):
+        return self.rt.wc.request(
+            "dump_stacks", timeout_s, timeout=(timeout_s or 30.0) + 15.0
+        )
+
+    def profile_start(self, hz=None):
+        return self.rt.wc.request("profile_start", hz)
+
+    def profile_collect(self):
+        return self.rt.wc.request("profile_collect", None, timeout=60.0)
+
+    def memory_summary(self):
+        return self.rt.wc.request("driver_cmd", ("memory_summary", None))
 
     def task_events(self):
         return self.rt.wc.request("driver_cmd", ("task_events", None))

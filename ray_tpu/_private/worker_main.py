@@ -106,6 +106,11 @@ class WorkerConnection:
         # Hook for message kinds beyond exec/resp/shutdown (e.g. a client-mode
         # driver serving "read_object" pulls for objects it put).
         self.misc_handler = None
+        # Introspection hook: returns this process's all-thread stack payload
+        # (worker_loop binds it with task annotations from the runtime). The
+        # reader thread serves dump_stacks itself — it stays responsive while
+        # the main thread runs user code, which is the whole point.
+        self.introspect_fn = None
         # Worker processes die with their control connection: once the head is
         # unreachable nothing can collect results, and a task stuck in user code
         # (e.g. a long sleep) would otherwise outlive its node daemon forever.
@@ -164,6 +169,16 @@ class WorkerConnection:
                 q = self._pending.pop(req_id, None)
             if q is not None:
                 q.put((ok, payload))
+        elif kind == "dump_stacks":
+            self.send(("stacks_data", msg[1], self._introspect_payload()))
+        elif kind == "profile_start":
+            from ray_tpu._private import profiler
+
+            profiler.start(msg[1])
+        elif kind == "profile_stop":
+            from ray_tpu._private import profiler
+
+            self.send(("profile_data", msg[1], profiler.stop()))
         elif kind == "cancel_queued":
             with self._cancelled_lock:
                 self.cancelled[msg[1]] = None
@@ -175,6 +190,17 @@ class WorkerConnection:
         elif self.misc_handler is not None:
             self.misc_handler(msg)
         return True
+
+    def _introspect_payload(self):
+        from ray_tpu._private import introspection
+
+        if self.introspect_fn is not None:
+            try:
+                return self.introspect_fn()
+            except Exception as e:  # noqa: BLE001 — a dump must never kill the reader
+                return {"transport": "inband", "error": repr(e),
+                        "pid": os.getpid(), "threads": []}
+        return introspection.thread_stacks()
 
     def reader_loop(self):
         try:
@@ -209,6 +235,12 @@ class WorkerConnection:
                 self._pending.clear()
 
 
+# Cumulative log lines dropped by this process's _LogShipper overflow path:
+# a plain int on the hot printing path, exported as
+# ray_tpu_log_lines_dropped_total by telemetry.ensure_logshipper_metrics.
+_LOG_STATS = {"dropped": 0}
+
+
 class _LogShipper:
     """Out-of-band line shipper: a bounded queue drained by a daemon thread.
 
@@ -216,7 +248,9 @@ class _LogShipper:
     task runs, the worker's reader thread is the only drainer of head->worker
     traffic, and a synchronous send from inside the task could deadlock
     against a scheduler blocked writing to this same worker. Overflow drops
-    lines (counted) rather than blocking the printer.
+    lines (counted in _LOG_STATS and surfaced both as a "...dropped" text
+    line and the ray_tpu_log_lines_dropped_total counter) rather than
+    blocking the printer.
     """
 
     MAX_LINES = 10_000
@@ -234,6 +268,7 @@ class _LogShipper:
     def enqueue(self, stream: str, task_name: str, lines) -> None:
         if len(self._q) >= self.MAX_LINES:
             self._dropped += len(lines)
+            _LOG_STATS["dropped"] += len(lines)
             return
         self._q.append((stream, task_name, lines))
         self._event.set()
@@ -322,6 +357,10 @@ def _install_output_tee(wc: "WorkerConnection", rt: "WorkerRuntime",
     shipper = _LogShipper(wc, worker_id_hex)
     sys.stdout = _TeeStream(sys.stdout, shipper, rt, "stdout")
     sys.stderr = _TeeStream(sys.stderr, shipper, rt, "stderr")
+    if rt.args.config.enable_metrics:
+        from ray_tpu._private.telemetry import ensure_logshipper_metrics
+
+        ensure_logshipper_metrics()
 
 
 class WorkerRuntime:
@@ -336,6 +375,10 @@ class WorkerRuntime:
         self.actor_id: Optional[ActorID] = None
         self.current_task_id: Optional[TaskID] = None
         self.current_task_name: str = ""
+        # thread ident -> task/method name executing there, for stack-dump
+        # annotation (threaded actors run several at once; the map says which
+        # thread carries which call).
+        self.executing: Dict[int, str] = {}
         self._put_counter = 0
         # Threaded actors (max_concurrency > 1): calls drain through a bounded
         # pool of daemon threads, out of submission order (reference: threaded
@@ -525,6 +568,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
     spec = req.spec
     rt.current_task_id = spec.task_id
     rt.current_task_name = spec.name or spec.func.name
+    rt.executing[threading.get_ident()] = rt.current_task_name
     # Put-id minting and lineage attribution key off the module-level worker
     # state too (per-thread: threaded actors run concurrent calls).
     worker_mod.global_worker.current_task_id = spec.task_id
@@ -693,6 +737,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
 
             tracing.end_span(exec_span)
         rt.stream_progress.pop(spec.task_id.binary(), None)
+        rt.executing.pop(threading.get_ident(), None)
         rt.current_task_id = None
         worker_mod.global_worker.current_task_id = None
 
@@ -707,6 +752,28 @@ def worker_loop(conn, args: WorkerArgs):
     wc = WorkerConnection(conn)
     wc.exit_on_eof = True
     rt = WorkerRuntime(args, wc)
+
+    # Live introspection: in-band stack dumps served by the reader thread
+    # (annotated with the task each thread is executing), plus the SIGUSR1
+    # faulthandler fallback for when even the reader can't run (GIL wedged):
+    # the daemon/head signals and tails the per-worker stack file back.
+    from ray_tpu._private import introspection
+
+    def _introspect():
+        return introspection.thread_stacks(
+            extra={
+                "role": "worker",
+                "worker_id": args.worker_id_hex,
+                "node_id": args.node_id_hex,
+                "current_task": rt.current_task_name or None,
+            },
+            executing=dict(rt.executing),
+        )
+
+    wc.introspect_fn = _introspect
+    introspection.register_oob_dump(
+        introspection.stack_file_path(args.shm_dir, args.worker_id_hex)
+    )
 
     # Bind the module-level API (ray_tpu.get/put/remote/...) to this worker.
     from ray_tpu._private import worker as worker_mod

@@ -17,14 +17,24 @@ class DashboardServer:
         self._started = threading.Event()
         self._loop = None
 
+    # Every kind `/api/{kind}` serves; the 404 for anything else lists them.
+    VALID_KINDS = (
+        "actors", "cluster", "jobs", "memory", "nodes", "objects", "profile",
+        "stacks", "tasks", "timeline",
+    )
+    # Ceiling on `/api/profile?duration=` (the handler blocks an executor
+    # thread for the duration).
+    MAX_PROFILE_DURATION_S = 60.0
+
     # ------------------------------------------------------------- handlers
-    def _payload(self, kind: str, limit: Optional[int] = None):
+    def _payload(self, kind: str, limit: Optional[int] = None,
+                 duration: Optional[float] = None):
         from ray_tpu.util import state as state_api
 
         if kind == "cluster":
             return state_api.summarize()
         if kind == "nodes":
-            return state_api.list_nodes()
+            return state_api.list_nodes(include_postmortems=True)
         if kind == "actors":
             return state_api.list_actors()
         if kind == "tasks":
@@ -35,6 +45,16 @@ class DashboardServer:
             # Unified chrome trace (task stages + spans + collectives):
             # save the JSON and load it at chrome://tracing / Perfetto.
             return state_api.timeline()
+        if kind == "stacks":
+            # Live all-thread stacks from every process (`ray stack`).
+            return state_api.stacks()
+        if kind == "memory":
+            # Ownership/refcount attribution + leak suspects (`ray memory`).
+            return state_api.memory_summary()
+        if kind == "profile":
+            # Cluster-wide sampling profile; blocks this executor thread
+            # for ?duration= seconds (default 1).
+            return state_api.profile(duration if duration is not None else 1.0)
         if kind == "jobs":
             from ray_tpu.job_submission import JobSubmissionClient
 
@@ -45,6 +65,14 @@ class DashboardServer:
         from aiohttp import web
 
         kind = request.match_info["kind"]
+        if kind not in self.VALID_KINDS:
+            return web.json_response(
+                {
+                    "error": f"unknown endpoint {kind!r}",
+                    "valid": list(self.VALID_KINDS),
+                },
+                status=404,
+            )
         limit = None
         raw_limit = request.query.get("limit")
         if raw_limit is not None:
@@ -54,11 +82,24 @@ class DashboardServer:
                 return web.json_response(
                     {"error": f"invalid limit {raw_limit!r}"}, status=400
                 )
+        duration = None
+        raw_duration = request.query.get("duration")
+        if raw_duration is not None:
+            try:
+                duration = min(
+                    max(0.0, float(raw_duration)), self.MAX_PROFILE_DURATION_S
+                )
+            except ValueError:
+                return web.json_response(
+                    {"error": f"invalid duration {raw_duration!r}"}, status=400
+                )
         loop = asyncio.get_event_loop()
         try:
-            payload = await loop.run_in_executor(None, self._payload, kind, limit)
-        except KeyError:
-            return web.json_response({"error": f"unknown endpoint {kind}"}, status=404)
+            payload = await loop.run_in_executor(
+                None, self._payload, kind, limit, duration
+            )
+        except Exception as e:  # noqa: BLE001 — e.g. profiler disabled
+            return web.json_response({"error": str(e)}, status=503)
         return web.json_response(json.loads(json.dumps(payload, default=str)))
 
     async def _metrics(self, _request):

@@ -161,6 +161,77 @@ def cmd_timeline(ns):
     print(f"wrote {len(events)} events to {ns.output}")
 
 
+# ------------------------------------------------------------ introspection
+def cmd_stack(ns):
+    """`ray stack` analogue: all-thread stacks from every live process,
+    each thread annotated with the task it is executing."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    dumps = state_api.stacks(ns.timeout)
+    for key in sorted(dumps):
+        d = dumps[key] or {}
+        print(f"=== {key} (pid={d.get('pid')}, "
+              f"transport={d.get('transport', 'inband')}) ===")
+        if d.get("transport") == "unavailable":
+            print(f"  unavailable: {d.get('error')}")
+        elif d.get("transport") == "oob":
+            print(d.get("raw", ""), end="")
+        else:
+            for th in d.get("threads", ()):
+                task = f"  [task: {th['task']}]" if th.get("task") else ""
+                print(f"--- thread {th.get('name')} "
+                      f"(id={th.get('thread_id')}){task}")
+                print(th.get("stack", ""), end="")
+        print()
+
+
+def cmd_memory(ns):
+    """`ray memory` analogue: ownership/refcount attribution, top sites,
+    leak suspects, and the store-dir byte join."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    s = state_api.memory_summary()
+    if ns.json:
+        print(json.dumps(s, indent=2, default=str))
+        return
+    print(f"objects: {s['num_objects']}  shm: {s['shm_bytes']} B  "
+          f"inline: {s['inline_bytes']} B  spilled: {s['spilled_bytes']} B  "
+          f"(gauge: {s['gauge_bytes']:.0f} B)")
+    print("\ntop creation sites:")
+    for site, agg in s["by_site"].items():
+        print(f"  {site:40s} {agg['count']:>6} objs {agg['bytes']:>14} B")
+    if s["leak_suspects"]:
+        print("\nLEAK SUSPECTS (only dead processes reference these):")
+        for o in s["leak_suspects"]:
+            print(f"  {o['object_id']} {o['size']} B site={o['site']} "
+                  f"holders={o['holders']}")
+    scan = s["store_scan"]
+    if scan.get("leaked"):
+        print(f"\nLEAKED STORE BYTES ({scan['leaked_bytes']} B unreferenced "
+              f"in {scan['dir']}):")
+        for e in scan["leaked"]:
+            print(f"  {e['path']} {e['bytes']} B ({e['kind']})")
+
+
+def cmd_profile(ns):
+    """Cluster-wide sampling profile; folded stacks to --output (flamegraph.pl
+    / speedscope input) or stdout."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    res = state_api.profile(ns.duration, hz=ns.hz)
+    text = res["flamegraph"]
+    if ns.output:
+        with open(ns.output, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {len(res['folded'])} folded stacks "
+              f"({res['samples']} samples) to {ns.output}")
+    else:
+        print(text)
+
+
 def cmd_microbenchmark(_ns):
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     sys.path.insert(0, repo_root)
@@ -230,6 +301,25 @@ def main(argv=None) -> None:
     sp.add_argument("--output", default="timeline.json")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("stack", help="all-thread stack dump of every live process")
+    sp.add_argument("--timeout", type=float, default=None,
+                    help="per-process reply deadline before the out-of-band "
+                         "faulthandler fallback")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("memory", help="object ownership/refcount attribution")
+    sp.add_argument("--json", action="store_true", help="raw JSON output")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("profile", help="cluster-wide sampling profile")
+    sp.add_argument("--duration", type=float, default=1.0)
+    sp.add_argument("--hz", type=float, default=None)
+    sp.add_argument("--output", help="write folded stacks to this file")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("microbenchmark", help="run the core microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)

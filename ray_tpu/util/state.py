@@ -27,14 +27,116 @@ STAGE_INTERVALS = (
 )
 
 
-def list_nodes() -> List[Dict[str, Any]]:
+def list_nodes(include_postmortems: bool = False) -> List[Dict[str, Any]]:
+    """Node table with per-worker health and any flight-recorder stack dump
+    the heartbeat detector captured at a SUSPECT transition.
+    `include_postmortems` appends entries for daemon nodes the detector
+    declared DEAD (alive=False, postmortem=True) with the dump captured
+    before they vanished."""
     _auto_init()
-    return global_worker.context.nodes()
+    return global_worker.context.nodes(
+        {"include_postmortems": True} if include_postmortems else None
+    )
 
 
 def list_actors() -> List[Dict[str, Any]]:
     _auto_init()
     return global_worker.context.list_actors()
+
+
+# ------------------------------------------------------------- introspection
+def stacks(timeout_s: float | None = None) -> Dict[str, Dict[str, Any]]:
+    """All-thread stacks from every live process RIGHT NOW — the `ray stack`
+    analogue. Returns {"head": payload, "worker:<id>": payload,
+    "daemon:<node>": payload}; each payload carries per-thread formatted
+    stacks with the task/actor-method the thread is executing. Workers whose
+    reader thread can't answer (GIL wedged) are retried out-of-band via a
+    SIGUSR1 faulthandler dump (transport="oob"); processes that can't even
+    do that come back as transport="unavailable" with the reason."""
+    _auto_init()
+    return global_worker.context.dump_stacks(timeout_s)
+
+
+def memory_summary() -> Dict[str, Any]:
+    """`ray memory` analogue: per-object owner/refcount/location/size from
+    the scheduler's ownership tables joined with the on-disk store state,
+    grouped by creation site, with leak suspects (objects whose only
+    references live on dead processes) and a store-dir scan flagging bytes
+    no live object references (e.g. results stored by a worker that crashed
+    before reporting them)."""
+    _auto_init()
+    return global_worker.context.memory_summary()
+
+
+# Chrome-trace events of the most recent profile() run, merged into
+# timeline() so one trace shows tasks, spans, collectives AND samples.
+# Stamped with the session generation: a shutdown()/init() cycle must not
+# leak a previous session's samples into the new session's timeline.
+_last_profile_chrome: List[Dict[str, Any]] = []
+_last_profile_session: Optional[int] = None
+
+
+def profile(duration_s: float = 1.0, hz: float | None = None) -> Dict[str, Any]:
+    """Cluster-wide sampling profile: start per-process samplers everywhere,
+    wait `duration_s`, collect and merge. Returns {"folded": {stack: count}
+    keyed "<process>;<thread>;frame;...;frame" (flamegraph.pl / speedscope
+    input), "flamegraph": the same as text lines, "chrome_trace": chrome
+    events (also merged into the next timeline() call), "per_process": raw
+    payloads}. Requires Config.enable_profiler (default on; when off this
+    raises and no profiling traffic is ever sent)."""
+    import time as _time
+
+    from ray_tpu._private.config import get_config
+
+    _auto_init()
+    ctx = global_worker.context
+    hz = float(hz or get_config().profiler_hz)
+    ctx.profile_start(hz)
+    _time.sleep(max(0.0, float(duration_s)))
+    per_process = ctx.profile_collect()
+
+    merged: Dict[str, int] = {}
+    chrome: List[Dict[str, Any]] = []
+    total_samples = 0
+    for proc_key in sorted(per_process):
+        payload = per_process[proc_key]
+        if not isinstance(payload, dict):
+            continue
+        folded = payload.get("folded") or {}
+        total_samples += int(payload.get("samples") or 0)
+        started = payload.get("started_at")
+        proc_hz = float(payload.get("hz") or hz)
+        for stack, count in folded.items():
+            key = f"{proc_key};{stack}"
+            merged[key] = merged.get(key, 0) + count
+            if started:
+                frames = stack.split(";")
+                chrome.append(
+                    {
+                        "name": frames[-1] if frames else stack,
+                        "cat": "profile",
+                        "ph": "X",
+                        "ts": int(started * 1e6),
+                        "dur": max(1, int(count / proc_hz * 1e6)),
+                        "pid": proc_key,
+                        "tid": frames[0] if frames else "?",
+                        "args": {"stack": stack, "samples": count},
+                    }
+                )
+    global _last_profile_chrome, _last_profile_session
+    _last_profile_chrome = chrome
+    _last_profile_session = global_worker._session_gen
+    return {
+        "folded": merged,
+        "flamegraph": "\n".join(
+            f"{k} {v}" for k, v in sorted(merged.items())
+        ),
+        "chrome_trace": chrome,
+        "samples": total_samples,
+        "hz": hz,
+        "duration_s": float(duration_s),
+        "per_process": per_process,
+    }
 
 
 def _monotonic_stages(stages: Dict[str, float]) -> Dict[str, float]:
@@ -196,6 +298,11 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     _auto_init()
     events = _task_timeline_events(global_worker.context.task_events())
     events.extend(tracing.chrome_trace())
+    # Samples from the most recent profile() run ride the same trace, so
+    # task intervals and where-the-CPU-went line up on one timeline —
+    # same-session runs only (the stamp goes stale on shutdown/init).
+    if _last_profile_session == global_worker._session_gen:
+        events.extend(_last_profile_chrome)
     events.sort(key=lambda e: (e["ts"], e.get("dur", 0)))
     if filename:
         with open(filename, "w") as f:

@@ -115,6 +115,15 @@ def test_dashboard_rest_and_metrics(ray_start_regular):
             assert time.time() < deadline, "scheduler metrics never exported"
             time.sleep(0.2)
             text = urllib.request.urlopen(f"{base}/metrics", timeout=15).read().decode()
+        # Live introspection endpoints ride the same REST surface.
+        stacks = json.loads(
+            urllib.request.urlopen(f"{base}/api/stacks", timeout=30).read()
+        )
+        assert "head" in stacks and stacks["head"]["threads"]
+        memory = json.loads(
+            urllib.request.urlopen(f"{base}/api/memory", timeout=15).read()
+        )
+        assert "shm_bytes" in memory and "leak_suspects" in memory
         # The live web UI: self-contained page whose JS polls the REST
         # endpoints the assertions above proved live — node/actor/task/job
         # tables plus the refresh loop (reference: dashboard/client SPA).
@@ -123,9 +132,16 @@ def test_dashboard_rest_and_metrics(ray_start_regular):
         for table in ("nodes-table", "actors-table", "tasks-table", "jobs-table"):
             assert f'id="{table}"' in html, table
         assert "/api/cluster" in html and "setInterval(refresh" in html
-        assert urllib.request.urlopen(f"{base}/api/nope", timeout=15)
-    except urllib.error.HTTPError as e:
-        assert e.code == 404
+        # Unknown kinds: a JSON 404 naming the valid ones, not a bare error.
+        try:
+            urllib.request.urlopen(f"{base}/api/nope", timeout=15)
+            raise AssertionError("unknown kind must 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            body = json.loads(e.read())
+            assert "nope" in body["error"]
+            for kind in ("cluster", "stacks", "memory", "profile", "tasks"):
+                assert kind in body["valid"], body
     finally:
         server.stop()
 

@@ -148,6 +148,22 @@ def test_cli_start_status_list_stop(tmp_path):
         assert r.returncode == 0, r.stdout
         assert len(json.loads(r.stdout)) == 1
 
+        # Introspection subcommands (COMPONENTS.md "Introspection"): a
+        # stack dump always includes the head's own threads, and the memory
+        # summary renders its accounting header.
+        r = cli("stack")
+        assert r.returncode == 0, r.stdout
+        assert "=== head" in r.stdout and "thread" in r.stdout
+
+        r = cli("memory")
+        assert r.returncode == 0, r.stdout
+        assert "objects:" in r.stdout and "top creation sites" in r.stdout
+
+        prof_out = tmp_path / "prof.folded"
+        r = cli("profile", "--duration", "0.5", "--output", str(prof_out))
+        assert r.returncode == 0, r.stdout
+        assert "folded stacks" in r.stdout and prof_out.exists()
+
         script = tmp_path / "cli_job.py"
         script.write_text("print('cli job ran')\n")
         r = cli("job", "submit", "--entrypoint", f"{sys.executable} {script}", "--wait")

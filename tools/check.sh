@@ -16,6 +16,10 @@ echo "== chaos smoke (seeded failpoint schedule) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
 echo
+echo "== introspection smoke (stacks + memory + profile on a mini-cluster) =="
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/introspect_smoke.py
+
+echo
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
