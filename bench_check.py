@@ -47,6 +47,34 @@ REQUIRED_METRICS = (
     "worker_kill_recovery_s",
 )
 
+# Data-plane suite (bench_dataplane.py -> BENCH_DATAPLANE.json): the
+# peer-to-peer object plane's acceptance contract.
+REQUIRED_METRICS_DATAPLANE = (
+    "get_10MB_relay_MBps",
+    "get_10MB_peer_MBps",
+    "multi_puller_aggregate_relay_GBps",
+    "multi_puller_aggregate_GBps",
+    "locality_hit_rate",
+    "transfer_speedup_10MB",
+)
+
+# Which REQUIRED set applies is decided by what the BASELINE contains
+# (--baseline invites arbitrary copied/renamed paths, so a filename key
+# would silently drop the data-plane contract): a baseline carrying any
+# data-plane metric is held to the data-plane REQUIRED set.
+def required_for(baseline_metrics: Dict[str, float]) -> tuple:
+    if any(m in baseline_metrics for m in REQUIRED_METRICS_DATAPLANE):
+        return REQUIRED_METRICS_DATAPLANE
+    return REQUIRED_METRICS
+
+# Absolute floors, enforced regardless of the baseline's value: trajectory
+# checks catch regressions *relative to yesterday*, floors encode the
+# architectural contract (peer-direct must beat the head relay >= 3x on a
+# cross-node 10MB get, per the data-plane acceptance criterion).
+HARD_FLOORS = {
+    "transfer_speedup_10MB": 3.0,
+}
+
 # Metrics where SMALLER is better (seconds of recovery, not ops/s): the
 # regression test inverts — a value above baseline by more than the
 # threshold fails, a drop is an improvement.
@@ -86,10 +114,17 @@ def main() -> int:
         print(f"bench_check: no metrics in {ns.new_run}", file=sys.stderr)
         return 1
 
+    required = required_for(base)
+
     failures = []
-    for name in REQUIRED_METRICS:
+    for name in required:
         if name not in new:
             failures.append(f"{name}: REQUIRED metric missing from new run")
+    for name, floor in HARD_FLOORS.items():
+        if name in new and new[name] < floor:
+            failures.append(
+                f"{name}: {new[name]:g} below the hard floor {floor:g}"
+            )
     for name, old_v in sorted(base.items()):
         if name not in new:
             failures.append(f"{name}: MISSING from new run (baseline {old_v:g})")

@@ -1,0 +1,175 @@
+"""Data-plane benchmark: peer-to-peer transfer throughput vs the head relay.
+
+Spawns a REAL head + daemon cluster twice (peer transfers off, then on;
+forced pulls both times so every cross-node read moves bytes) and records:
+
+  - ``get_10MB_relay_MBps``  — cross-node driver get with every byte relayed
+    through the head (the pre-data-plane architecture, and the baseline the
+    acceptance criterion compares against);
+  - ``get_10MB_peer_MBps``   — same reads streamed daemon→driver peer-direct
+    in ``transfer_chunk_bytes`` chunks;
+  - ``multi_puller_aggregate_relay_GBps`` / ``multi_puller_aggregate_GBps``
+    — aggregate bandwidth with 8 concurrent cross-node pullers spread over
+    two consumer nodes (the head-relay number is capped by one Python
+    process; the peer number scales with the senders);
+  - ``locality_hit_rate``    — fraction of byte-heavy-arg tasks the
+    locality-aware lease policy lands on the holder node (those transfers
+    never happen at all);
+  - ``transfer_speedup_10MB`` — peer/relay single-stream ratio (the
+    acceptance criterion wants >= 3).
+
+Prints one human-readable line plus one JSON line per metric, same format
+as bench_core.py; pipe to BENCH_DATAPLANE.json and check with
+``python bench_check.py BENCH_DATAPLANE.json --baseline BENCH_DATAPLANE.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+MB = 1024 * 1024
+OBJ_WORDS = 1_250_000  # 10 MB of float64
+OBJ_BYTES = OBJ_WORDS * 8
+
+
+def _emit(results, name, value, unit):
+    rec = {"metric": name, "value": round(value, 3), "unit": unit}
+    results.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def _cluster(peer_transfer: bool):
+    from ray_tpu.cluster_utils import Cluster
+
+    os.environ["RAY_TPU_force_object_pulls"] = "1"
+    os.environ["RAY_TPU_enable_peer_transfer"] = "1" if peer_transfer else "0"
+    cluster = Cluster(head_node_args={"num_cpus": 4, "num_tpus": 0}, real=True)
+    cluster.add_node(num_cpus=4, resources={"src": 16})
+    cluster.add_node(num_cpus=4, resources={"sink1": 16})
+    cluster.add_node(num_cpus=4, resources={"sink2": 16})
+    return cluster
+
+
+def _producers(n):
+    import ray_tpu
+
+    @ray_tpu.remote(resources={"src": 1})
+    def produce(seed):
+        return np.full(OBJ_WORDS, float(seed))
+
+    refs = [produce.remote(i) for i in range(n)]
+    ray_tpu.wait(refs, num_returns=n, timeout=120)
+    return refs
+
+
+def _bench_driver_get(n=12):
+    """Sequential cross-node driver gets of n DISTINCT 10MB objects (fresh
+    objects per read: the node cache never short-circuits)."""
+    import ray_tpu
+
+    refs = _producers(n)
+    # One warmup object outside the timed set.
+    ray_tpu.get(refs[0], timeout=120)
+    t0 = time.perf_counter()
+    for r in refs[1:]:
+        ray_tpu.get(r, timeout=120)
+    dt = time.perf_counter() - t0
+    return (n - 1) * OBJ_BYTES / dt / MB  # MB/s
+
+
+def _bench_multi_puller(n=8):
+    """n concurrent consumer tasks across two sink nodes, each pulling its
+    own 10MB object from the source node; aggregate GB/s."""
+    import ray_tpu
+
+    refs = _producers(n)
+
+    @ray_tpu.remote(max_retries=2)
+    def consume(x):
+        return float(x[0])
+
+    # Warm the FULL worker pool on both sinks (n/2 concurrent tasks per sink
+    # node): worker spawn costs ~hundreds of ms each and would otherwise
+    # dominate the timed region. The warmup arg is one shared object, so its
+    # pull dedups and the warmup itself moves almost no data.
+    opts = [consume.options(resources={"sink1": 1}),
+            consume.options(resources={"sink2": 1})]
+    ray_tpu.get([opts[i % 2].remote(refs[0]) for i in range(n)], timeout=120)
+    t0 = time.perf_counter()
+    out = [opts[i % 2].remote(refs[i]) for i in range(n)]
+    ray_tpu.get(out, timeout=300)
+    dt = time.perf_counter() - t0
+    return n * OBJ_BYTES / dt / (1024 ** 3)  # GB/s
+
+
+def _bench_locality(n=10):
+    """Arg-heavy tasks with no placement constraint: the locality-aware
+    lease policy should land them on the holder node. SEQUENTIAL submission
+    (each task completes before the next submits), so the holder always has
+    a free slot and the measurement isolates the placement POLICY — every
+    task should hit, deterministically. A concurrent burst instead measures
+    where the spread threshold spills once the holder saturates, which
+    quantizes noisily at small n (bad CI signal)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    [ref] = _producers(1)
+
+    @ray_tpu.remote
+    def heavy(arr):
+        return float(arr[1])
+
+    before = state.transfer_stats()
+    for _ in range(n):
+        ray_tpu.get(heavy.remote(ref), timeout=120)
+    after = state.transfer_stats()
+    hits = after["locality_hits"] - before["locality_hits"]
+    misses = after["locality_misses"] - before["locality_misses"]
+    total = hits + misses
+    return hits / total if total else 0.0
+
+
+def main():
+    import ray_tpu
+
+    results = []
+
+    # ---- phase 1: head relay (peer transfers disabled) --------------------
+    cluster = _cluster(peer_transfer=False)
+    try:
+        relay_mbps = _bench_driver_get()
+        relay_agg = _bench_multi_puller()
+    finally:
+        cluster.shutdown()
+    _emit(results, "get_10MB_relay_MBps", relay_mbps, "MB/s")
+    _emit(results, "multi_puller_aggregate_relay_GBps", relay_agg, "GB/s")
+
+    # ---- phase 2: peer-direct data plane ----------------------------------
+    cluster = _cluster(peer_transfer=True)
+    try:
+        peer_mbps = _bench_driver_get()
+        peer_agg = _bench_multi_puller()
+        hit_rate = _bench_locality()
+        st = __import__("ray_tpu.util.state", fromlist=["state"]).transfer_stats()
+        relay_pulls = st["relay_pulls"]
+    finally:
+        cluster.shutdown()
+        for k in ("RAY_TPU_force_object_pulls", "RAY_TPU_enable_peer_transfer"):
+            os.environ.pop(k, None)
+    _emit(results, "get_10MB_peer_MBps", peer_mbps, "MB/s")
+    _emit(results, "multi_puller_aggregate_GBps", peer_agg, "GB/s")
+    _emit(results, "locality_hit_rate", hit_rate, "fraction")
+    _emit(results, "transfer_speedup_10MB", peer_mbps / relay_mbps, "x")
+
+    print(f"# peer-phase head relay pulls: {relay_pulls} "
+          f"(0 == all bytes moved peer-direct)")
+    for r in results:
+        print(f"# {r['metric']:38s} {r['value']:>12g} {r['unit']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,8 +58,17 @@ def load_arena_lib() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
-            _build_failed = True
-            return None
+            # A prebuilt .so from another machine can be unloadable here
+            # (e.g. newer-glibc symbols). The source is authoritative:
+            # rebuild once for THIS toolchain and retry before giving up.
+            if not _build():
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                _build_failed = True
+                return None
         lib.arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.arena_create.restype = ctypes.c_int
         lib.arena_attach.argtypes = [ctypes.c_char_p]

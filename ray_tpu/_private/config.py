@@ -11,7 +11,8 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any
+import typing
+from typing import Any, Optional
 
 
 # Environment keys the runtime honors BESIDE the `RAY_TPU_<Config field>`
@@ -64,8 +65,12 @@ class Config:
     # Store large objects in the node's native C++ shm arena (ray_tpu/_native/
     # shm_arena.cpp — one mapping, offset allocations, no per-object file
     # create/unlink) instead of one file per object. Falls back to files
-    # automatically when no toolchain / arena full.
-    use_native_object_arena: bool = True
+    # automatically when no toolchain / arena full. None = auto: arena only
+    # where reads can export zero-copy pinned buffers (PEP-688, py3.12+) —
+    # older interpreters must COPY every arena read (freed blocks recycle,
+    # unlike unlinked file mmaps), which turns ~138 GB/s same-node 10MB gets
+    # into ~10 GB/s. True forces the arena on regardless (tests).
+    use_native_object_arena: Optional[bool] = None
     # Native arena size per node; 0 = same as object_store_memory. Objects
     # that don't fit the arena overflow to per-object file segments.
     object_arena_bytes: int = 0
@@ -84,6 +89,22 @@ class Config:
     # Fail cross-node pulls that would relay through the head instead of the
     # peer-direct daemon data plane (testing/ops guard for the head NIC).
     disable_pull_relay: bool = False
+
+    # --- peer-to-peer data plane (object_transfer.py) ---
+    # Cross-node object bytes stream node->node over dedicated data
+    # connections (PullManager/PushManager); the head answers location
+    # queries only. False falls back to relaying every byte through the head
+    # (the pre-data-plane behavior; also the bench baseline).
+    enable_peer_transfer: bool = True
+    # Chunk size for peer transfers: each transfer_chunk frame carries this
+    # many bytes, sliced straight out of the segment/arena file.
+    transfer_chunk_bytes: int = 1 * 1024 * 1024
+    # Bound on concurrently-executing pulls per reader process; further
+    # pulls queue in priority order (task-args > explicit get > prefetch).
+    transfer_max_inflight_pulls: int = 4
+    # Pusher-side backpressure: at most this many unacked chunks in flight
+    # per transfer (bounds socket backlog and the puller's reorder buffer).
+    transfer_window_chunks: int = 8
 
     # --- scheduling ---
     # Hybrid policy threshold: pack onto the best node until its utilization
@@ -214,10 +235,24 @@ class Config:
     log_to_driver: bool = True
 
     def apply_overrides(self, system_config: dict | None = None) -> "Config":
+        # PEP 563 (future annotations) makes every f.type a STRING, so env
+        # coercion must resolve the real annotation — the type of the default
+        # value is wrong for tri-state fields (type(None) isn't callable).
+        hints = typing.get_type_hints(type(self))
         for f in dataclasses.fields(self):
             env_key = f"RAY_TPU_{f.name}"
-            if env_key in os.environ:
-                setattr(self, f.name, _coerce(os.environ[env_key], f.type if isinstance(f.type, type) else type(getattr(self, f.name))))
+            if env_key not in os.environ:
+                continue
+            typ = hints.get(f.name, str)
+            optional = typing.get_origin(typ) is typing.Union
+            if optional:
+                args = [a for a in typing.get_args(typ) if a is not type(None)]
+                typ = args[0] if args else str
+            raw = os.environ[env_key]
+            if optional and raw.lower() in ("", "none", "auto"):
+                setattr(self, f.name, None)
+            else:
+                setattr(self, f.name, _coerce(raw, typ))
         if system_config:
             for k, v in system_config.items():
                 if not hasattr(self, k):

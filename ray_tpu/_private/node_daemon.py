@@ -66,60 +66,23 @@ class NodeDaemon:
             s.close()
 
     def _start_data_server(self):
-        """Peer-direct data plane: serve segment reads straight to readers on
-        other nodes, so object pulls skip the head relay (reference:
-        peer-to-peer transfer in `object_manager.cc`). Framed-pickle protocol
-        with the cluster authkey, like every other connection. WITHOUT an
-        authkey the server does not start (an open listener would be an
-        arbitrary-read endpoint); pulls then ride the authenticated relay."""
-        from multiprocessing.connection import Listener
+        """Peer-direct data plane: a PushManager (object_transfer.py) serving
+        chunked transfer_begin/transfer_chunk streams straight to readers on
+        other nodes, so object pulls skip the head relay (reference: the
+        push side of `object_manager.cc`). Framed-pickle protocol with the
+        cluster authkey, like every other connection. WITHOUT an authkey the
+        server does not start (an open listener would be an arbitrary-read
+        endpoint); pulls then ride the authenticated relay. A disabled
+        enable_peer_transfer likewise advertises no address."""
+        from ray_tpu._private.config import get_config
+        from ray_tpu._private.object_transfer import PushManager
 
-        authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", "")) or None
-        if authkey is None:
+        if not get_config().enable_peer_transfer:
             return None
-        self._data_listener = Listener(("0.0.0.0", 0), authkey=authkey)
-        port = self._data_listener.address[1]
-
-        def accept_loop():
-            while not self._stop.is_set():
-                try:
-                    conn = self._data_listener.accept()
-                except Exception:  # noqa: BLE001 — OSError/EOF/AuthenticationError
-                    if self._stop.is_set():
-                        return
-                    continue
-                threading.Thread(
-                    target=self._serve_data_conn, args=(conn,),
-                    daemon=True, name="data-serve",
-                ).start()
-
-        threading.Thread(target=accept_loop, daemon=True, name="data-accept").start()
-        return f"{self._local_host()}:{port}"
-
-    def _serve_data_conn(self, conn):
-        from ray_tpu._private.object_store import read_segment
-
-        shm_root = os.path.realpath(self.shm_dir)
-        try:
-            while True:
-                path, offset, length = serialization.loads(conn.recv_bytes())
-                try:
-                    # Only segments under this node's store dir are servable —
-                    # the wire must not become an arbitrary-file-read endpoint.
-                    real = os.path.realpath(path)
-                    if not real.startswith(shm_root + os.sep) and real != shm_root:
-                        raise PermissionError(f"path outside store dir: {path}")
-                    data = read_segment(real, offset, length)
-                    conn.send_bytes(serialization.dumps((True, data)))
-                except OSError as e:
-                    conn.send_bytes(serialization.dumps((False, repr(e))))
-        except (EOFError, OSError):
-            pass
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+        self._push_manager = PushManager(self.shm_dir)
+        addr = self._push_manager.start_listener(self._local_host())
+        self._data_listener = self._push_manager
+        return addr
 
     def connect(self):
         from multiprocessing.connection import Client
@@ -133,6 +96,9 @@ class NodeDaemon:
             data_address = self._start_data_server()
         self._data_address = data_address
         self.conn = Client((self.head_host, self.head_port), authkey=authkey)
+        from ray_tpu._private.object_transfer import set_nodelay
+
+        set_nodelay(self.conn)
         self.conn.send_bytes(
             serialization.dumps(
                 (

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import mmap
 import os
+import sys
 import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -249,13 +250,6 @@ def read_segment(path: str, offset: Optional[int], length: Optional[int]) -> byt
         return f.read()
 
 
-# Peer-direct data-plane connections: per-address (conn, lock) so one hung
-# peer cannot serialize fetches from healthy ones.
-_peer_conns: Dict[str, Tuple[object, threading.Lock]] = {}
-_peer_lock = threading.Lock()
-# Nodes known to advertise no data server: skip the locate round-trip.
-_no_peer_nodes: set = set()
-
 # Reader-side locality stats (ray_tpu_object_store_reads_total /
 # _pull_bytes_total via telemetry.ensure_objectstore_client_metrics): the
 # hot read path bumps plain ints; a registry collector publishes deltas.
@@ -282,49 +276,10 @@ def _stats_enabled() -> bool:
         return False
 
 
-def _fetch_peer(address: str, meta: ObjectMeta, timeout: float = 30.0) -> Optional[bytes]:
-    """Pull a segment's bytes straight from the owning daemon's data server
-    (reference: peer-to-peer object transfer, `object_manager.cc`); None on
-    any failure or timeout — the caller falls back to the head relay."""
-    from multiprocessing.connection import Client
-
-    from ray_tpu._private import serialization
-
-    host, _, port = address.rpartition(":")
-    authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", "")) or None
-    with _peer_lock:
-        entry = _peer_conns.get(address)
-    conn = None
-    try:
-        if entry is None:
-            conn = Client((host, int(port)), authkey=authkey)
-            entry = (conn, threading.Lock())
-            with _peer_lock:
-                _peer_conns[address] = entry
-        conn, conn_lock = entry
-        # One request/response at a time per CONNECTION; a bounded poll keeps
-        # a dead peer from hanging the task past the pull timeout.
-        with conn_lock:
-            conn.send_bytes(
-                serialization.dumps((meta.segment, meta.arena_offset, meta.size))
-            )
-            if not conn.poll(timeout):
-                raise TimeoutError(f"peer {address} did not answer in {timeout}s")
-            ok, data = serialization.loads(conn.recv_bytes())
-        return data if ok else None
-    except Exception:  # noqa: BLE001 — any wire failure: drop conn, fall back
-        with _peer_lock:
-            _peer_conns.pop(address, None)
-        try:
-            if conn is not None:
-                conn.close()
-        except Exception:
-            pass
-        return None
-
-
 def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn,
-                     force_remote: bool, locate_fn=None) -> ObjectMeta:
+                     force_remote: bool, locate_fn=None, transfer=None,
+                     priority: Optional[int] = None,
+                     replica_fn=None) -> ObjectMeta:
     """Return a meta whose segment is readable from this process, pulling the
     bytes when the segment lives on another node. The single implementation
     behind every reader path (worker task args, driver get, client-driver get)
@@ -333,11 +288,19 @@ def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn,
     - Same-node (or same-filesystem) segments are used in place: zero-copy.
     - `force_remote` (Config.force_object_pulls) treats other-node segments as
       unreadable even on a shared filesystem, to exercise the wire path.
-    - With `locate_fn(key) -> (meta, data_address)` the bytes come PEER-DIRECT
-      from the owning daemon's data server; `pull_fn(key) -> (meta, bytes)`
-      (head relay) is the fallback.
+    - With a `transfer` (ObjectTransferManager) and `locate_fn(key) ->
+      (meta, [(node_id, address), ...])` the bytes stream PEER-DIRECT from a
+      holder's data server in bounded chunks (object_transfer.PullManager:
+      priority admission, per-key dedup, replica failover); `pull_fn(key) ->
+      (meta, bytes)` (head relay) is the fallback.
     - Pulled bytes are cached under the object id in the local store dir;
-      later reads hit the cache instead of re-transferring.
+      later reads hit the cache instead of re-transferring, and `replica_fn`
+      (when given) registers this node as a replica in the head's location
+      directory so OTHER nodes can pull from here too — and so the head can
+      DELETE the cache file when the object is freed. Registration also runs
+      on cache hits (a prefetch fills the cache before any blocking read
+      reaches this function), deduped per store so a hot object doesn't
+      re-announce on every read.
     """
     import dataclasses
 
@@ -363,27 +326,46 @@ def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn,
     if os.path.exists(local_path):
         if _stats_enabled():
             _READ_STATS["cache_hits"] += 1
+        _register_replica(store, meta.object_id.binary(), replica_fn)
         return dataclasses.replace(meta, segment=local_path, arena_offset=None)
-    fetched = data = None
-    if locate_fn is not None and meta.node_id not in _no_peer_nodes:
-        try:
-            located, addr = locate_fn(meta.object_id.binary())
-        except Exception:  # noqa: BLE001 — stale meta etc.: use the relay
-            located, addr = None, None
-        if located is not None and located.segment is not None and addr:
-            from ray_tpu._private.config import get_config
+    fetched: Optional[ObjectMeta] = None
+    data: Optional[bytes] = None
+    if (
+        transfer is not None
+        and transfer.enabled
+        and locate_fn is not None
+        and meta.node_id not in transfer.no_peer_nodes
+    ):
+        from ray_tpu._private import object_transfer
 
-            peer_bytes = _fetch_peer(
-                addr, located, timeout=get_config().object_pull_timeout_s
-            )
-            if peer_bytes is not None:
-                fetched, data = located, peer_bytes
-        elif located is not None and addr is None and located.node_id:
-            # Owner has no data server (head-local / client driver / virtual
-            # node): remember, so later pulls skip the locate round-trip.
-            _no_peer_nodes.add(located.node_id)
-    if fetched is None:
-        fetched, data = pull_fn(meta.object_id.binary())
+        try:
+            located = locate_fn(meta.object_id.binary())
+        except Exception:  # noqa: BLE001 — stale meta etc.: use the relay
+            located = None
+        if located is not None:
+            fresh, locations = located
+            if fresh is not None and fresh.segment is None:
+                return fresh  # became inline (e.g. error overwrite)
+            if fresh is not None:
+                try:
+                    path = transfer.pull(
+                        fresh, locations,
+                        object_transfer.PRIORITY_GET if priority is None else priority,
+                    )
+                except Exception:  # noqa: BLE001 — PullFailed, or any manager
+                    # surprise: the peer plane must DEGRADE to the relay, never
+                    # turn a readable object into a reader-facing error.
+                    path = None
+                if path is not None:
+                    if _stats_enabled():
+                        _READ_STATS["pulls"] += 1
+                        _READ_STATS["pull_bytes"] += fresh.size
+                    _register_replica(store, fresh.object_id.binary(),
+                                      replica_fn)
+                    return dataclasses.replace(
+                        fresh, segment=path, arena_offset=None
+                    )
+    fetched, data = pull_fn(meta.object_id.binary())
     if _stats_enabled():
         _READ_STATS["pulls"] += 1
         _READ_STATS["pull_bytes"] += len(data) if data else 0
@@ -395,7 +377,29 @@ def resolve_for_read(store: "LocalObjectStore", meta: ObjectMeta, pull_fn,
         with open(tmp, "wb") as f:
             f.write(data or b"")
         os.replace(tmp, local_path)
+    _register_replica(store, fetched.object_id.binary(), replica_fn)
     return dataclasses.replace(fetched, segment=local_path, arena_offset=None)
+
+
+def _register_replica(store: "LocalObjectStore", key: bytes,
+                      replica_fn) -> None:
+    """Tell the head this node caches `key`'s bytes (once per store+key —
+    object ids are never reused, so the dedup set needs no eviction). The
+    registration makes the copy both a pull source for other nodes and
+    reachable by the head's free-time purge."""
+    if replica_fn is None or key in store._replicas_announced:
+        return
+    store._replicas_announced.add(key)
+    try:
+        replica_fn(key)
+    except Exception:  # noqa: BLE001 — bookkeeping only
+        pass
+
+
+# PEP-688 __buffer__ (the pinned zero-copy exporter below) needs 3.12+; on
+# older interpreters arena reads copy their buffers out instead — still one
+# mapping and no per-object files, just not zero-copy on the read side.
+_PINNED_EXPORT = sys.version_info >= (3, 12)
 
 
 class _PinnedArenaBuffer:
@@ -438,6 +442,9 @@ class LocalObjectStore:
         self.node_id = node_id
         os.makedirs(shm_dir, exist_ok=True)
         self._segments: Dict[str, SharedSegment] = {}
+        # Object keys whose cached copy this process already announced to the
+        # head's replica directory (see resolve_for_read/_register_replica).
+        self._replicas_announced: set = set()
         self._lock = threading.Lock()
         # Arena handle cached per store: False = not yet resolved (None is a
         # meaningful "unavailable" result from get_node_arena).
@@ -458,11 +465,14 @@ class LocalObjectStore:
         if self._arena is False:  # resolve once per store
             from ray_tpu._private.config import get_config
 
-            self._arena = (
-                get_node_arena(self.shm_dir)
-                if get_config().use_native_object_arena
-                else None
-            )
+            # None = auto: arena only where reads can be pinned zero-copy
+            # (PEP-688, py3.12+) — the copy fallback turns ~138 GB/s
+            # same-node gets into ~10 GB/s, worse than file-segment mmaps.
+            # True (tests) forces the arena on regardless.
+            want = get_config().use_native_object_arena
+            if want is None:
+                want = _PINNED_EXPORT
+            self._arena = get_node_arena(self.shm_dir) if want else None
         if self._arena is not None:
             meta = write_arena_object(
                 self._arena, os.path.join(self.shm_dir, ARENA_FILENAME), sv
@@ -494,12 +504,20 @@ class LocalObjectStore:
             # Unlike unlinked file mmaps (which stay valid for existing views),
             # a freed arena block gets RECYCLED — so zero-copy views must pin
             # the object. Each buffer is wrapped in a PEP-688 exporter that
-            # holds a process-local ref until the consuming arrays die.
+            # holds a process-local ref until the consuming arrays die; on
+            # interpreters without __buffer__ support the bytes are copied
+            # out instead (safe without a pin).
             key = meta.object_id.binary()
-            buffers = [
-                _PinnedArenaBuffer(mv[off : off + length], key)
-                for off, length in meta.buffer_layout or []
-            ]
+            if _PINNED_EXPORT:
+                buffers = [
+                    _PinnedArenaBuffer(mv[off : off + length], key)
+                    for off, length in meta.buffer_layout or []
+                ]
+            else:
+                buffers = [
+                    bytes(mv[off : off + length])
+                    for off, length in meta.buffer_layout or []
+                ]
             return deserialize(inband, buffers)
         with self._lock:
             seg = self._segments.get(meta.segment)

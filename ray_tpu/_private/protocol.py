@@ -64,6 +64,10 @@ DISPATCHERS = {
     "worker.dispatch": "ray_tpu._private.worker_main:WorkerConnection._dispatch",
     "driver.misc": "ray_tpu._private.worker:RemoteDriverContext._on_misc",
     "daemon.dispatch": "ray_tpu._private.node_daemon:NodeDaemon._dispatch",
+    # Peer-to-peer data plane (object_transfer.py): the pusher's per-conn
+    # reader (begin/ack/cancel in) and the puller's peer reader (chunk/end in).
+    "transfer.push": "ray_tpu._private.object_transfer:PushEndpoint._dispatch",
+    "transfer.pull": "ray_tpu._private.object_transfer:_PeerConnection._reader_loop",
 }
 
 MESSAGE_GRAMMAR = {
@@ -195,7 +199,57 @@ MESSAGE_GRAMMAR = {
         "readers": ("driver.misc",),
         "doc": "(channel, payload) — pubsub push (logs/errors channels)",
     },
-    # ---- head -> daemon/driver data plane --------------------------------
+    # ---- object location directory (data plane control) ------------------
+    "locate_object": {
+        "dir": "any->head", "arity": (3, 3),
+        "readers": ("scheduler.worker", "scheduler.driver"),
+        "doc": "(token, [object_key, ...]) — batched location query: where do "
+               "these objects' bytes live? The head answers object_locations; "
+               "it never moves payload bytes for peer-served objects",
+    },
+    "object_locations": {
+        "dir": "head->any", "arity": (3, 3),
+        "readers": ("worker.dispatch", "driver.misc"),
+        "doc": "(token, {key: (meta, [(node_id, data_address), ...])}) — "
+               "owner-first locations (replicas after); address None means "
+               "the holder has no data server (relay is the only route)",
+    },
+    # ---- peer-to-peer chunked transfers (node<->node, bypassing the head) -
+    "transfer_begin": {
+        "dir": "puller->pusher", "arity": (6, 6),
+        "readers": ("transfer.push",),
+        "doc": "(req_id, path, offset, length, chunk_bytes) — start streaming "
+               "a segment/arena slice in chunk_bytes pieces. path is absolute "
+               "for the owner's segment; a store-RELATIVE object-id name asks "
+               "a replica for its cache file (resolved under its store dir)",
+    },
+    "transfer_ack": {
+        "dir": "puller->pusher", "arity": (3, 3),
+        "readers": ("transfer.push",),
+        "doc": "(req_id, seq) — chunk received; refills the pusher's bounded "
+               "outstanding-chunk window (transfer_window_chunks)",
+    },
+    "transfer_cancel": {
+        "dir": "puller->pusher", "arity": (2, 2),
+        "readers": ("transfer.push",),
+        "doc": "(req_id,) — abandon an in-flight transfer (pull cancelled or "
+               "timed out); the pusher drops its state",
+    },
+    "transfer_chunk": {
+        "dir": "pusher->puller", "arity": (4, 4),
+        "readers": ("transfer.pull",),
+        "doc": "(req_id, seq, nbytes) — chunk header; the payload follows as "
+               "one RAW (unpickled) frame. Written at seq*chunk_bytes on the "
+               "puller (positional reassembly: dups are idempotent, a drop "
+               "surfaces as a byte-count mismatch at transfer_end)",
+    },
+    "transfer_end": {
+        "dir": "pusher->puller", "arity": (4, 4),
+        "readers": ("transfer.pull",),
+        "doc": "(req_id, ok, err_repr) — transfer complete (sent after the "
+               "final chunk; FIFO puts it behind every chunk) or failed",
+    },
+    # ---- head -> daemon/driver data plane (relay fallback) ---------------
     "read_object": {
         "dir": "head->source", "arity": (3, 5),
         "readers": ("daemon.dispatch", "driver.misc"),
@@ -230,7 +284,7 @@ MESSAGE_GRAMMAR = {
     "batch": {
         "dir": "any", "arity": (2, 2),
         "readers": ("scheduler.worker", "scheduler.daemon", "scheduler.driver",
-                    "worker.reader", "daemon.dispatch"),
+                    "worker.reader", "daemon.dispatch", "transfer.push"),
         "doc": "([msg, ...],) — micro-batched control frame; receivers apply "
                "every contained message before waking scheduling work once",
     },

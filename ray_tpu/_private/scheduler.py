@@ -581,11 +581,26 @@ class Scheduler:
         self._conn_to_daemon: Dict[Any, DaemonHandle] = {}
         self._conn_to_driver: Dict[Any, DriverHandle] = {}
         self._workers_by_id: Dict[str, WorkerHandle] = {}
-        # Object-pull plumbing: node_id bytes -> connection that can read that
-        # node's segments; outstanding reads keyed by token.
+        # Object-pull plumbing (relay FALLBACK; the peer-direct data plane in
+        # object_transfer.py carries most bytes): node_id bytes -> connection
+        # that can read that node's segments; outstanding reads keyed by
+        # token, with concurrent relay pulls of one key coalesced into a
+        # single read (waiters pile onto _relay_waiters[key]).
         self._pull_sources: Dict[bytes, _ConnSender] = {}
-        self._pending_pulls: Dict[int, Tuple[Callable[[bool, Any], None], ObjectMeta]] = {}
+        self._pending_pulls: Dict[int, Tuple[bytes, ObjectMeta]] = {}
+        self._relay_waiters: Dict[bytes, List[Callable[[bool, Any], None]]] = {}
         self._pull_token = 0
+        # Location directory for the data plane: nodes holding a CACHED copy
+        # of a sealed object beside its owner (registered by pullers after a
+        # successful transfer; purged with the object / the node).
+        self.object_replicas: Dict[bytes, set] = {}
+        # Cumulative data-plane counters (never reset; transfer_stats() and
+        # the telemetry tick both read them): relay traffic the peer-direct
+        # plane is supposed to eliminate, plus locality-placement outcomes.
+        self._transfer_stats = {
+            "relay_pulls": 0, "relay_bytes": 0, "local_reads": 0,
+            "locality_hits": 0, "locality_misses": 0,
+        }
         # Object lifecycle (reference: ownership refcounting in
         # `core_worker/reference_count.h:59`, plasma capacity/eviction in
         # `object_manager/plasma/eviction_policy.h`, lineage reconstruction in
@@ -629,7 +644,15 @@ class Scheduler:
         self._sock_path = os.path.join(session_dir, "worker.sock")
         from multiprocessing.connection import Listener
 
-        self._listener = Listener(self._sock_path, family="AF_UNIX", authkey=self._authkey)
+        # backlog: multiprocessing's default is 1 — a gang of concurrently
+        # spawned workers overflows the accept queue, the kernel silently
+        # drops the excess connections, and each dropped worker blocks
+        # FOREVER in its auth-challenge recv (no hello ever reaches the
+        # acceptor, so its lease hangs with the exec parked in the outbox).
+        self._listener = Listener(
+            self._sock_path, family="AF_UNIX", backlog=128,
+            authkey=self._authkey,
+        )
         # TCP listener: node daemons, remote workers, and client-mode drivers
         # dial this (the analogue of the reference's gRPC ports). Bound to the
         # advertise host (loopback by default) so a plain single-machine
@@ -638,9 +661,24 @@ class Scheduler:
         self._tcp_listener = Listener(
             (bind_host if bind_host is not None else advertise_host, tcp_port),
             family="AF_INET",
+            backlog=128,  # see the unix listener's backlog note
             authkey=self._authkey,
         )
         self.tcp_address = (advertise_host, self._tcp_listener.address[1])
+        # The head's own half of the data plane: a push server over the head
+        # store dir (head-held objects stream to readers WITHOUT crossing the
+        # scheduler loop or control sockets) plus the coalescing local-read
+        # pool behind the relay fallback. Virtual nodes share the head's shm
+        # dir, so one server covers them all.
+        from ray_tpu._private.object_transfer import ObjectTransferManager
+
+        self._transfer = ObjectTransferManager(
+            os.path.join(session_dir, "shm"), cfg=config, authkey=self._authkey
+        )
+        try:
+            self._data_address = self._transfer.start_push_server(advertise_host)
+        except OSError:
+            self._data_address = None
 
     @property
     def authkey(self) -> bytes:
@@ -666,6 +704,11 @@ class Scheduler:
                 if self._stopped.is_set():
                     return
                 continue
+            # Req/resp roundtrips on TCP control connections otherwise stall
+            # on Nagle + delayed-ACK (~40ms per small frame after idle).
+            from ray_tpu._private.object_transfer import set_nodelay
+
+            set_nodelay(conn)
             kind = hello[0]
             if kind == "worker":
                 self.call("attach_worker", (hello[1], conn))
@@ -788,12 +831,14 @@ class Scheduler:
             pass
 
     def _fail_pulls_from(self, source_node_id: bytes):
-        """Fail outstanding pulls whose source just died, so readers error out
-        instead of hanging on a response that will never arrive."""
-        for token, (respond, meta) in list(self._pending_pulls.items()):
+        """Fail outstanding relay pulls whose source just died, so readers
+        error out instead of hanging on a response that will never arrive."""
+        for token, (key, meta) in list(self._pending_pulls.items()):
             if meta.node_id == source_node_id:
                 del self._pending_pulls[token]
-                respond(False, ConnectionError("object source node died during pull"))
+                for respond in self._relay_waiters.pop(key, []):
+                    respond(False, ConnectionError(
+                        "object source node died during pull"))
 
     def stop(self):
         fut = self.call("_stop", None)
@@ -802,6 +847,7 @@ class Scheduler:
         except Exception:
             pass
         self._stopped.set()
+        self._transfer.close()
         for listener in (self._listener, self._tcp_listener):
             try:
                 listener.close()
@@ -1169,6 +1215,8 @@ class Scheduler:
         elif kind == "object_data":
             _, token, ok, data = msg
             self._finish_pull(token, ok, data)
+        elif kind == "locate_object":
+            self._on_locate_object(dh, msg[1], msg[2])
         elif kind == "ref_ops":
             self._apply_ref_ops(msg[1], dh.holder_id)
 
@@ -1201,6 +1249,9 @@ class Scheduler:
             available=dict(resources),
             shm_dir=shm_dir,
             labels=labels or {},
+            # Head/virtual nodes share the head store dir; the head's own
+            # push server serves their segments peer-direct.
+            data_address=self._data_address,
         )
         self.nodes[node_id] = node
         self.node_order.append(node_id)
@@ -1229,6 +1280,7 @@ class Scheduler:
             self._on_worker_death(wh)
         del self.nodes[node_id]
         self.node_order.remove(node_id)
+        self._drop_node_replicas(node_id.binary())
         # PG bundles on this node go back to pending.
         for pg in self.pgs.values():
             for b in pg.bundles:
@@ -1783,6 +1835,8 @@ class Scheduler:
             self._on_worker_log(wh, msg)
         elif kind == "ref_ops":
             self._apply_ref_ops(msg[1], wh.worker_id.hex())
+        elif kind == "locate_object":
+            self._on_locate_object(wh, msg[1], msg[2])
         elif kind == "stacks_data" or kind == "profile_data":
             self._on_introspect_reply(msg[1], msg[2])
 
@@ -2263,6 +2317,7 @@ class Scheduler:
             return
         self._retire_meta_accounting(meta)
         self._delete_segment(meta)
+        self._purge_replicas(key, meta)
         self._maybe_gc_lineage(meta.object_id)
 
     def _gc_eligible(self, oid: ObjectID):
@@ -3197,7 +3252,7 @@ class Scheduler:
             "free", "register_function", "remove_pg", "cancel", "task_events",
             "task_latency", "list_actors", "list_tasks", "list_objects",
             "get_nodes", "add_node", "remove_node", "autoscaler_state",
-            "memory_summary",
+            "memory_summary", "transfer_stats",
         }
     )
 
@@ -3210,35 +3265,128 @@ class Scheduler:
 
     # ------------------------------------------------------------------ object pulls
     def _locate_object(self, object_key: bytes):
-        """(meta, data_address): where an object's bytes live. With a
-        data_address the reader pulls PEER-DIRECT from the owning daemon's
-        data server (reference: peer-to-peer chunk transfer,
-        `object_manager.cc`); None falls back to the head relay."""
+        """(meta, [(node_id, data_address), ...]): where an object's bytes
+        live — the owner first, then replica nodes holding a pulled copy.
+        Readers dial an address and stream the bytes PEER-DIRECT
+        (object_transfer.py; reference: the object directory feeding
+        peer-to-peer chunk transfer, `ownership_based_object_directory.h` +
+        `object_manager.cc`). An address of None means that holder has no
+        data server and only the head relay can serve it."""
         meta = self.object_table.get(object_key)
         if meta is None:
             raise KeyError("object not sealed")
-        addr = None
+        locations: List[Tuple[bytes, Optional[str]]] = []
         if meta.segment is not None and meta.node_id:
             node = self.nodes.get(NodeID(meta.node_id))
             if node is not None and node.alive:
-                addr = node.data_address
-        return meta, addr
+                locations.append((meta.node_id, node.data_address))
+            for nid in self.object_replicas.get(object_key, ()):
+                if nid == meta.node_id:
+                    continue
+                rnode = self.nodes.get(NodeID(nid))
+                if rnode is not None and rnode.alive and rnode.data_address:
+                    locations.append((nid, rnode.data_address))
+        return meta, locations
 
     def _cmd_locate_object(self, object_key: bytes):
         return self._locate_object(object_key)
 
-    def _req_locate_object(self, wh, req_id: int, object_key: bytes):
-        try:
-            self._respond(wh, req_id, True, self._locate_object(object_key))
-        except KeyError as e:
-            self._respond(wh, req_id, False, e)
+    @loop_thread_only
+    def _on_locate_object(self, handle, token: int, keys: List[bytes]) -> None:
+        """Answer a batched ("locate_object", token, keys) directory query;
+        the reply coalesces with whatever else this loop iteration sends."""
+        out = {}
+        for key in keys:
+            try:
+                out[key] = self._locate_object(key)
+            except KeyError:
+                pass  # unsealed/freed: absent from the reply
+        self._send_to(handle, ("object_locations", token, out))
+
+    def _cmd_object_replica(self, payload):
+        """A puller cached an object's bytes in its node's store: register the
+        node as a replica so later locates offer it as an alternate source
+        (and mid-stream owner death has somewhere to fail over to)."""
+        object_key, node_id = payload
+        if not node_id:
+            return False
+        meta = self.object_table.get(object_key)
+        if meta is None:
+            # Freed before this (async) registration arrived: the puller's
+            # cache file is already an orphan _purge_replicas will never
+            # see — delete it now instead of leaking node shm.
+            node = self.nodes.get(NodeID(node_id))
+            if node is not None:
+                self._delete_replica_file(node, object_key.hex())
+            return False
+        if node_id == meta.node_id:
+            return False
+        node = self.nodes.get(NodeID(node_id))
+        if node is None or not node.alive:
+            return False  # node gone: its store (and the file) died with it
+        # Register even when the holder can't SERVE peers (no data server,
+        # e.g. the head's push listener failed to start): the entry is what
+        # lets _purge_replicas delete the cache file on free — skipping it
+        # leaks the bytes for the session. _locate_object re-checks
+        # data_address before offering the node as a pull source.
+        self.object_replicas.setdefault(object_key, set()).add(node_id)
+        return True
+
+    def _req_object_replica(self, wh, req_id: Optional[int], payload):
+        # Rides the one-way "cmd" path from workers/client drivers.
+        self._respond(wh, req_id, True, self._cmd_object_replica(payload))
+
+    def _purge_replicas(self, object_key: bytes, meta: ObjectMeta) -> None:
+        """The object was freed: delete its cached copies everywhere (the
+        owner's segment goes through _delete_segment; replicas are plain
+        cache files named by object id in each holder node's store dir)."""
+        nodes = self.object_replicas.pop(object_key, None)
+        if not nodes:
+            return
+        cache_name = meta.object_id.hex()
+        for nid in nodes:
+            node = self.nodes.get(NodeID(nid))
+            if node is not None:
+                self._delete_replica_file(node, cache_name)
+
+    def _delete_replica_file(self, node: "NodeState", cache_name: str) -> None:
+        path = os.path.join(node.shm_dir, cache_name)
+        if node.daemon is not None:
+            self._send_to(node.daemon, ("delete_object", path))
+        else:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def _drop_node_replicas(self, node_id: bytes) -> None:
+        """A node died: its cached copies are gone — stop offering them."""
+        for key in [k for k, s in self.object_replicas.items() if node_id in s]:
+            s = self.object_replicas[key]
+            s.discard(node_id)
+            if not s:
+                del self.object_replicas[key]
+
+    def _cmd_transfer_stats(self, _):
+        """Data-plane introspection: cumulative relay/locality counters (the
+        zero-head-bytes contract is `relay_pulls == 0` for peer-served
+        workloads) plus the head's own transfer-manager totals."""
+        from ray_tpu._private import object_transfer
+
+        out = dict(self._transfer_stats)
+        out["replica_entries"] = sum(
+            len(s) for s in self.object_replicas.values()
+        )
+        out["head_transfer"] = dict(object_transfer._STATS)
+        return out
 
     def _req_pull_object(self, wh, req_id: int, object_key: bytes):
-        """A reader is missing a sealed object's segment locally: relay the bytes
-        from whichever node (daemon or client driver) holds them. The 2-hop relay
-        keeps round 2 simple; a direct node-to-node data plane can replace it
-        behind this request without touching callers (reference pulls peer-direct:
-        `/root/reference/src/ray/object_manager/object_manager.cc`)."""
+        """A reader is missing a sealed object's segment locally and could not
+        (or may not) pull it peer-direct: relay the bytes from whichever node
+        (daemon or client driver) holds them. Since the peer-to-peer data
+        plane (object_transfer.py) this is the FALLBACK route — owners
+        without a data server (client drivers), dead peer links, and
+        peer-transfer-disabled runs."""
 
         def respond(ok: bool, payload):
             self._respond(wh, req_id, ok, payload)
@@ -3281,41 +3429,47 @@ class Scheduler:
                 ))
                 return
         if source is None:
-            # Head-local: virtual nodes and the head node share the head's shm
-            # dir, so the segment is directly readable here. Read off-thread —
-            # a multi-GB read must not stall the scheduling loop (responses are
-            # lock-protected sends, safe from other threads). Arena objects
-            # read their allocation slice of the arena file.
-            def _read_and_respond():
-                from ray_tpu._private.object_store import read_segment
-
-                try:
-                    data = read_segment(meta.segment, meta.arena_offset, meta.size)
-                except OSError as e:
-                    respond(False, e)
-                    return
-                respond(True, (meta, data))
-
-            threading.Thread(target=_read_and_respond, daemon=True, name="pull-read").start()
+            # Head-local: virtual nodes and the head node share the head's
+            # shm dir, so the segment is directly readable here. The transfer
+            # manager's coalescing read pool does it off-thread (a multi-GB
+            # read must not stall the scheduling loop) and folds concurrent
+            # pulls of the same key into ONE read — the old ad-hoc
+            # "pull-read" thread per request did neither. Responders are
+            # @any_thread by construction (_respond / future settles).
+            self._transfer_stats["local_reads"] += 1
+            self._transfer.read_local(meta, respond)
             return
+        # Remote relay: coalesce concurrent pulls of one key into a single
+        # read_object round trip; every waiter shares the reply.
+        waiters = self._relay_waiters.get(object_key)
+        if waiters is not None:
+            waiters.append(respond)
+            return
+        self._relay_waiters[object_key] = [respond]
+        self._transfer_stats["relay_pulls"] += 1
         self._pull_token += 1
         token = self._pull_token
-        self._pending_pulls[token] = (respond, meta)
+        self._pending_pulls[token] = (object_key, meta)
         if not source.send(
             ("read_object", token, meta.segment, meta.arena_offset, meta.size)
         ):
             self._pending_pulls.pop(token, None)
-            respond(False, ConnectionError("object source node is unreachable"))
+            for r in self._relay_waiters.pop(object_key, []):
+                r(False, ConnectionError("object source node is unreachable"))
 
     def _finish_pull(self, token: int, ok: bool, data):
         ent = self._pending_pulls.pop(token, None)
         if ent is None:
             return
-        respond, meta = ent
+        key, meta = ent
+        waiters = self._relay_waiters.pop(key, [])
         if ok:
-            respond(True, (meta, data))
+            self._transfer_stats["relay_bytes"] += len(data) if data else 0
+            for respond in waiters:
+                respond(True, (meta, data))
         else:
-            respond(False, OSError(f"remote segment read failed: {data}"))
+            for respond in waiters:
+                respond(False, OSError(f"remote segment read failed: {data}"))
 
     # ------------------------------------------------------------------ introspection
     # Cluster-wide "what is every process doing RIGHT NOW" (the `ray stack` /
@@ -4170,6 +4324,15 @@ class Scheduler:
                 best = node
         return best
 
+    def _note_locality(self, loc: Dict[bytes, int], node: NodeState) -> None:
+        """Locality-placement outcome counters (ray_tpu_locality_hits_total):
+        a hit means a task with byte-heavy args landed on a node already
+        holding some of them, so those transfers never happen."""
+        if not loc:
+            return
+        key = "locality_hits" if loc.get(node.node_id.binary()) else "locality_misses"
+        self._transfer_stats[key] += 1
+
     def _locality_bytes(self, rec: TaskRecord) -> Dict[bytes, int]:
         """Per-node resident bytes of this task's object arguments."""
         out: Dict[bytes, int] = {}
@@ -4317,8 +4480,15 @@ class Scheduler:
 
     def _note_dispatch(self, rec: TaskRecord, now: float) -> None:
         """Stamp the lease_granted stage + dispatch telemetry (plain ints —
-        materialized at loop-tick cadence)."""
+        materialized at loop-tick cadence). The ONE locality-counting point:
+        every dispatch path (fresh lease, pipelined push, actor creation)
+        lands here exactly once per task, so the hit rate counts placement
+        OUTCOMES — never _pick_node probes repeated across scheduler ticks
+        for a task stuck behind the worker cap."""
         rec.stage_ts["lease_granted"] = now
+        node = self.nodes.get(rec.node)
+        if node is not None:
+            self._note_locality(self._locality_bytes(rec), node)
         tel = self.telemetry
         tel.dispatched += 1
         if tel.enabled:

@@ -72,6 +72,10 @@ class SchedulerTelemetry:
         self.stack_dumps_oob = 0
         self.stack_dumps_unavailable = 0
         self.profile_sessions = 0
+        # Data-plane cursor: sched._transfer_stats is CUMULATIVE (the
+        # transfer_stats() introspection reads it directly), so the tick
+        # exports deltas against this snapshot.
+        self._last_transfer: Dict[str, int] = {}
 
     # ---------------------------------------------------------------- ticks
     def on_iteration(self, sched, now: float) -> None:
@@ -117,6 +121,15 @@ class SchedulerTelemetry:
         if self.hb_dead_daemon:
             m["hb_dead"].inc(self.hb_dead_daemon, {"kind": "daemon"})
             self.hb_dead_daemon = 0
+        ts = sched._transfer_stats
+        last = self._last_transfer
+        for attr, metric in (("locality_hits", "locality_hits"),
+                             ("relay_pulls", "relay_pulls"),
+                             ("relay_bytes", "relay_bytes")):
+            d = ts[attr] - last.get(attr, 0)
+            if d:
+                m[metric].inc(d)
+                last[attr] = ts[attr]
         if self.finished:
             m["terminal"].inc(self.finished, {"state": "FINISHED"})
             self.finished = 0
@@ -188,6 +201,17 @@ class SchedulerTelemetry:
             "profile_sessions": Counter(
                 "ray_tpu_profile_sessions_total",
                 "cluster-wide sampling-profiler sessions started"),
+            "locality_hits": Counter(
+                "ray_tpu_locality_hits_total",
+                "tasks with byte-heavy args placed on a node already "
+                "holding them (those transfers never happen)"),
+            "relay_pulls": Counter(
+                "ray_tpu_transfer_relay_total",
+                "cross-node pulls that fell back to relaying bytes through "
+                "the head (peer-direct is the expected route)"),
+            "relay_bytes": Counter(
+                "ray_tpu_transfer_relay_bytes_total",
+                "object bytes relayed through the head's control plane"),
             "dispatch_wait": Histogram(
                 "ray_tpu_scheduler_dispatch_wait_s",
                 "queued -> lease_granted wait per task",
@@ -326,6 +350,63 @@ def ensure_objectstore_client_metrics() -> None:
         if d:
             pull_bytes.inc(d)
         last.update({k: s[k] for k in last})
+
+    register_collector(collect)
+
+
+# ------------------------------------------------------------- data plane
+_transfer_installed = False
+
+
+def ensure_transfer_metrics() -> None:
+    """Publish the peer-transfer counters accumulated in
+    object_transfer._STATS (per process): chunk/byte flow by direction and
+    the PullManager's queue/in-flight gauges."""
+    global _transfer_installed
+    if _transfer_installed:
+        return
+    _transfer_installed = True
+    from ray_tpu._private import object_transfer
+    from ray_tpu.util.metrics import Counter, Gauge, register_collector
+
+    bytes_total = Counter("ray_tpu_transfer_bytes_total",
+                          "object bytes moved by peer-direct transfers",
+                          ("direction",))
+    chunks_total = Counter("ray_tpu_transfer_chunks_total",
+                           "transfer_chunk frames moved by peer-direct "
+                           "transfers", ("direction",))
+    pulls_total = Counter("ray_tpu_transfer_pulls_total",
+                          "PullManager transfers by outcome "
+                          "(completed/failed/cancelled/deduped)", ("outcome",))
+    queue_depth = Gauge("ray_tpu_pull_queue_depth",
+                        "pulls waiting for an in-flight slot "
+                        "(transfer_max_inflight_pulls)")
+    inflight = Gauge("ray_tpu_pull_inflight",
+                     "pulls currently streaming chunks")
+    last = {"bytes_in": 0, "bytes_out": 0, "chunks_in": 0, "chunks_out": 0,
+            "pulls_completed": 0, "pulls_failed": 0, "pulls_cancelled": 0,
+            "pulls_deduped": 0}
+
+    def collect():
+        # Snapshot once; diff and advance the cursor from the snapshot (see
+        # the batching collector for why).
+        s = dict(object_transfer._STATS)
+        for key, metric, tag in (
+            ("bytes_in", bytes_total, {"direction": "in"}),
+            ("bytes_out", bytes_total, {"direction": "out"}),
+            ("chunks_in", chunks_total, {"direction": "in"}),
+            ("chunks_out", chunks_total, {"direction": "out"}),
+            ("pulls_completed", pulls_total, {"outcome": "completed"}),
+            ("pulls_failed", pulls_total, {"outcome": "failed"}),
+            ("pulls_cancelled", pulls_total, {"outcome": "cancelled"}),
+            ("pulls_deduped", pulls_total, {"outcome": "deduped"}),
+        ):
+            d = s[key] - last[key]
+            if d:
+                metric.inc(d, tag)
+            last[key] = s[key]
+        queue_depth.set(float(s["queue_depth"]))
+        inflight.set(float(s["inflight"]))
 
     register_collector(collect)
 

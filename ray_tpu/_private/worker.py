@@ -341,6 +341,9 @@ class _WorkerState:
         self.mode: Optional[str] = None
         self.job_id: Optional[JobID] = None
         self.store: Optional[LocalObjectStore] = None
+        # Peer-to-peer data-plane manager for this process's pulls
+        # (object_transfer.ObjectTransferManager); None until init/connect.
+        self.transfer = None
         self.context = None  # DriverContext | WorkerProcContext
         # Per-THREAD: threaded actors run concurrent calls, each with its own
         # current task (put-ID minting and lineage attribution key off it).
@@ -529,6 +532,9 @@ class DriverContext:
         self.scheduler.call("reconstruct_object", (key, inner)).result()
         return inner.result(timeout=get_config().object_pull_timeout_s)
 
+    def transfer_stats(self):
+        return self.scheduler.call("transfer_stats", None).result()
+
     def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
         from ray_tpu._private.object_store import resolve_for_read
 
@@ -547,9 +553,15 @@ class DriverContext:
         def locate(key: bytes):
             return self.scheduler.call("locate_object", key).result()
 
+        def note_replica(key: bytes):
+            self.scheduler.call_nowait(
+                "object_replica", (key, global_worker.store.node_id)
+            )
+
         return resolve_for_read(
             global_worker.store, meta, pull, get_config().force_object_pulls,
-            locate_fn=locate,
+            locate_fn=locate, transfer=global_worker.transfer,
+            replica_fn=note_replica,
         )
 
 
@@ -572,6 +584,10 @@ class RemoteDriverContext:
                 _print_worker_log(payload)
             elif channel == "errors":
                 _print_worker_error(payload)
+        elif msg[0] == "object_locations":
+            from ray_tpu._private import object_transfer
+
+            object_transfer.deliver_locations(msg[1], msg[2])
         elif msg[0] == "read_object":
             # (token, path[, offset, length]) — offset/length arrive for
             # arena-backed objects (MESSAGE_GRAMMAR "read_object"). The old
@@ -746,7 +762,11 @@ class RemoteDriverContext:
             "reconstruct_object", key, timeout=get_config().object_pull_timeout_s
         )
 
+    def transfer_stats(self):
+        return self.wc.request("driver_cmd", ("transfer_stats", None))
+
     def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
+        from ray_tpu._private import object_transfer
         from ray_tpu._private.object_store import resolve_for_read
 
         def pull(key: bytes):
@@ -760,13 +780,19 @@ class RemoteDriverContext:
                 ) from None
 
         def locate(key: bytes):
-            return self.wc.request(
-                "locate_object", key, timeout=get_config().object_pull_timeout_s
-            )
+            return object_transfer.locate_via(
+                self.wc.send, [key],
+                timeout=get_config().object_pull_timeout_s,
+            ).get(key)
+
+        def note_replica(key: bytes):
+            self.wc.send_async(("cmd", "object_replica",
+                                (key, global_worker.store.node_id)))
 
         return resolve_for_read(
             global_worker.store, meta, pull, get_config().force_object_pulls,
-            locate_fn=locate,
+            locate_fn=locate, transfer=global_worker.transfer,
+            replica_fn=note_replica,
         )
 
 
@@ -873,6 +899,9 @@ class WorkerProcContext:
     def autoscaler_state(self):
         return self.rt.wc.request("driver_cmd", ("autoscaler_state", None))
 
+    def transfer_stats(self):
+        return self.rt.wc.request("driver_cmd", ("transfer_stats", None))
+
     def free(self, ids):
         return []
 
@@ -907,6 +936,7 @@ def _connect_worker_process(runtime):
     """Called by worker_main to bind the module API to this worker process."""
     global_worker.mode = WORKER_MODE
     global_worker.store = runtime.store
+    global_worker.transfer = runtime.transfer
     global_worker.context = WorkerProcContext(runtime)
     global_worker.job_id = JobID.from_int(1)
     set_config(runtime.args.config)
@@ -1024,6 +1054,11 @@ def init(
     global_worker.store = LocalObjectStore(
         os.path.join(session_dir, "shm"), node_id=head_node_id.binary()
     )
+    from ray_tpu._private.object_transfer import ObjectTransferManager
+
+    global_worker.transfer = ObjectTransferManager(
+        global_worker.store.shm_dir, cfg=cfg, authkey=scheduler.authkey
+    )
     global_worker.context = DriverContext(scheduler)
     global_worker.namespace = namespace or "default"
     global_worker.node = scheduler
@@ -1109,6 +1144,9 @@ def _init_client_mode(address: str, namespace: Optional[str],
     global_worker.job_id = JobID.from_int(1)
     global_worker.session_dir = None  # owned by the head, not us
     global_worker.store = store
+    from ray_tpu._private.object_transfer import ObjectTransferManager
+
+    global_worker.transfer = ObjectTransferManager(store.shm_dir)
     global_worker.context = ctx
     global_worker.namespace = namespace or "default"
     global_worker.node = None
@@ -1159,9 +1197,15 @@ def shutdown():
             if global_worker.session_dir:
                 # scheduler.stop() above removed the spill dir.
                 shutil.rmtree(global_worker.session_dir, ignore_errors=True)
+    if global_worker.transfer is not None:
+        try:
+            global_worker.transfer.close()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
     global_worker.mode = None
     global_worker.context = None
     global_worker.store = None
+    global_worker.transfer = None
     global_worker.node = None
     global_worker.session_dir = None
     global_worker._put_counter = 0

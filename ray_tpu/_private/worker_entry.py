@@ -19,7 +19,11 @@ def dial(address: str, authkey: bytes):
 
     if address.startswith("tcp://"):
         host, _, port = address[len("tcp://"):].rpartition(":")
-        return Client((host, int(port)), authkey=authkey)
+        conn = Client((host, int(port)), authkey=authkey)
+        from ray_tpu._private.object_transfer import set_nodelay
+
+        set_nodelay(conn)
+        return conn
     return Client(address, family="AF_UNIX", authkey=authkey)
 
 

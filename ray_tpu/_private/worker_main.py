@@ -47,28 +47,10 @@ class WorkerArgs:
     head_address: Optional[str] = None
 
 
-def _abrupt_close(conn) -> None:
-    """Hard-close a multiprocessing Connection so BOTH ends observe EOF
-    immediately (the failpoint "close" action). `conn.close()` alone is not
-    enough: a reader thread blocked in recv keeps the underlying file
-    description referenced, so no FIN is sent and neither side ever wakes —
-    shutdown(SHUT_RDWR) on a dup'd fd tears the socket down for real."""
-    import socket as _socket
-
-    try:
-        s = _socket.socket(fileno=os.dup(conn.fileno()))
-    except OSError:
-        try:
-            conn.close()
-        except OSError:
-            pass
-        return
-    try:
-        s.shutdown(_socket.SHUT_RDWR)
-    except OSError:
-        pass
-    finally:
-        s.close()
+# Hard-close for the failpoint "close" action and send-failure cleanup: the
+# ONE implementation (dup-fd shutdown(SHUT_RDWR) so a blocked reader sees a
+# real EOF) lives with the data plane, which needs the same teardown.
+from ray_tpu._private.object_transfer import _abrupt_close  # noqa: E402
 
 
 class WorkerConnection:
@@ -106,6 +88,11 @@ class WorkerConnection:
         # Hook for message kinds beyond exec/resp/shutdown (e.g. a client-mode
         # driver serving "read_object" pulls for objects it put).
         self.misc_handler = None
+        # Data-plane prefetch hook: called with each queued ExecRequest so
+        # the transfer manager can start pulling its remote args at PREFETCH
+        # priority while earlier tasks still run (reference: pull_manager.h
+        # prefetch lane). Must never block the reader thread.
+        self.prefetch_hook = None
         # Introspection hook: returns this process's all-thread stack payload
         # (worker_loop binds it with task annotations from the runtime). The
         # reader thread serves dump_stacks itself — it stays responsive while
@@ -163,6 +150,15 @@ class WorkerConnection:
         kind = msg[0]
         if kind == "exec":
             self.task_queue.put(msg[1])
+            if self.prefetch_hook is not None:
+                try:
+                    self.prefetch_hook(msg[1])
+                except Exception:  # noqa: BLE001 — prefetch is best-effort
+                    pass
+        elif kind == "object_locations":
+            from ray_tpu._private import object_transfer
+
+            object_transfer.deliver_locations(msg[1], msg[2])
         elif kind == "resp":
             _, req_id, ok, payload = msg
             with self._req_lock:
@@ -367,9 +363,16 @@ class WorkerRuntime:
     """Per-process runtime state: object store facade, function cache, actor."""
 
     def __init__(self, args: WorkerArgs, wc: WorkerConnection):
+        from ray_tpu._private.object_transfer import ObjectTransferManager
+
         self.args = args
         self.wc = wc
         self.store = LocalObjectStore(args.shm_dir, node_id=bytes.fromhex(args.node_id_hex))
+        # Pull half of the peer-to-peer data plane: remote segments stream
+        # straight from the holder node's data server into this node's store
+        # cache (chunked, priority-admitted, deduped across concurrent
+        # readers); the head relay is the fallback only.
+        self.transfer = ObjectTransferManager(args.shm_dir, cfg=args.config)
         self.functions: Dict[str, Any] = {}
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
@@ -449,11 +452,35 @@ class WorkerRuntime:
                 self._aio_loop = loop
         return asyncio.run_coroutine_threadsafe(coro, self._aio_loop).result()
 
-    def ensure_local(self, meta: ObjectMeta) -> ObjectMeta:
-        """Make a segment-backed object readable on this node, pulling the
-        bytes PEER-DIRECT from the owning daemon's data server when one
-        exists, else relaying through the head (the reader-side of the
-        reference's PullManager, `pull_manager.h:52`)."""
+    def locate_many(self, keys) -> dict:
+        """Batched location-directory query over the control connection
+        (locate_object/object_locations tags)."""
+        from ray_tpu._private import object_transfer
+
+        return object_transfer.locate_via(
+            self.wc.send, list(keys),
+            timeout=self.args.config.object_pull_timeout_s,
+        )
+
+    def prefetch_args(self, req: ExecRequest) -> None:
+        """Queued-task argument prefetch: start pulling remote arg segments
+        at PREFETCH priority while earlier tasks still run. Runs on the
+        reader thread — everything heavier than the enqueue happens on the
+        transfer manager's prefetch thread."""
+        metas = [
+            m for m in
+            list(req.arg_metas) + list(req.kwarg_metas.values())
+            # Own-node args never transfer, whatever the force_object_pulls
+            # testing knob says (matching resolve_for_read's remote check).
+            if m is None or m.node_id != self.store.node_id
+        ]
+        self.transfer.prefetch(metas, self.locate_many)
+
+    def ensure_local(self, meta: ObjectMeta, priority=None) -> ObjectMeta:
+        """Make a segment-backed object readable on this node, streaming the
+        bytes PEER-DIRECT from a holder node's data server in bounded chunks
+        (the reader side of the reference's PullManager, `pull_manager.h:52`),
+        else relaying through the head."""
         from ray_tpu._private.object_store import resolve_for_read
 
         def pull(key: bytes):
@@ -462,23 +489,27 @@ class WorkerRuntime:
             )
 
         def locate(key: bytes):
-            return self.wc.request(
-                "locate_object", key, timeout=self.args.config.object_pull_timeout_s
-            )
+            return self.locate_many([key]).get(key)
+
+        def note_replica(key: bytes):
+            # This node now holds a cached copy: register it in the head's
+            # location directory so other nodes can pull from here.
+            self.wc.send_async(("cmd", "object_replica", (key, self.store.node_id)))
 
         return resolve_for_read(
             self.store, meta, pull, self.args.config.force_object_pulls,
-            locate_fn=locate,
+            locate_fn=locate, transfer=self.transfer, priority=priority,
+            replica_fn=note_replica,
         )
 
-    def fetch_value(self, meta: ObjectMeta):
+    def fetch_value(self, meta: ObjectMeta, priority=None):
         """Read an object value, reconstructing from lineage if its bytes were
         lost (reference: ObjectRecoveryManager re-submitting the creating
         task). The shared recovery loop in `_private/retry.py` runs the
         reconstruction under the unified policy and surfaces a typed
         ObjectLostError on budget exhaustion."""
         try:
-            return self.store.get(self.ensure_local(meta))
+            return self.store.get(self.ensure_local(meta, priority=priority))
         except (OSError, ConnectionError) as first_err:
             from ray_tpu._private import retry
 
@@ -488,7 +519,7 @@ class WorkerRuntime:
                 lambda key: self.wc.request(
                     "reconstruct_object", key, timeout=cfg.object_pull_timeout_s
                 ),
-                lambda m: self.store.get(self.ensure_local(m)),
+                lambda m: self.store.get(self.ensure_local(m, priority=priority)),
                 first_err,
             )
             return value
@@ -600,8 +631,12 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
             # Partial-failure injection: die before any argument bytes are
             # touched — the task must retry cleanly with its deps re-pinned.
             failpoints.maybe_crash("worker.crash_before_args_fetched")
-        args = [rt.fetch_value(m) for m in req.arg_metas]
-        kwargs = {k: rt.fetch_value(m) for k, m in req.kwarg_metas.items()}
+        from ray_tpu._private.object_transfer import PRIORITY_TASK_ARGS
+
+        args = [rt.fetch_value(m, priority=PRIORITY_TASK_ARGS)
+                for m in req.arg_metas]
+        kwargs = {k: rt.fetch_value(m, priority=PRIORITY_TASK_ARGS)
+                  for k, m in req.kwarg_metas.items()}
         if stages is not None:
             # exec_start follows immediately: first-call function deserialize
             # is accounted to exec, keeping the stamp count per task at four.
@@ -771,6 +806,7 @@ def worker_loop(conn, args: WorkerArgs):
         )
 
     wc.introspect_fn = _introspect
+    wc.prefetch_hook = rt.prefetch_args
     introspection.register_oob_dump(
         introspection.stack_file_path(args.shm_dir, args.worker_id_hex)
     )

@@ -99,7 +99,11 @@ class Cluster:
     def _add_daemon_node(self, node_resources, labels) -> NodeID:
         from ray_tpu._private.launch import spawn_node_daemon
 
-        shm_dir = tempfile.mkdtemp(prefix="ray_tpu_node_")
+        # The node store is the SHARED-MEMORY store: back it with /dev/shm
+        # when present (a disk-backed tmpdir caps the data plane at the
+        # device's write bandwidth), like the head's session dir.
+        shm_root = "/dev/shm" if os.path.isdir("/dev/shm") else None
+        shm_dir = tempfile.mkdtemp(prefix="ray_tpu_node_", dir=shm_root)
         self._tmp_dirs.append(shm_dir)
         proc, node_hex = spawn_node_daemon(
             self._head_info["address"],

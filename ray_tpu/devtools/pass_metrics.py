@@ -34,6 +34,7 @@ DEFAULT_HOT_MODULES = (
     "ray_tpu._private.scheduler",
     "ray_tpu._private.batching",
     "ray_tpu._private.object_store",
+    "ray_tpu._private.object_transfer",
     "ray_tpu._private.worker",
     "ray_tpu._private.worker_main",
     "ray_tpu._private.serialization",

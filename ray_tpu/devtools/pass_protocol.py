@@ -45,6 +45,7 @@ DEFAULT_SENDER_MODULES = (
     "ray_tpu._private.batching",
     "ray_tpu._private.head",
     "ray_tpu._private.worker_entry",
+    "ray_tpu._private.object_transfer",
 )
 
 

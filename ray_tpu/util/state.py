@@ -57,6 +57,14 @@ def stacks(timeout_s: float | None = None) -> Dict[str, Dict[str, Any]]:
     return global_worker.context.dump_stacks(timeout_s)
 
 
+def transfer_stats() -> Dict[str, Any]:
+    """Data-plane counters from the head: cumulative relay pulls/bytes (zero
+    for peer-served workloads — the head answers location queries only),
+    locality-placement hits/misses, and live replica-directory size."""
+    _auto_init()
+    return global_worker.context.transfer_stats()
+
+
 def memory_summary() -> Dict[str, Any]:
     """`ray memory` analogue: per-object owner/refcount/location/size from
     the scheduler's ownership tables joined with the on-disk store state,

@@ -215,3 +215,21 @@ def test_remote_function_direct_call_rejected(ray_start_regular):
 
     with pytest.raises(TypeError):
         f()
+
+
+def test_config_env_override_tri_state(monkeypatch):
+    """Env overrides coerce through the RESOLVED annotation, not the type of
+    the default — a tri-state Optional[bool] field with default None must
+    accept 0/1 (and auto/none for the auto gate) instead of crashing every
+    process that inherits the env var."""
+    from ray_tpu._private.config import Config
+
+    monkeypatch.setenv("RAY_TPU_use_native_object_arena", "0")
+    monkeypatch.setenv("RAY_TPU_transfer_chunk_bytes", "65536")
+    cfg = Config().apply_overrides()
+    assert cfg.use_native_object_arena is False
+    assert cfg.transfer_chunk_bytes == 65536
+    monkeypatch.setenv("RAY_TPU_use_native_object_arena", "1")
+    assert Config().apply_overrides().use_native_object_arena is True
+    monkeypatch.setenv("RAY_TPU_use_native_object_arena", "auto")
+    assert Config().apply_overrides().use_native_object_arena is None

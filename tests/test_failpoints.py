@@ -507,3 +507,133 @@ def test_node_killer_timeline_events_and_dead_guard():
         assert {e["args"]["node_id"] for e in chaos} >= set(killer.kills)
     finally:
         cluster.shutdown()
+
+
+# ------------------------------------------- peer-transfer chaos combos
+# The data plane (object_transfer.py) moves cross-node bytes over dedicated
+# peer connections; these combos drive its failure modes on a REAL 2-daemon
+# cluster (forced pulls, so every cross-node read rides the wire). Each
+# combo runs twice with the same env schedule and must converge to the same
+# (correct) value — chunk faults fall back to the head relay, segment loss
+# falls through to lineage reconstruction.
+
+def _run_transfer_combo(env_spec, extra_env=None):
+    from ray_tpu.cluster_utils import Cluster
+
+    failpoints.reset()
+    os.environ["RAY_TPU_FAILPOINTS"] = env_spec
+    os.environ["RAY_TPU_force_object_pulls"] = "1"
+    os.environ["RAY_TPU_transfer_chunk_bytes"] = str(64 * 1024)
+    for k, v in (extra_env or {}).items():
+        os.environ[k] = v
+    cluster = None
+    try:
+        cluster = Cluster(head_node_args={"num_cpus": 2, "num_tpus": 0},
+                          real=True)
+        cluster.add_node(num_cpus=2, resources={"a": 1})
+        cluster.add_node(num_cpus=2, resources={"b": 1})
+
+        @ray_tpu.remote(resources={"a": 1}, max_retries=4)
+        def produce():
+            return np.arange(400_000)
+
+        @ray_tpu.remote(resources={"b": 1}, max_retries=4)
+        def consume(x):
+            return int(x.sum())
+
+        ref = produce.remote()
+        return ray_tpu.get(consume.remote(ref), timeout=120)
+    finally:
+        if cluster is not None:
+            cluster.shutdown()
+        for k in ("RAY_TPU_FAILPOINTS", "RAY_TPU_force_object_pulls",
+                  "RAY_TPU_transfer_chunk_bytes", *(extra_env or {})):
+            os.environ.pop(k, None)
+        failpoints.reset()
+
+
+TRANSFER_MATRIX = [
+    # A dropped chunk surfaces as a byte-count mismatch at transfer_end:
+    # the pull fails over to the head relay, the value stays correct.
+    ("transfer-chunk-drop", "transfer.chunk=drop@once", None),
+    # Duplicate chunk frames are idempotent (positional writes).
+    ("transfer-chunk-dup", "transfer.chunk=dup@once", None),
+    # Abrupt push-connection close mid-stream: the puller's reader EOFs,
+    # remaining locations (none) are tried, relay fallback serves the read.
+    ("transfer-chunk-close", "transfer.chunk=close@once", None),
+    # Peer dial failure: the transfer never starts; relay fallback.
+    ("transfer-peer-dial-error", "transfer.peer_dial=error@once", None),
+    # Segment loss under a mid-stream pull (file segments so the lose site
+    # can unlink): the consumer's transfer AND the relay both fail on the
+    # missing bytes; the unified retry policy reconstructs from lineage.
+    ("transfer-lose-segment-reconstruct", "object.lose_segment=lose@once",
+     {"RAY_TPU_use_native_object_arena": "0"}),
+]
+
+
+@pytest.mark.parametrize(
+    "env_spec,extra_env",
+    [m[1:] for m in TRANSFER_MATRIX],
+    ids=[m[0] for m in TRANSFER_MATRIX],
+)
+def test_transfer_chaos_matrix(env_spec, extra_env):
+    expected = int(np.arange(400_000).sum())
+    r1 = _run_transfer_combo(env_spec, extra_env)
+    r2 = _run_transfer_combo(env_spec, extra_env)
+    assert r1 == r2 == expected, (r1, r2, expected)
+
+
+def test_sender_daemon_death_mid_stream_fails_over_to_replica():
+    """SIGKILL the owning daemon while its chunks are streaming: the
+    puller's peer link EOFs mid-transfer and the PullManager re-drives the
+    pull onto the next replica from the location directory (the head's copy,
+    registered when the driver read the object) — never the byte relay."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import state
+
+    failpoints.reset()
+    # Slow every pushed chunk so the kill lands mid-stream deterministically
+    # (~160 chunks x 20ms = a >3s window).
+    os.environ["RAY_TPU_FAILPOINTS"] = "transfer.chunk=delay:0.02@always"
+    os.environ["RAY_TPU_force_object_pulls"] = "1"
+    os.environ["RAY_TPU_transfer_chunk_bytes"] = str(64 * 1024)
+    cluster = None
+    try:
+        cluster = Cluster(head_node_args={"num_cpus": 2, "num_tpus": 0},
+                          real=True)
+        node_a = cluster.add_node(num_cpus=2, resources={"a": 1})
+        cluster.add_node(num_cpus=2, resources={"b": 1})
+
+        @ray_tpu.remote(resources={"a": 1}, max_retries=4)
+        def produce():
+            return np.arange(1_250_000)  # 10MB
+
+        @ray_tpu.remote(resources={"b": 1}, max_retries=4)
+        def consume(x):
+            return int(x.sum())
+
+        ref = produce.remote()
+        # Driver read: caches the bytes in the head's store and registers
+        # the head node as a replica in the location directory.
+        assert ray_tpu.get(ref, timeout=120)[-1] == 1_249_999
+        assert state.transfer_stats()["replica_entries"] >= 1
+        result = consume.remote(ref)
+        time.sleep(1.0)  # consumer is mid-stream from daemon A
+        cluster.remove_node(node_a)  # SIGKILL + wait for head to notice
+        assert ray_tpu.get(result, timeout=120) == int(np.arange(1_250_000).sum())
+        # The failover rode the replica's data server — the head PUSHED
+        # chunks from its store's cached copy (replica pulls ask by
+        # store-relative object-id name; the owner's absolute path died with
+        # daemon A) — never the byte relay, and never a head-local segment
+        # read smuggling the payload over the control plane.
+        st = state.transfer_stats()
+        assert st["relay_pulls"] == 0, st
+        assert st["local_reads"] == 0, st
+        assert st["head_transfer"]["chunks_out"] >= 100, st
+    finally:
+        if cluster is not None:
+            cluster.shutdown()
+        for k in ("RAY_TPU_FAILPOINTS", "RAY_TPU_force_object_pulls",
+                  "RAY_TPU_transfer_chunk_bytes"):
+            os.environ.pop(k, None)
+        failpoints.reset()

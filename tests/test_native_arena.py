@@ -143,8 +143,12 @@ def test_refdrop_frees_arena_allocation(arena_runtime):
 
 def test_zero_copy_view_pins_allocation(arena_runtime):
     """A deserialized array keeps its arena block alive even after the
-    ObjectRef is dropped — freed blocks get recycled, so views must pin."""
+    ObjectRef is dropped — freed blocks get recycled, so views must pin.
+    On interpreters without PEP-688 __buffer__ (py<3.12) reads COPY their
+    buffers out instead: no pin exists (the block may free immediately),
+    but the array must stay intact under arena churn either way."""
     import ray_tpu
+    from ray_tpu._private.object_store import _PINNED_EXPORT
     from ray_tpu._private.worker import flush_ref_ops
 
     marker = np.full(200_000, 7.5)
@@ -155,8 +159,9 @@ def test_zero_copy_view_pins_allocation(arena_runtime):
     gc.collect()
     flush_ref_ops()
     time.sleep(0.5)
-    # Still pinned by `arr`'s buffer.
-    assert _arena_used() >= base
+    if _PINNED_EXPORT:
+        # Still pinned by `arr`'s buffer.
+        assert _arena_used() >= base
     # Hammer the arena with new objects; arr must stay intact.
     refs = [ray_tpu.put(np.zeros(200_000)) for _ in range(5)]
     assert float(arr[0]) == 7.5 and float(arr[-1]) == 7.5

@@ -1,0 +1,342 @@
+"""Peer-to-peer object data plane (`_private/object_transfer.py`).
+
+Covers the PullManager contract (priority admission, in-flight bounding,
+dedup, cancellation) at the unit level, chunked transfer integrity over the
+real wire, the zero-head-bytes property (cross-node gets never relay
+payload through the head), and locality-aware lease placement.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import object_transfer
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import ObjectID, TaskID, JobID
+from ray_tpu._private.object_store import ObjectMeta
+from ray_tpu.cluster_utils import Cluster
+
+
+def _meta(i: int, size: int = 64, node: bytes = b"n" * 16) -> ObjectMeta:
+    oid = ObjectID.for_put(TaskID.for_driver(JobID.from_int(1)), i)
+    return ObjectMeta(object_id=oid, size=size, segment=f"/fake/{oid.hex()}",
+                      node_id=node)
+
+
+class _StubPulls(object_transfer.PullManager):
+    """PullManager with the wire replaced: _start_transfer records the
+    admission order; tests complete/fail requests by hand."""
+
+    def __init__(self, tmp, **cfg_overrides):
+        cfg = Config()
+        for k, v in cfg_overrides.items():
+            setattr(cfg, k, v)
+        super().__init__(str(tmp), cfg, authkey=b"x")
+        self.started = []
+
+    def _start_transfer(self, req):
+        self.started.append(req.key)
+
+    def finish(self, key, ok=True):
+        with self._lock:
+            req = self._reqs[key]
+        if ok:
+            # Fabricate the cache file the transfer would have produced.
+            with open(req.final_path, "wb") as f:
+                f.write(b"y" * req.meta.size)
+            req.fh = None
+            req.tmp_path = None
+            with self._lock:
+                self._settle_locked(req, "done", None)
+            self._admit_next()
+        else:
+            self._finish_error(req, object_transfer.PullFailed("stub fail"))
+
+
+LOC = [(b"n" * 16, "127.0.0.1:1")]
+
+
+def test_pull_priority_and_inflight_bound(tmp_path):
+    """Admission respects max_inflight; the queue drains task-args before
+    gets before prefetches regardless of submission order."""
+    pm = _StubPulls(tmp_path, transfer_max_inflight_pulls=2)
+    metas = [_meta(i) for i in range(6)]
+    # Two admitted immediately (slots free), rest queue.
+    pm.pull_nowait(metas[0], LOC, object_transfer.PRIORITY_PREFETCH)
+    pm.pull_nowait(metas[1], LOC, object_transfer.PRIORITY_PREFETCH)
+    pm.pull_nowait(metas[2], LOC, object_transfer.PRIORITY_PREFETCH)
+    pm.pull_nowait(metas[3], LOC, object_transfer.PRIORITY_GET)
+    pm.pull_nowait(metas[4], LOC, object_transfer.PRIORITY_TASK_ARGS)
+    pm.pull_nowait(metas[5], LOC, object_transfer.PRIORITY_TASK_ARGS)
+    assert pm.started == [metas[0].object_id.binary(), metas[1].object_id.binary()]
+    assert object_transfer._STATS["queue_depth"] >= 4
+    # Finishing one admits the highest-priority queued request (task-args
+    # first, FIFO within the class), never the earlier-submitted prefetch.
+    pm.finish(metas[0].object_id.binary())
+    assert pm.started[-1] == metas[4].object_id.binary()
+    pm.finish(metas[1].object_id.binary())
+    assert pm.started[-1] == metas[5].object_id.binary()
+    pm.finish(metas[4].object_id.binary())
+    assert pm.started[-1] == metas[3].object_id.binary()
+    pm.finish(metas[5].object_id.binary())
+    assert pm.started[-1] == metas[2].object_id.binary()
+    assert len(pm.started) == 6
+
+
+def test_pull_dedup_coalesces_concurrent_readers(tmp_path):
+    """N concurrent pulls of one key = ONE transfer; every waiter gets the
+    same cached path."""
+    pm = _StubPulls(tmp_path)
+    meta = _meta(0)
+    results = []
+
+    def reader():
+        results.append(pm.pull(meta, LOC, object_transfer.PRIORITY_GET,
+                               timeout=10))
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 5
+    while not pm.started and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.1)  # let the rest pile onto the same request
+    assert len(pm.started) == 1
+    pm.finish(meta.object_id.binary())
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == 4 and len(set(results)) == 1
+    assert os.path.exists(results[0])
+
+
+def test_pull_cancellation(tmp_path):
+    """cancel() fails waiters with PullCancelled and frees the slot for the
+    next queued request."""
+    pm = _StubPulls(tmp_path, transfer_max_inflight_pulls=1)
+    m1, m2 = _meta(1), _meta(2)
+    errors = []
+
+    def reader():
+        try:
+            pm.pull(m1, LOC, object_transfer.PRIORITY_GET, timeout=10)
+        except object_transfer.PullCancelled as e:
+            errors.append(e)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    deadline = time.time() + 5
+    while not pm.started and time.time() < deadline:
+        time.sleep(0.01)
+    pm.pull_nowait(m2, LOC, object_transfer.PRIORITY_GET)  # queued behind m1
+    assert pm.cancel(m1.object_id.binary())
+    t.join(timeout=10)
+    assert len(errors) == 1
+    # The freed slot admitted the queued pull.
+    assert pm.started[-1] == m2.object_id.binary()
+    # Cancelling an unknown key is a no-op.
+    assert not pm.cancel(b"missing-key-000")
+
+
+def test_priority_upgrade_on_dedup(tmp_path):
+    """A queued prefetch re-files at GET priority when a reader joins it."""
+    pm = _StubPulls(tmp_path, transfer_max_inflight_pulls=1)
+    blocker, pre, other = _meta(1), _meta(2), _meta(3)
+    pm.pull_nowait(blocker, LOC, object_transfer.PRIORITY_GET)   # occupies slot
+    pm.pull_nowait(other, LOC, object_transfer.PRIORITY_GET)     # queued first
+    pm.pull_nowait(pre, LOC, object_transfer.PRIORITY_PREFETCH)  # queued last
+    got = []
+    t = threading.Thread(target=lambda: got.append(
+        pm.pull(pre, LOC, object_transfer.PRIORITY_TASK_ARGS, timeout=10)))
+    t.start()
+    time.sleep(0.1)
+    pm.finish(blocker.object_id.binary())
+    # The upgraded request outranks the earlier-queued GET.
+    assert pm.started[-1] == pre.object_id.binary()
+    pm.finish(pre.object_id.binary())
+    t.join(timeout=10)
+    assert got and got[0]
+    pm.finish(other.object_id.binary())
+
+
+def test_admit_drain_survives_mass_synchronous_failures(tmp_path):
+    """A dead source fails every admitted pull SYNCHRONOUSLY; draining a few
+    hundred queued pulls through the freed slot must be iterative — the
+    naive handoff recursed ~3 frames per queued request and blew the stack
+    mid-bookkeeping."""
+
+    class _PlugThenFail(object_transfer.PullManager):
+        def __init__(self, tmp):
+            cfg = Config()
+            cfg.transfer_max_inflight_pulls = 1
+            super().__init__(str(tmp), cfg, authkey=b"x")
+            self.plug = None
+
+        def _start_transfer(self, req):
+            if self.plug is None:
+                self.plug = req  # occupies the one slot; the rest queue
+                return
+            self._finish_error(req, object_transfer.PullFailed("down"))
+
+    pm = _PlugThenFail(tmp_path)
+    before = dict(object_transfer._STATS)  # gauges are process-global
+    metas = [_meta(i) for i in range(500)]
+    for m in metas:
+        pm.pull_nowait(m, LOC, object_transfer.PRIORITY_PREFETCH)
+    assert object_transfer._STATS["queue_depth"] - before["queue_depth"] >= 499
+    # Cancelling the plug admits the whole queue through the freed slot.
+    assert pm.cancel(pm.plug.key)
+    assert not pm._reqs
+    assert object_transfer._STATS["queue_depth"] == before["queue_depth"]
+    assert object_transfer._STATS["inflight"] == before["inflight"]
+
+
+# --------------------------------------------------------------------------
+# Wire-level tests (virtual cluster: the head's own push server serves the
+# shared arena; force_object_pulls drives every cross-node read over it).
+# --------------------------------------------------------------------------
+@pytest.fixture
+def forced_pull_cluster():
+    os.environ["RAY_TPU_force_object_pulls"] = "1"
+    cluster = None
+    try:
+        cluster = Cluster(head_node_args={
+            "num_cpus": 2,
+            # Force the arena even where the auto gate (py3.12+) would pick
+            # file segments: in this shared-dir virtual cluster a per-object
+            # file lands exactly on the puller's cache path, so the pull
+            # would short-circuit locally and never exercise the wire.
+            "_system_config": {"transfer_chunk_bytes": 64 * 1024,
+                               "use_native_object_arena": True},
+        })
+        cluster.add_node(num_cpus=2, resources={"b": 1})
+        yield cluster
+    finally:
+        os.environ.pop("RAY_TPU_force_object_pulls", None)
+        if cluster is not None:
+            cluster.shutdown()
+
+
+def test_chunk_reassembly_many_chunks(forced_pull_cluster):
+    """A 10MB arena object spans ~150 64KB chunks; the reassembled value is
+    bit-identical and the pull went through the chunked peer plane."""
+    from ray_tpu._native import available
+
+    if not available():
+        pytest.skip("native arena unavailable (file segments share the dir)")
+
+    @ray_tpu.remote(resources={"b": 0.5})
+    def produce():
+        return np.random.default_rng(7).standard_normal(1_250_000)
+
+    before = dict(object_transfer._STATS)
+    ref = produce.remote()
+    v = ray_tpu.get(ref, timeout=60)
+    np.testing.assert_array_equal(
+        v, np.random.default_rng(7).standard_normal(1_250_000))
+    assert object_transfer._STATS["chunks_in"] - before["chunks_in"] >= 100
+    assert (object_transfer._STATS["bytes_in"] - before["bytes_in"]
+            >= 10_000_000)
+    # Second get: served from the node cache, no new transfer.
+    mid = dict(object_transfer._STATS)
+    ray_tpu.get(ref, timeout=60)
+    assert object_transfer._STATS["chunks_in"] == mid["chunks_in"]
+
+
+# --------------------------------------------------------------------------
+# Real multi-daemon cluster: the zero-head-bytes property.
+# --------------------------------------------------------------------------
+@pytest.fixture
+def real_two_node_cluster():
+    os.environ["RAY_TPU_force_object_pulls"] = "1"
+    cluster = None
+    try:
+        cluster = Cluster(head_node_args={"num_cpus": 2, "num_tpus": 0},
+                          real=True)
+        cluster.add_node(num_cpus=2, resources={"a": 1})
+        cluster.add_node(num_cpus=2, resources={"b": 1})
+        yield cluster
+    finally:
+        os.environ.pop("RAY_TPU_force_object_pulls", None)
+        if cluster is not None:
+            cluster.shutdown()
+
+
+def test_cross_node_get_bypasses_head(real_two_node_cluster):
+    """Daemon→daemon gets move zero object bytes through the head: the
+    relay counters stay at 0 while real payloads cross nodes."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(resources={"a": 1})
+    def produce():
+        return np.arange(500_000)
+
+    @ray_tpu.remote(resources={"b": 1})
+    def consume(x):
+        return int(x.sum())
+
+    refs = [produce.remote() for _ in range(3)]
+    total = sum(ray_tpu.get([consume.remote(r) for r in refs], timeout=90))
+    assert total == 3 * int(np.arange(500_000).sum())
+    # Driver-side read too (colocated with the head: pulls peer-direct from
+    # the daemon's push server).
+    assert ray_tpu.get(refs[0], timeout=60)[-1] == 499_999
+    st = state.transfer_stats()
+    assert st["relay_pulls"] == 0, st
+    assert st["relay_bytes"] == 0, st
+
+
+def test_relay_counters_observe_fallback(real_two_node_cluster):
+    """Sanity for the zero-head-bytes assertion: with peer transfer OFF the
+    same workload MUST relay — proving the counter actually measures the
+    head's data path. (Configured per-pull via the manager toggle: the env
+    is shared with the already-running cluster.)"""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(resources={"a": 1})
+    def produce():
+        return np.arange(300_000)
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    global_worker.transfer.enabled = False
+    try:
+        assert ray_tpu.get(ref, timeout=60)[-1] == 299_999
+    finally:
+        global_worker.transfer.enabled = True
+    st = state.transfer_stats()
+    assert st["relay_pulls"] >= 1, st
+    assert st["relay_bytes"] > 0, st
+
+
+def test_locality_lease_placement_and_counter(real_two_node_cluster):
+    """A task whose 10MB argument lives on node A lands on node A (no
+    transfer at all), and the head counts the locality hit."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(resources={"a": 0.1})
+    def produce():
+        return np.zeros(1_250_000)  # 10MB on node A
+
+    @ray_tpu.remote
+    def where_am_i(arr):
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker.store.node_id.hex()
+
+    @ray_tpu.remote(resources={"a": 0.1})
+    def node_a_id():
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker.store.node_id.hex()
+
+    a_id = ray_tpu.get(node_a_id.remote(), timeout=60)
+    ref = produce.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    before = state.transfer_stats()["locality_hits"]
+    assert ray_tpu.get(where_am_i.remote(ref), timeout=60) == a_id
+    assert state.transfer_stats()["locality_hits"] > before

@@ -20,6 +20,10 @@ echo "== introspection smoke (stacks + memory + profile on a mini-cluster) =="
 timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/introspect_smoke.py
 
 echo
+echo "== data-plane smoke (peer-direct transfers, zero head relay) =="
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/dataplane_smoke.py
+
+echo
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
