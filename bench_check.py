@@ -45,6 +45,11 @@ REQUIRED_METRICS = (
     "task_throughput_failpoints_ratio",
     # Worker death -> detection -> respawn -> re-run wall time.
     "worker_kill_recovery_s",
+    # Ownership decentralization: 4 concurrent client drivers' aggregate
+    # throughput against one head (closed-loop clients, fixed offered load).
+    "task_throughput_multidriver",
+    # Framed wire codec vs pickle fallback on the submission burst.
+    "task_submit_burst_native_ratio",
 )
 
 # Data-plane suite (bench_dataplane.py -> BENCH_DATAPLANE.json): the

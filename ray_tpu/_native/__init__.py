@@ -141,3 +141,86 @@ class Arena:
 
 def available() -> bool:
     return load_arena_lib() is not None
+
+
+# --------------------------------------------------------------------------
+# wire_native: the control-plane codec (a real CPython extension, not a
+# ctypes lib — per-call ctypes marshalling would eat the win on sub-
+# microsecond pack/unpack calls). Same on-demand build-and-atomic-replace
+# flow as the arena; ray_tpu/_private/wire.py falls back to its pure-Python
+# codec when this returns None.
+# --------------------------------------------------------------------------
+_WIRE_SRC = os.path.join(_SRC_DIR, "wire_native.c")
+_WIRE_LIB = os.path.join(_SRC_DIR, "wire_native.so")
+_wire_mod = None
+_wire_failed = False
+_wire_lock = threading.Lock()
+
+
+def _build_wire() -> bool:
+    import sysconfig
+
+    include = sysconfig.get_paths().get("include")
+    if not include or not os.path.exists(os.path.join(include, "Python.h")):
+        return False
+    tmp = f"{_WIRE_LIB}.tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-I", include, "-o", tmp, _WIRE_SRC,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        return False
+    os.replace(tmp, _WIRE_LIB)
+    return True
+
+
+def load_wire_module():
+    """The wire_native extension module, building it on first use; None when
+    no toolchain / headers are available (callers use the Python codec)."""
+    global _wire_mod, _wire_failed
+    if _wire_mod is not None:
+        return _wire_mod
+    if _wire_failed:
+        return None
+    with _wire_lock:
+        if _wire_mod is not None:
+            return _wire_mod
+        if not os.path.exists(_WIRE_LIB) or os.path.getmtime(
+            _WIRE_LIB
+        ) < os.path.getmtime(_WIRE_SRC):
+            if not _build_wire():
+                _wire_failed = True
+                return None
+        def _try_load():
+            import importlib.machinery
+            import importlib.util
+
+            try:
+                loader = importlib.machinery.ExtensionFileLoader(
+                    "ray_tpu._native.wire_native", _WIRE_LIB
+                )
+                spec = importlib.util.spec_from_file_location(
+                    "ray_tpu._native.wire_native", _WIRE_LIB, loader=loader
+                )
+                mod = importlib.util.module_from_spec(spec)
+                loader.exec_module(mod)
+                return mod
+            except (ImportError, OSError):
+                return None
+
+        mod = _try_load()
+        if mod is None:
+            # A prebuilt .so from another machine/interpreter: rebuild once
+            # for THIS toolchain (source is authoritative) and retry.
+            if not _build_wire():
+                _wire_failed = True
+                return None
+            mod = _try_load()
+            if mod is None:
+                _wire_failed = True
+                return None
+        _wire_mod = mod
+        return _wire_mod

@@ -176,17 +176,20 @@ class BatchedSender:
         self._enqueue(msg, adaptive=True)
 
     @any_thread
-    def buffer(self, msg: Any) -> None:
+    def buffer(self, msg: Any, nbytes: Optional[int] = None) -> None:
         """Enqueue WITHOUT the adaptive immediate-send: for messages whose
         natural flush point is a caller-owned boundary (a pipelined worker's
         queue-empty flush, a completion batch) — the timer is only the
         backstop. On a timeshared core each process's send cadence looks
         sparse even when the aggregate rate is high, so the adaptive path
-        would defeat exactly the coalescing these messages exist for."""
-        self._enqueue(msg, adaptive=False)
+        would defeat exactly the coalescing these messages exist for.
+        `nbytes` lets hot callers pass a size they already know (a done's
+        result sizes) instead of paying the generic estimator walk."""
+        self._enqueue(msg, adaptive=False, nbytes=nbytes)
 
     @any_thread
-    def _enqueue(self, msg: Any, adaptive: bool) -> None:
+    def _enqueue(self, msg: Any, adaptive: bool,
+                 nbytes: Optional[int] = None) -> None:
         if not self.enabled:
             try:
                 self.send(msg)
@@ -197,7 +200,7 @@ class BatchedSender:
         with self._lock:
             now = time.monotonic()
             self._buf.append(msg)
-            self._nbytes += approx_msg_nbytes(msg)
+            self._nbytes += approx_msg_nbytes(msg) if nbytes is None else nbytes
             stale = now - self._last_write >= self.interval
             self._last_enqueue = now
             if (

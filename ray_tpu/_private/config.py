@@ -38,6 +38,7 @@ ENV_VARS = {
     "RAY_TPU_RUNTIME_ENV_PLUGINS": "extra runtime_env plugin entry points",
     "RAY_TPU_TRACING": "1 = enable util/tracing span collection",
     "RAY_TPU_USAGE_STATS_ENABLED": "0 disables the usage-stats stamp",
+    "RAY_TPU_WORKER_PROFILE": "debug: cProfile worker dispatch loops, dump to this dir",
     "RAY_TPU_WORKFLOW_ROOT": "workflow storage root directory",
 }
 
@@ -74,6 +75,14 @@ class Config:
     # Native arena size per node; 0 = same as object_store_memory. Objects
     # that don't fit the arena overflow to per-object file segments.
     object_arena_bytes: int = 0
+    # Framed wire codec for control-plane messages (_private/wire.py +
+    # _native/wire_native.c): specialized pack/unpack for the fixed-shape
+    # hot tags (submit/exec/done/batch/ref ops) instead of pickling every
+    # frame. None = auto: send wire frames iff the C extension builds/loads
+    # on this host (the PR6 arena-knob pattern). True forces the format
+    # (pure-Python codec without a toolchain); False sends pickle only.
+    # Receivers accept BOTH formats regardless (magic-byte dispatch).
+    use_native_protocol: Optional[bool] = None
     # When a put would exceed object_store_memory, relocate the just-written
     # (not yet visible) object to the disk spill directory instead of raising —
     # the analogue of plasma's fallback allocations to /tmp
@@ -128,6 +137,16 @@ class Config:
     # task_oom_retry_delay_ms) — immediate redispatch under sustained
     # pressure would burn every retry in under a second.
     task_oom_retry_delay_ms: int = 1000
+    # Burst coalescing for fire-and-forget scheduler commands (submits,
+    # inline put registrations): while they stream in faster than ~3k/s and
+    # NO blocking command is waiting, the scheduler loop stays parked for up
+    # to this budget so the submitting thread keeps the core — processing
+    # mid-burst would steal exactly the CPU the burst is timed on (one-core
+    # hosts timeshare the driver, the loop, and the workers). Any blocking
+    # call (get/wait/kv/...) cancels the deferral immediately, so sync
+    # round-trip latency is unaffected; a pure fire-and-forget stream sees
+    # dispatch start at most this many ms after its first submit. 0 = off.
+    scheduler_burst_coalesce_ms: float = 50.0
     # Max tasks in flight per leased stateless worker (1 = no pipelining).
     # When a dispatch class saturates the node, further same-class tasks
     # queue directly on the class's busy workers — the reference's
@@ -274,3 +293,8 @@ def get_config() -> Config:
 def set_config(cfg: Config) -> None:
     global _global_config
     _global_config = cfg
+    # The wire codec caches its send-knob resolution; a new config (init,
+    # worker startup, client connect) must re-resolve it.
+    from ray_tpu._private import wire
+
+    wire.refresh()

@@ -71,6 +71,16 @@ class BaseID:
         self._binary = bytes(binary)
 
     @classmethod
+    def _trusted(cls, binary: bytes):
+        """Construct from internally-minted bytes, skipping length validation
+        and the defensive copy. ID minting sits on the `.remote()` hot path
+        (one task id + N return ids per submit); the dataclass-style checked
+        __init__ costs more than the rest of the mint."""
+        self = object.__new__(cls)
+        self._binary = binary
+        return self
+
+    @classmethod
     def from_random(cls):
         return cls(_rand(cls.SIZE))
 
@@ -142,7 +152,7 @@ class TaskID(BaseID):
     @classmethod
     def for_task(cls, actor_id: ActorID):
         """Derive a TaskID scoped to an actor (or the job driver pseudo-actor)."""
-        return cls(_rand(TASK_ID_UNIQUE_BYTES) + actor_id.binary())
+        return cls._trusted(_rand(TASK_ID_UNIQUE_BYTES) + actor_id._binary)
 
     @classmethod
     def for_driver(cls, job_id: JobID):
@@ -151,7 +161,7 @@ class TaskID(BaseID):
 
     @property
     def actor_id(self) -> ActorID:
-        return ActorID(self._binary[TASK_ID_UNIQUE_BYTES:])
+        return ActorID._trusted(self._binary[TASK_ID_UNIQUE_BYTES:])
 
 
 class ObjectID(BaseID):
@@ -160,17 +170,21 @@ class ObjectID(BaseID):
     @classmethod
     def for_return(cls, task_id: TaskID, index: int):
         """Return object `index` of `task_id` (index >= 1, as in the reference)."""
-        return cls(task_id.binary() + index.to_bytes(OBJECT_ID_INDEX_BYTES, "little"))
+        return cls._trusted(
+            task_id._binary + index.to_bytes(OBJECT_ID_INDEX_BYTES, "little")
+        )
 
     @classmethod
     def for_put(cls, task_id: TaskID, put_index: int):
         # Put objects use the high bit of the index to disambiguate from returns.
         idx = put_index | 0x8000_0000
-        return cls(task_id.binary() + idx.to_bytes(OBJECT_ID_INDEX_BYTES, "little"))
+        return cls._trusted(
+            task_id._binary + idx.to_bytes(OBJECT_ID_INDEX_BYTES, "little")
+        )
 
     @property
     def task_id(self) -> TaskID:
-        return TaskID(self._binary[:TASK_ID_SIZE])
+        return TaskID._trusted(self._binary[:TASK_ID_SIZE])
 
     @property
     def is_put(self) -> bool:

@@ -428,6 +428,19 @@ class _PinnedArenaBuffer:
             pass  # interpreter teardown
 
 
+# Guard for put_serialized's fast inline-meta construction: a field added to
+# ObjectMeta without updating it would surface as a late AttributeError.
+_fast_meta_fields = {
+    "object_id", "size", "inband", "inline_buffers", "segment",
+    "buffer_layout", "is_error", "node_id", "arena_offset", "owns_payload",
+    "contained_ids", "spilled",
+}
+assert _fast_meta_fields == set(ObjectMeta.__dataclass_fields__), (
+    "put_serialized's fast path is out of sync with ObjectMeta: "
+    f"{_fast_meta_fields ^ set(ObjectMeta.__dataclass_fields__)}"
+)
+
+
 class LocalObjectStore:
     """Per-process facade over inline values and shm segments.
 
@@ -454,13 +467,25 @@ class LocalObjectStore:
     def put_serialized(self, object_id: ObjectID, sv: SerializedValue, inline_threshold: int) -> ObjectMeta:
         contained = sv.contained_ids or None
         if sv.total_size <= inline_threshold or not sv.buffers:
-            return ObjectMeta(
+            # Hot path (every small task result / put): bypass the dataclass
+            # __init__'s 12 field assignments (_fast_meta_fields guards the
+            # field set at import).
+            meta = ObjectMeta.__new__(ObjectMeta)
+            meta.__dict__.update(
                 object_id=object_id,
                 size=sv.total_size,
                 inband=sv.inband,
                 inline_buffers=[bytes(b) for b in sv.buffers],
+                segment=None,
+                buffer_layout=None,
+                is_error=False,
+                node_id=None,
+                arena_offset=None,
+                owns_payload=True,
                 contained_ids=contained,
+                spilled=False,
             )
+            return meta
         meta = None
         if self._arena is False:  # resolve once per store
             from ray_tpu._private.config import get_config

@@ -214,6 +214,16 @@ MESSAGE_GRAMMAR = {
                "owner-first locations (replicas after); address None means "
                "the holder has no data server (relay is the only route)",
     },
+    # ---- ownership decentralization (head -> owner seal forwarding) ------
+    "own_meta": {
+        "dir": "head->owner", "arity": (2, 2),
+        "readers": ("worker.dispatch", "driver.misc"),
+        "doc": "(meta,) — a sealed ObjectMeta forwarded to the process that "
+               "OWNS the object (submitted its task): the owner's "
+               "OwnershipTable is the record of truth, so its local gets "
+               "resolve in-process without a head round trip. Coalesces "
+               "into batch frames like any control message",
+    },
     # ---- peer-to-peer chunked transfers (node<->node, bypassing the head) -
     "transfer_begin": {
         "dir": "puller->pusher", "arity": (6, 6),

@@ -46,7 +46,7 @@ from ray_tpu._private.ids import (
     WorkerID,
 )
 from ray_tpu._private.object_store import ObjectMeta
-from ray_tpu._private.protocol import ExecRequest, TaskSpec
+from ray_tpu._private.protocol import ExecRequest, FunctionDescriptor, TaskSpec
 from ray_tpu._private.worker_main import WorkerArgs, worker_loop
 
 _mp = multiprocessing.get_context("spawn")
@@ -316,6 +316,68 @@ class TaskRecord:
     stage_ts: Dict[str, float] = field(default_factory=dict)
 
 
+def fast_task_record(
+    spec: TaskSpec,
+    arg_entries,
+    kwarg_entries,
+    return_ids,
+    func_blob,
+    retries_left: int = 0,
+    dispatch_key: Optional[tuple] = None,
+) -> TaskRecord:
+    """Hot-path TaskRecord construction: one dict.update instead of the
+    dataclass __init__'s ~28 field assignments + default factories. Used by
+    the `.remote()` submission path, where record construction is a
+    measurable slice of the per-task budget. `_FAST_RECORD_FIELDS` below
+    asserts this stays in sync with the dataclass definition."""
+    rec = TaskRecord.__new__(TaskRecord)
+    rec.__dict__.update(
+        spec=spec,
+        arg_entries=arg_entries,
+        kwarg_entries=kwarg_entries,
+        return_ids=return_ids,
+        func_blob=func_blob,
+        retries_left=retries_left,
+        state="PENDING",
+        worker=None,
+        node=None,
+        acquired={},
+        acquired_pg=None,
+        unresolved=0,
+        submitted_at=spec.submitted_ts,
+        dep_ids=[],
+        pins_released=False,
+        stream_metas=[],
+        stream_waiters=[],
+        stream_total=None,
+        stream_owner=None,
+        stream_released=False,
+        stream_requested=-1,
+        throttle_waiters=[],
+        dispatch_key=dispatch_key,
+        running_since=0.0,
+        owner="",
+        oom_killed=False,
+        oom_detail="",
+        stage_ts={},
+    )
+    return rec
+
+
+# Guard: fast_task_record bypasses the dataclass __init__, so a field added
+# to TaskRecord without updating it would surface as a late AttributeError
+# deep in the scheduler. Fail at import instead.
+_FAST_RECORD_FIELDS = set(
+    fast_task_record(
+        TaskSpec(task_id=None, func=FunctionDescriptor("", "")), [], {}, [], None
+    ).__dict__
+)
+assert _FAST_RECORD_FIELDS == {f.name for f in TaskRecord.__dataclass_fields__.values()}, (
+    "fast_task_record is out of sync with the TaskRecord dataclass: "
+    f"{_FAST_RECORD_FIELDS ^ {f.name for f in TaskRecord.__dataclass_fields__.values()}}"
+)
+
+
 class _PendingQueue:
     """Pending tasks indexed by dispatch class.
 
@@ -546,6 +608,13 @@ class Scheduler:
         self._commands: "queue.SimpleQueue" = queue.SimpleQueue()
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
+        # Urgent wake channel: blocking call()s signal here. During burst
+        # coalescing the loop stops watching the NORMAL wake fd (submit
+        # wakes accumulate silently), but stays responsive to this one — a
+        # get/wait must never pay the coalesce budget.
+        self._urgent_r, self._urgent_w = socket.socketpair()
+        self._urgent_r.setblocking(False)
+        self._urgent_pending = False
         # True while a wake byte is undrained: submit bursts send one wake
         # syscall, not one per task. _wake_lock couples the flag to the byte
         # state — set+send and drain+clear are each atomic, so the flag can
@@ -553,6 +622,29 @@ class Scheduler:
         # until the loop's poll timeout).
         self._wake_pending = False
         self._wake_lock = threading.Lock()
+        # Burst coalescing (scheduler_burst_coalesce_ms): fire-and-forget
+        # command streams defer the drain while hot; any blocking call()
+        # cancels. _blocking_pending counts queued fut-carrying commands
+        # (mutated under _wake_lock from API threads, decremented by the
+        # loop); _last_cmd_enqueue timestamps the newest nowait command.
+        self._blocking_pending = 0
+        # In-process driver threads parked on the OwnershipTable (their get()
+        # never enters the command queue): counted here so burst coalescing
+        # yields to them exactly like a blocking call().
+        self._owner_waiters = 0
+        self._last_cmd_enqueue = 0.0
+        self._burst_defer_start: Optional[float] = None
+        self._burst_coalesce_s = max(
+            0.0, float(config.scheduler_burst_coalesce_ms) / 1000.0
+        )
+        # A command stream counts as "hot" while enqueues arrive closer
+        # together than this (~500/s); sparse traffic processes immediately.
+        # Loose on purpose: a GC pause or an unrelated conn wake mid-burst
+        # must not read as "stream ended" and trigger a full drain inside
+        # the burst (blocking calls cancel deferral regardless, so the only
+        # cost of the loose window is added dispatch latency for sparse
+        # PURE fire-and-forget traffic, bounded by the coalesce budget).
+        self._burst_hot_s = 0.002
         # Outbound control-plane micro-batching (batching.py): while the loop
         # thread is inside an iteration, messages to workers/drivers/daemons
         # coalesce per connection into ("batch", [msgs]) frames, flushed on a
@@ -580,7 +672,25 @@ class Scheduler:
         self._conn_to_worker: Dict[Any, WorkerHandle] = {}
         self._conn_to_daemon: Dict[Any, DaemonHandle] = {}
         self._conn_to_driver: Dict[Any, DriverHandle] = {}
+        # Persistent readiness watcher for the loop: connections register
+        # once at attach and unregister at death, instead of the loop
+        # rebuilding + re-registering every fd per iteration (mpc.wait was
+        # ~25% of loop samples under task load). Loop-thread only.
+        import selectors as _selectors
+
+        self._selectors_mod = _selectors
+        self._selector = _selectors.DefaultSelector()
         self._workers_by_id: Dict[str, WorkerHandle] = {}
+        # Ownership decentralization (_private/ownership.py): sealed metas
+        # forward to the owner process so its table answers gets in-process.
+        # The in-process driver's table gets a direct call (set by init());
+        # remote owners resolve holder id -> connection here.
+        self.inproc_meta_sink: Optional[Callable[[ObjectMeta], None]] = None
+        self._holder_to_driver: Dict[str, DriverHandle] = {}
+        # Holder ids (drivers + workers) that died: lineage reconstruction of
+        # their objects refuses to re-execute (owner-survives-only rule), and
+        # their non-terminal tasks were sealed with OwnerDiedError.
+        self._dead_holders: set = set()
         # Object-pull plumbing (relay FALLBACK; the peer-direct data plane in
         # object_transfer.py carries most bytes): node_id bytes -> connection
         # that can read that node's segments; outstanding reads keyed by
@@ -735,6 +845,7 @@ class Scheduler:
             self._on_worker_death(wh)
             return False
         self._conn_to_worker[conn] = wh
+        self._watch_conn(conn)
         return True
 
     def _cmd_attach_daemon(self, payload):
@@ -756,6 +867,7 @@ class Scheduler:
         self.nodes[node_id] = node
         self.node_order.append(node_id)
         self._conn_to_daemon[conn] = daemon
+        self._watch_conn(conn)
         self._pull_sources[node_id.binary()] = daemon
         daemon.send(
             (
@@ -777,6 +889,8 @@ class Scheduler:
         pull_hex = info.get("pull_node_id")
         dh = DriverHandle(conn, bytes.fromhex(pull_hex) if pull_hex else None)
         self._conn_to_driver[conn] = dh
+        self._watch_conn(conn)
+        self._holder_to_driver[dh.holder_id] = dh
         if dh.pull_node_id:
             self._pull_sources[dh.pull_node_id] = dh
         head = self.nodes.get(self.node_order[0]) if self.node_order else None
@@ -797,6 +911,7 @@ class Scheduler:
     def _on_daemon_death(self, daemon: DaemonHandle):
         self._drop_outbound(daemon)
         self._conn_to_daemon.pop(daemon.conn, None)
+        self._unwatch_conn(daemon.conn)
         self._pull_sources.pop(daemon.node_id.binary(), None)
         self._fail_pulls_from(daemon.node_id.binary())
         try:
@@ -818,11 +933,15 @@ class Scheduler:
     def _on_driver_death(self, dh: DriverHandle):
         self._drop_outbound(dh)
         self._conn_to_driver.pop(dh.conn, None)
+        self._unwatch_conn(dh.conn)
+        self._holder_to_driver.pop(dh.holder_id, None)
+        self._dead_holders.add(dh.holder_id)
         self._on_driver_death_cleanup_subs(dh)
         if dh.pull_node_id:
             self._pull_sources.pop(dh.pull_node_id, None)
             self._fail_pulls_from(dh.pull_node_id)
         self._drop_holder_everywhere(dh.holder_id)
+        self._fail_tasks_of_dead_owner(dh.holder_id)
         # Owned actors die with their creator; detached actors survive.
         self._kill_actors_owned_by(dh.holder_id)
         try:
@@ -854,8 +973,22 @@ class Scheduler:
             except OSError:
                 pass
         self._wake()
+        self._wake_urgent()
         if self._thread:
             self._thread.join(timeout=5)
+        # Close the loop's private fds (epoll + wake/urgent socketpairs):
+        # test suites cycle hundreds of init/shutdown pairs in one process,
+        # and leaked fds eventually push every new fd past select()'s
+        # FD_SETSIZE for unrelated code.
+        try:
+            self._selector.close()
+        except OSError:
+            pass
+        for sock in (self._wake_r, self._wake_w, self._urgent_r, self._urgent_w):
+            try:
+                sock.close()
+            except OSError:
+                pass
         # Spilled payloads live outside the session dir (possibly a
         # user-configured path): remove them with the session.
         import shutil
@@ -872,8 +1005,11 @@ class Scheduler:
         if self._stopped.is_set():
             fut.set_exception(RuntimeError("scheduler is stopped"))
             return fut
+        with self._wake_lock:
+            self._blocking_pending += 1
         self._commands.put((method, payload, fut))
         self._wake()
+        self._wake_urgent()
         # Re-check AFTER the put: if stop raced in between, the loop's final
         # drain may already have run and this command would sit unprocessed
         # forever. The drain and this check both guard with fut.done(), so at
@@ -895,6 +1031,7 @@ class Scheduler:
         command itself only registers the record)."""
         if self._stopped.is_set():
             raise RuntimeError("scheduler is stopped")
+        self._last_cmd_enqueue = time.monotonic()
         self._commands.put((method, payload, None))
         self._wake()
         # Post-put stop-race check (mirrors call()): if the loop's final
@@ -915,14 +1052,38 @@ class Scheduler:
             except OSError:
                 pass
 
+    @any_thread
+    def note_owner_wait(self, delta: int) -> None:
+        """A driver thread is about to park on (or just left) its ownership
+        table: burst coalescing must yield — the parked thread's results
+        only arrive through this loop's dispatch/done processing."""
+        with self._wake_lock:
+            self._owner_waiters += delta
+        if delta > 0:
+            self._wake_urgent()
+
+    @any_thread
+    def _wake_urgent(self):
+        if self._urgent_pending:
+            return
+        with self._wake_lock:
+            if self._urgent_pending:
+                return
+            self._urgent_pending = True
+            try:
+                self._urgent_w.send(b"x")
+            except OSError:
+                pass
+
     # -------------------------------------------------- outbound micro-batching
     @any_thread
-    def _send_to(self, handle, msg) -> None:
+    def _send_to(self, handle, msg, nbytes: Optional[int] = None) -> None:
         """Send a control message to a worker/driver/daemon handle, coalescing
         per connection while the scheduler thread is inside a loop iteration
         (flushed on threshold and before the loop sleeps). Off-thread callers
         (e.g. pull-read responders) and disabled batching send directly. Send
-        failures route to the handle's death path."""
+        failures route to the handle's death path. `nbytes` lets hot callers
+        pass a size they already know instead of the estimator walk."""
         buf = self._out_buffer
         if buf is None or threading.get_ident() != self._loop_tid:
             if not handle.send(msg):
@@ -942,7 +1103,7 @@ class Scheduler:
         if ent is None:
             ent = buf[id(handle)] = [handle, [], 0]
         ent[1].append(msg)
-        ent[2] += _approx_msg_nbytes(msg)
+        ent[2] += _approx_msg_nbytes(msg) if nbytes is None else nbytes
         self.telemetry.out_msgs += 1
         if len(ent[1]) >= self._batch_max_msgs or ent[2] >= self._batch_max_bytes:
             del buf[id(handle)]
@@ -996,23 +1157,62 @@ class Scheduler:
             if handle.conn in self._conn_to_daemon:
                 self._on_daemon_death(handle)
 
+    # ------------------------------------------------------- readiness watch
+    @loop_thread_only
+    def _watch_conn(self, conn) -> None:
+        try:
+            self._selector.register(conn, self._selectors_mod.EVENT_READ)
+        except (KeyError, ValueError, OSError):
+            pass  # already registered / fd already dead (EOF path handles it)
+
+    @loop_thread_only
+    def _unwatch_conn(self, conn) -> None:
+        try:
+            self._selector.unregister(conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    @loop_thread_only
+    def _rebuild_selector(self) -> None:
+        """Recover from a stale fd (a connection closed without unwatch —
+        e.g. a peer process died mid-iteration): re-register every live
+        connection the maps still know about."""
+        try:
+            self._selector.close()
+        except OSError:
+            pass
+        self._selector = self._selectors_mod.DefaultSelector()
+        self._watch_conn(self._wake_r)
+        self._watch_conn(self._urgent_r)
+        for conn in list(self._conn_to_worker):
+            self._watch_conn(conn)
+        for conn in list(self._conn_to_daemon):
+            self._watch_conn(conn)
+        for conn in list(self._conn_to_driver):
+            self._watch_conn(conn)
+
     # ------------------------------------------------------------------ main loop
     @loop_thread_only
     def _loop(self):
-        import multiprocessing.connection as mpc
-
         self._loop_tid = threading.get_ident()
+        self._watch_conn(self._wake_r)
+        self._watch_conn(self._urgent_r)
         last_health_check = time.time()
+        # Burst coalescing state: while deferring, the normal wake fd is
+        # unwatched (submit wakes accumulate silently) and the select
+        # timeout is the remaining budget; the urgent fd stays watched.
+        deferring = False
+        defer_deadline = 0.0
         while not self._stopped.is_set():
-            waitables = (
-                [self._wake_r]
-                + [w.conn for n in self.nodes.values() for w in n.workers.values() if w.conn is not None]
-                + list(self._conn_to_daemon)
-                + list(self._conn_to_driver)
-            )
+            timeout = 0.25
+            if deferring:
+                timeout = max(0.0005, defer_deadline - time.monotonic())
             try:
-                ready = mpc.wait(waitables, timeout=0.25)
+                ready = [key.fileobj for key, _ in self._selector.select(timeout=timeout)]
             except OSError:
+                # A watched fd went stale (peer died without the EOF being
+                # drained yet): rebuild from the live connection maps.
+                self._rebuild_selector()
                 ready = []
             # Reap workers that died before (or without) connecting back.
             now = time.time()
@@ -1050,6 +1250,15 @@ class Scheduler:
                             pass
                         self._wake_pending = False
                     continue
+                if obj is self._urgent_r:
+                    with self._wake_lock:
+                        try:
+                            while self._urgent_r.recv(4096):
+                                pass
+                        except BlockingIOError:
+                            pass
+                        self._urgent_pending = False
+                    continue
                 wh = self._conn_to_worker.get(obj)
                 if wh is not None:
                     self._drain_worker(wh)
@@ -1070,6 +1279,35 @@ class Scheduler:
             # (an empty list — the steady state — costs one attribute check).
             if self._introspections:
                 self._tick_introspection(time.time())
+            # Burst coalescing: a HOT fire-and-forget command stream (the
+            # newest enqueue within _burst_hot_s) with no blocking caller
+            # waiting defers the drain up to the coalesce budget. On a
+            # single core the alternative is the loop timeslicing against
+            # the submitting thread mid-burst — both run slower than
+            # letting the burst land first and draining it in one pass.
+            if (
+                self._burst_coalesce_s > 0.0
+                and self._blocking_pending == 0
+                and self._owner_waiters == 0
+                and time.monotonic() - self._last_cmd_enqueue < self._burst_hot_s
+                and not self._commands.empty()
+            ):
+                if not deferring:
+                    deferring = True
+                    defer_deadline = time.monotonic() + self._burst_coalesce_s
+                    self._unwatch_conn(self._wake_r)
+                if time.monotonic() < defer_deadline:
+                    # Deliver anything the drains above coalesced, then park.
+                    try:
+                        self._flush_outbound()
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+                    continue
+            if deferring:
+                deferring = False
+                self._watch_conn(self._wake_r)
             # Drain commands (a fire-and-forget submit has fut=None: the whole
             # burst is processed in ONE wakeup instead of one ack round trip
             # per submission — the pipelined-submission fast path).
@@ -1078,6 +1316,9 @@ class Scheduler:
                     method, payload, fut = self._commands.get_nowait()
                 except queue.Empty:
                     break
+                if fut is not None:
+                    with self._wake_lock:
+                        self._blocking_pending -= 1
                 if method == "_stop":
                     self._shutdown_workers()
                     fut.set_result(None)
@@ -1267,6 +1508,7 @@ class Scheduler:
         if node.daemon is not None:
             node.daemon.send(("shutdown",))
             self._conn_to_daemon.pop(node.daemon.conn, None)
+            self._unwatch_conn(node.daemon.conn)
             self._pull_sources.pop(node_id.binary(), None)
             try:
                 node.daemon.conn.close()
@@ -1454,11 +1696,14 @@ class Scheduler:
         self._workers_by_id.pop(wh.worker_id.hex(), None)
         if wh.conn is not None:
             self._conn_to_worker.pop(wh.conn, None)
+            self._unwatch_conn(wh.conn)
             try:
                 wh.conn.close()
             except OSError:
                 pass
         self._drop_holder_everywhere(wh.worker_id.hex())
+        self._dead_holders.add(wh.worker_id.hex())
+        self._fail_tasks_of_dead_owner(wh.worker_id.hex())
         self._kill_actors_owned_by(wh.worker_id.hex())
         if wh.actor_id is not None:
             self._handle_actor_worker_death(wh)
@@ -1879,6 +2124,16 @@ class Scheduler:
         rec = None
         if isinstance(payload, TaskRecord):
             rec = payload
+        elif (
+            isinstance(payload, tuple)
+            and len(payload) == 4
+            and isinstance(payload[0], TaskSpec)
+        ):
+            # submit_fast payload: (spec, return_ids, func_blob, dispatch_key).
+            spec, return_ids, func_blob, dispatch_key = payload
+            rec = self.tasks.get(spec.task_id) or fast_task_record(
+                spec, (), {}, return_ids, func_blob, spec.max_retries, dispatch_key
+            )
         elif isinstance(payload, ExecRequest):
             rec = self.tasks.get(payload.spec.task_id) or TaskRecord(
                 spec=payload.spec,
@@ -1889,6 +2144,11 @@ class Scheduler:
             )
         if rec is not None and rec.return_ids:
             try:
+                # Owner must be set BEFORE sealing: the error seal forwards
+                # to the owner's table, else its in-process get would hang.
+                if not rec.owner:
+                    rec.owner = holder or self._INPROC_DRIVER
+                self.tasks.setdefault(rec.spec.task_id, rec)
                 self._register_return_holders(
                     rec.return_ids, holder or self._INPROC_DRIVER
                 )
@@ -2253,12 +2513,44 @@ class Scheduler:
             for child in meta.contained_ids:
                 self._pin(child)
             self.contained_pins[key] = list(meta.contained_ids)
-        for cb in self.object_waiters.pop(key, []):
-            cb(meta)
-        for respond in self._reconstructing.pop(key, []):
-            respond(True, meta)
+        waiters = self.object_waiters.pop(key, None)
+        if waiters:
+            for cb in waiters:
+                cb(meta)
+        reconstructing = self._reconstructing.pop(key, None)
+        if reconstructing:
+            for respond in reconstructing:
+                respond(True, meta)
+        # Ownership forward: the submitting process keeps the record of truth
+        # for its objects — hand it the sealed meta so its gets resolve
+        # in-process. Put objects skip this (the putter delivered locally):
+        # a worker-side put shares its creating TASK's id prefix, so the
+        # rec lookup would hit that task's record and forward a frame its
+        # owner never expected. The put bit is the u32 index's high bit
+        # (little-endian -> top bit of the key's last byte).
+        if meta.object_id._binary[-1] < 0x80:
+            rec = self.tasks.get(meta.object_id.task_id)
+            if rec is not None and rec.owner:
+                self._forward_to_owner(rec.owner, meta)
         # The seal itself may be the last event keeping a dropped object alive.
         self._maybe_free(key)
+
+    def _forward_to_owner(self, owner: str, meta: ObjectMeta) -> None:
+        """Route a sealed meta to its owner's OwnershipTable: the in-process
+        driver by direct (thread-safe) call, remote owners as coalesced
+        ("own_meta", meta) frames on their existing control connections."""
+        if owner == self._INPROC_DRIVER:
+            sink = self.inproc_meta_sink
+            if sink is not None:
+                sink(meta)
+            return
+        wh = self._workers_by_id.get(owner)
+        if wh is not None:
+            self._send_to(wh, ("own_meta", meta))
+            return
+        dh = self._holder_to_driver.get(owner)
+        if dh is not None:
+            self._send_to(dh, ("own_meta", meta))
 
     # --- refcounting core ---
     def _add_holder(self, key: bytes, holder: str):
@@ -2598,6 +2890,22 @@ class Scheduler:
         self._register_task(rec)
         return [oid for oid in rec.return_ids]
 
+    def _cmd_submit_fast(self, payload):
+        """In-process submit carrying (spec, return_ids, func_blob,
+        dispatch_key) instead of a built TaskRecord: record construction
+        happens HERE on the loop thread — which burst coalescing keeps out
+        of the submitting thread's timing window — instead of inside
+        `.remote()`."""
+        spec, return_ids, func_blob, dispatch_key = payload
+        rec = fast_task_record(
+            spec, (), {}, return_ids, func_blob, spec.max_retries, dispatch_key
+        )
+        if failpoints.ENABLED and failpoints.fire("sched.cmd.submit"):
+            # The fast path is still a submit: a schedule armed on the
+            # canonical name must hit both entry points.
+            raise failpoints.FailpointInjected("sched.cmd.submit")
+        return self._cmd_submit(rec)
+
     def _cmd_put_meta(self, meta: ObjectMeta):
         err = self._check_capacity(meta)
         if err is not None and not self._try_spill_new(meta):
@@ -2745,6 +3053,41 @@ class Scheduler:
         self.gcs.detached_actors[actor_id.binary()] = blob
         self._try_start_actor(ar)
         return True
+
+    def _fail_tasks_of_dead_owner(self, holder: str) -> None:
+        """Owner process died: its unresolved task results can never be
+        accounted (the record of truth lived with the owner), so dependent
+        gets must raise typed OwnerDiedError instead of hanging. PENDING
+        tasks are dropped and sealed with the error; lease-queued (pipelined,
+        not yet executing) tasks are cancelled on their workers; a task
+        already executing runs to completion — its seal is still valid, and
+        the dropped holder frees the result if nobody else borrows it."""
+        from ray_tpu.exceptions import OwnerDiedError
+
+        for rec in list(self.tasks.values()):
+            if rec.owner != holder or rec.state not in ("PENDING", "RUNNING"):
+                continue
+            name = rec.spec.name or rec.spec.func.name
+            err = OwnerDiedError(
+                f"Owner of task {name} ({holder[:12]}) died before its "
+                "result resolved."
+            )
+            if rec.state == "PENDING":
+                self.pending.remove(rec)
+                self._store_error_results(rec, err)
+                rec.state = "CANCELLED"
+                continue
+            node = self.nodes.get(rec.node)
+            wh = node.workers.get(rec.worker) if node else None
+            if (
+                wh is not None
+                and wh.current_task != rec.spec.task_id
+                and rec.spec.task_id in wh.inflight_tasks
+            ):
+                wh.inflight_tasks.remove(rec.spec.task_id)
+                self._send_to(wh, ("cancel_queued", rec.spec.task_id.binary()))
+                self._store_error_results(rec, err)
+                rec.state = "CANCELLED"
 
     def _kill_actors_owned_by(self, holder: str) -> None:
         """An owner (driver/worker) died: its owned actors die with it;
@@ -3176,7 +3519,9 @@ class Scheduler:
             return
         self._add_holder(meta.object_id.binary(), self._holder_of(wh))
         self._seal_object(meta)
-        self._respond(wh, req_id, True, True)
+        # A spilled meta was relocated: hand the owner its current location
+        # (the owner-side table would otherwise point at an unlinked file).
+        self._respond(wh, req_id, True, meta if meta.spilled else True)
 
     def _req_get_metas(self, wh: WorkerHandle, req_id: int, ids: List[bytes]):
         self._mark_blocked(wh)
@@ -3777,6 +4122,19 @@ class Scheduler:
         if rec is None:
             respond(False, ObjectLostError(f"No lineage retained for object {oid.hex()}."))
             return
+        if rec.owner and rec.owner in self._dead_holders:
+            from ray_tpu.exceptions import OwnerDiedError
+
+            # Owner-survives-only rule: re-executing a dead owner's task
+            # would produce results whose record of truth is gone.
+            respond(
+                False,
+                OwnerDiedError(
+                    f"Object {oid.hex()} cannot be reconstructed: its owner "
+                    "process died (lineage re-execution requires a live owner)."
+                ),
+            )
+            return
         if rec.spec.actor_id is not None:
             respond(
                 False,
@@ -3952,7 +4310,37 @@ class Scheduler:
             # AFTER all dep additions, so GC's per-dep decrement is symmetric.
             for d in rec.dep_ids:
                 self.lineage_consumers[d] = self.lineage_consumers.get(d, 0) + 1
-        self.pending.push(rec)
+        # Lease fast path: a no-arg task whose dispatch class already holds a
+        # pipelined lease goes straight onto that worker — the steady-state
+        # submit skips the pending queue and the whole scheduling pass
+        # (classes walk, dep scan, node pick). Misses take the normal path.
+        if not self._fast_pipeline_dispatch(rec):
+            self.pending.push(rec)
+
+    def _fast_pipeline_dispatch(self, rec: TaskRecord) -> bool:
+        spec = rec.spec
+        if (
+            rec.arg_entries
+            or rec.kwarg_entries
+            or spec.is_actor_creation
+            or spec.scheduling_strategy == "SPREAD"
+        ):
+            return False
+        depth = self.config.worker_pipeline_depth
+        if depth <= 1 or not self._leases:
+            return False
+        # Idle workers keep dispatch priority: piling onto a busy lease while
+        # an idle worker could run the task NOW would serialize it behind the
+        # lease head's (possibly long) current task. The full path's
+        # env-hash/eviction logic decides whether an idle worker actually
+        # fits; this guard only preserves the idle-first ordering.
+        for node in self.nodes.values():
+            if node.alive and node.idle:
+                return False
+        # The dispatch itself is exactly the pipelined push (ONE copy of the
+        # lease-accounting contract); this wrapper only adds the no-arg and
+        # idle-first guards that make it safe to run at submit time.
+        return self._try_pipeline(rec, [], {})
 
     def _submit_actor_task(self, req: ExecRequest, owner: Optional[str] = None):
         from ray_tpu.exceptions import RayActorError
@@ -3965,6 +4353,7 @@ class Scheduler:
             return_ids=list(req.return_ids),
             func_blob=None,
         )
+        rec.owner = owner or self._INPROC_DRIVER
         if spec.returns_mode is not None:
             rec.stream_owner = owner or self._INPROC_DRIVER
         # Pin dependencies (and refs nested in by-value args) until terminal.
@@ -4406,7 +4795,12 @@ class Scheduler:
         wh = None
         for wid in list(node.idle):
             cand = node.workers.get(wid)
-            if cand is None or not cand.process.is_alive():
+            # Liveness probing per dispatch costs a subprocess-poll syscall
+            # (~13% of loop samples under task load): probe only workers
+            # still in their connect-back window — a connected worker's
+            # death surfaces through conn EOF / the send-failure path, which
+            # requeues the task.
+            if cand is None or (cand.conn is None and not cand.process.is_alive()):
                 node.idle.remove(wid)
                 continue
             if cand.env_hash == want_hash:
@@ -4497,20 +4891,24 @@ class Scheduler:
                 tel.dispatch_waits.append(now - queued)
 
     def _send_exec(self, wh: WorkerHandle, rec: TaskRecord, metas, kw) -> None:
-        req = ExecRequest(
-            spec=rec.spec,
-            arg_metas=metas,
-            kwarg_metas=kw,
-            func_blob=None,
-            return_ids=rec.return_ids,
-        )
+        req = ExecRequest.__new__(ExecRequest)
+        req.spec = rec.spec
+        req.arg_metas = metas
+        req.kwarg_metas = kw
+        req.func_blob = None
+        req.return_ids = rec.return_ids
+        nbytes = 320
         if rec.spec.func.function_id not in wh.known_functions:
             req.func_blob = self.gcs.function_table.get(rec.spec.func.function_id, rec.func_blob)
             wh.known_functions.add(rec.spec.func.function_id)
+            if req.func_blob is not None:
+                nbytes += len(req.func_blob)
+        if metas or kw:
+            nbytes = None  # inline arg bytes: let the estimator walk them
         # Coalesced per worker in the loop-wide outbound buffer; a send
         # failure at flush runs worker-death handling, which retries or seals
         # an error for every in-flight record itself.
-        self._send_to(wh, ("exec", req))
+        self._send_to(wh, ("exec", req), nbytes=nbytes)
 
     def _remove_from_lease_index(self, wh: WorkerHandle) -> None:
         if wh.lease_key is not None:

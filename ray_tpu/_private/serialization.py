@@ -19,6 +19,8 @@ from typing import Any, Callable, List
 
 import cloudpickle
 
+from ray_tpu._private import wire
+
 
 @dataclass
 class SerializedValue:
@@ -116,14 +118,22 @@ def deserialize(inband: bytes, buffers: List[memoryview]) -> Any:
 def dumps(obj: Any) -> bytes:
     """Single-blob serialization for control-plane messages (no out-of-band).
 
-    Control messages are overwhelmingly plain data (task specs with already-
-    serialized arg bytes, status tuples): the C pickler is ~5-10x faster than
-    cloudpickle's Python-driven dump, so try it first. Two cases must still
-    take the cloudpickle path: objects it cannot pickle at all (lambdas,
-    closures — PicklingError), and objects it pickles BY REFERENCE into
-    `__main__` (a worker's __main__ is not the driver's script, so those
-    would unpickle-fail remotely; the byte-scan is cheap and false positives
+    Control-message tuples (a str tag first — the MESSAGE_GRAMMAR shapes)
+    take the framed wire codec when the native protocol is enabled
+    (_private/wire.py: C extension or its pure-Python twin, knob
+    `use_native_protocol`); receivers dispatch on the frame's magic byte, so
+    both formats always decode. Everything else — and any message the codec
+    declines — pickles: the C pickler is ~5-10x faster than cloudpickle's
+    Python-driven dump, so try it first. Two cases must still take the
+    cloudpickle path: objects it cannot pickle at all (lambdas, closures —
+    PicklingError), and objects it pickles BY REFERENCE into `__main__` (a
+    worker's __main__ is not the driver's script, so those would
+    unpickle-fail remotely; the byte-scan is cheap and false positives
     merely lose the fast path)."""
+    if type(obj) is tuple and obj and type(obj[0]) is str and wire.send_enabled():
+        data = wire.encode(obj)
+        if data is not None:
+            return data
     try:
         data = pickle.dumps(obj, protocol=5)
     except Exception:
@@ -134,4 +144,6 @@ def dumps(obj: Any) -> bytes:
 
 
 def loads(data: bytes) -> Any:
+    if data[:1] == wire.MAGIC:
+        return wire.decode(data)
     return pickle.loads(data)

@@ -28,8 +28,14 @@ from ray_tpu._private.config import Config, get_config, set_config
 from ray_tpu._private.gcs import GCS
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu._private.object_store import LocalObjectStore, ObjectMeta
+from ray_tpu._private.ownership import OwnershipTable
 from ray_tpu._private.protocol import ExecRequest, FunctionDescriptor, TaskSpec
-from ray_tpu._private.scheduler import ActorRecord, Scheduler, TaskRecord
+from ray_tpu._private.scheduler import (
+    ActorRecord,
+    Scheduler,
+    TaskRecord,
+    fast_task_record,
+)
 
 DRIVER_MODE = "driver"
 WORKER_MODE = "worker"
@@ -106,7 +112,14 @@ class _RefTracker:
                 except IndexError:
                     break
             ops, self._ops = self._ops, []
-            return ops
+        # Zero-transition releases also retire the owner-side table entry
+        # (outside self._lock: the table has its own lock).
+        if ops:
+            table = global_worker.ownership
+            for op, key in ops:
+                if op == "rel":
+                    table.forget(key)
+        return ops
 
     def reset(self) -> None:
         with self._lock:
@@ -173,7 +186,7 @@ class ObjectRef:
 
     def __init__(self, object_id: ObjectID):
         self._id = object_id
-        _ref_tracker.incref(object_id.binary())
+        _ref_tracker.incref(object_id._binary)
 
     def __del__(self):
         try:
@@ -341,6 +354,9 @@ class _WorkerState:
         self.mode: Optional[str] = None
         self.job_id: Optional[JobID] = None
         self.store: Optional[LocalObjectStore] = None
+        # Owner-side record of truth for objects this process created
+        # (_private/ownership.py): metas resolve here without a head trip.
+        self.ownership = OwnershipTable()
         # Peer-to-peer data-plane manager for this process's pulls
         # (object_transfer.ObjectTransferManager); None until init/connect.
         self.transfer = None
@@ -353,6 +369,9 @@ class _WorkerState:
         self.node = None  # driver only: the Node object
         self._put_counter = 0
         self._task_counter = 0
+        # Cached id-minting bases (next_task_id/next_put_id are hot-path).
+        self._pseudo_actor: Optional[ActorID] = None
+        self._driver_task_id: Optional[TaskID] = None
         self._lock = threading.Lock()
         self.namespace: str = "default"
         self._client_tmp_dir: Optional[str] = None
@@ -368,18 +387,33 @@ class _WorkerState:
     def current_task_id(self, value: Optional[TaskID]) -> None:
         self._task_tls.task_id = value
 
+    def _driver_pseudo_actor(self) -> ActorID:
+        # Cached per job: minting ids is on the `.remote()`/put() hot path.
+        actor = self._pseudo_actor
+        if actor is None or actor.job_id != (self.job_id or JobID.from_int(0)):
+            actor = ActorID(
+                b"\x00" * 12 + (self.job_id or JobID.from_int(0)).binary()
+            )
+            self._pseudo_actor = actor
+        return actor
+
     def next_put_id(self) -> ObjectID:
         with self._lock:
             self._put_counter += 1
             idx = self._put_counter
-        base = self.current_task_id or TaskID.for_driver(self.job_id or JobID.from_int(0))
+        base = self.current_task_id
+        if base is None:
+            base = self._driver_task_id
+            if base is None:
+                base = self._driver_task_id = TaskID.for_driver(
+                    self.job_id or JobID.from_int(0)
+                )
         return ObjectID.for_put(base, idx)
 
     def next_task_id(self) -> TaskID:
-        actor = self.current_actor_id or ActorID(
-            b"\x00" * 12 + (self.job_id or JobID.from_int(0)).binary()
+        return TaskID.for_task(
+            self.current_actor_id or self._driver_pseudo_actor()
         )
-        return TaskID.for_task(actor)
 
 
 global_worker = _WorkerState()
@@ -394,10 +428,20 @@ class DriverContext:
     def __init__(self, scheduler: Scheduler):
         self.scheduler = scheduler
 
+    def note_owner_wait(self, delta: int) -> None:
+        self.scheduler.note_owner_wait(delta)
+
     def submit(self, rec: TaskRecord):
         # Fire-and-forget: pipelined `.remote()` bursts drain in one scheduler
         # wakeup. Errors surface through the return refs, never the submit.
         self.scheduler.call_nowait("submit", rec)
+
+    def submit_fast(self, spec, return_ids, func_blob, dispatch_key):
+        # No-arg fast-path submit: the loop builds the TaskRecord itself
+        # (burst coalescing keeps that off the submitting thread's clock).
+        self.scheduler.call_nowait(
+            "submit_fast", (spec, return_ids, func_blob, dispatch_key)
+        )
 
     def submit_actor_task(self, req: ExecRequest):
         self.scheduler.call_nowait("submit_actor_task", req)
@@ -431,8 +475,11 @@ class DriverContext:
             # command queue keeps every later get/wait/submit ordered after
             # it — identical observable semantics, no round trip.
             self.scheduler.call_nowait("put_meta", meta)
-            return
+            return None
+        # In-process: the scheduler mutates THIS meta object on spill, so the
+        # caller's copy is always current.
         self.scheduler.call("put_meta", meta).result()
+        return None
 
     def kv(self, op: str, *args):
         return self.scheduler.call("kv", (op, args)).result()
@@ -584,6 +631,8 @@ class RemoteDriverContext:
                 _print_worker_log(payload)
             elif channel == "errors":
                 _print_worker_error(payload)
+        elif msg[0] == "own_meta":
+            global_worker.ownership.deliver_owned(msg[1])
         elif msg[0] == "object_locations":
             from ray_tpu._private import object_transfer
 
@@ -638,6 +687,15 @@ class RemoteDriverContext:
         # frame; any blocking request flushes first (FIFO preserved).
         self.wc.send_async(("cmd", "submit", rec))
 
+    def submit_fast(self, spec, return_ids, func_blob, dispatch_key):
+        # Connection-backed contexts build the record here (the head's
+        # _req_submit path takes TaskRecords); dispatch_key stays local —
+        # the head recomputes it from the spec.
+        rec = fast_task_record(
+            spec, (), {}, return_ids, func_blob, spec.max_retries, None
+        )
+        self.wc.send_async(("cmd", "submit", rec))
+
     def submit_actor_task(self, req: ExecRequest):
         self.wc.send_async(("cmd", "submit_actor_task", req))
 
@@ -662,8 +720,11 @@ class RemoteDriverContext:
             # Inline puts cannot fail the capacity check: register without
             # an ack; connection FIFO orders any later get/submit after it.
             self.wc.send_async(("cmd", "put_meta", meta))
-            return
-        self.wc.request("put_meta", meta)
+            return None
+        # The head responds the relocated meta when it spilled the object
+        # (our local copy would point at an unlinked segment otherwise).
+        resp = self.wc.request("put_meta", meta)
+        return resp if resp is not True else None
 
     def kv(self, op, *args):
         return self.wc.request("kv", (op, args))
@@ -807,6 +868,12 @@ class WorkerProcContext:
         # without acks and batch into one frame.
         self.rt.wc.send_async(("cmd", "submit", rec))
 
+    def submit_fast(self, spec, return_ids, func_blob, dispatch_key):
+        rec = fast_task_record(
+            spec, (), {}, return_ids, func_blob, spec.max_retries, None
+        )
+        self.rt.wc.send_async(("cmd", "submit", rec))
+
     def submit_actor_task(self, req: ExecRequest):
         self.rt.wc.send_async(("cmd", "submit_actor_task", req))
 
@@ -831,8 +898,9 @@ class WorkerProcContext:
     def put_meta(self, meta):
         if meta.segment is None and get_config().control_plane_batching:
             self.rt.wc.send_async(("cmd", "put_meta", meta))
-            return
-        self.rt.wc.request("put_meta", meta)
+            return None
+        resp = self.rt.wc.request("put_meta", meta)
+        return resp if resp is not True else None
 
     def kv(self, op, *args):
         return self.rt.wc.request("kv", (op, args))
@@ -1017,6 +1085,9 @@ def init(
             log_to_driver=True if log_to_driver is None else log_to_driver,
         )
 
+    from ray_tpu.util import tracing
+
+    tracing.refresh_env()  # honor RAY_TPU_TRACING set before init
     cfg = Config().apply_overrides(_system_config)
     if log_to_driver is not None:
         # Explicit kwarg wins; otherwise RAY_TPU_log_to_driver /
@@ -1060,10 +1131,14 @@ def init(
         global_worker.store.shm_dir, cfg=cfg, authkey=scheduler.authkey
     )
     global_worker.context = DriverContext(scheduler)
+    # Ownership decentralization: the scheduler loop delivers sealed metas of
+    # driver-owned objects straight into this process's table (thread-safe).
+    scheduler.inproc_meta_sink = global_worker.ownership.deliver_owned
     global_worker.namespace = namespace or "default"
     global_worker.node = scheduler
     global_worker._session_gen += 1
     _ref_tracker.reset()
+    global_worker.ownership.reset()
     _start_ref_flusher()
 
     if cfg.log_to_driver:
@@ -1126,7 +1201,15 @@ def _init_client_mode(address: str, namespace: Optional[str],
 
     wc = WorkerConnection(conn)
     ctx = RemoteDriverContext(wc, address)
-    reader = threading.Thread(target=wc.reader_loop, daemon=True, name="driver-reader")
+
+    def _reader():
+        wc.reader_loop()
+        # Head connection gone: wake any getter parked on the ownership
+        # table (its own_meta can never arrive) so it falls through to the
+        # context and surfaces a connection error instead of hanging.
+        global_worker.ownership.reset()
+
+    reader = threading.Thread(target=_reader, daemon=True, name="driver-reader")
     reader.start()
 
     head_shm = info["shm_dir"]
@@ -1153,6 +1236,7 @@ def _init_client_mode(address: str, namespace: Optional[str],
     global_worker._client_tmp_dir = own_dir
     global_worker._session_gen += 1
     _ref_tracker.reset()
+    global_worker.ownership.reset()
     _start_ref_flusher()
 
     if log_to_driver:
@@ -1209,8 +1293,10 @@ def shutdown():
     global_worker.node = None
     global_worker.session_dir = None
     global_worker._put_counter = 0
+    global_worker._driver_task_id = None
     global_worker._session_gen += 1  # stop this session's ref flusher
     _ref_tracker.reset()
+    global_worker.ownership.reset()
     # Function-registration cache is per-session: a new init() must re-ship blobs.
     from ray_tpu import remote_function
 
@@ -1232,10 +1318,13 @@ def put(value: Any) -> ObjectRef:
     oid = global_worker.next_put_id()
     meta = global_worker.store.put(oid, value, cfg.max_direct_call_object_size)
     try:
-        global_worker.context.put_meta(meta)
+        meta = global_worker.context.put_meta(meta) or meta
     except exceptions.ObjectStoreFullError:
         global_worker.store.free(meta)
         raise
+    # This process owns the object: record the meta so a local get() resolves
+    # in-process (put_meta may have returned a relocated/spilled meta).
+    global_worker.ownership.deliver(meta)
     return ObjectRef(oid)
 
 
@@ -1254,6 +1343,44 @@ def _recover_lost_object(ctx, meta: ObjectMeta, first_err: BaseException):
     )
 
 
+def _resolve_metas(ids: List[bytes], timeout: Optional[float]) -> List[ObjectMeta]:
+    """Owner-first meta resolution: objects this process owns answer from the
+    in-process OwnershipTable (resolved now, or parked on its condition until
+    the seal forward arrives) — zero head round trips, zero scheduler-thread
+    hops. Any id the table doesn't cover (borrowed refs, pre-decentralization
+    paths) falls back to the head's object directory."""
+    table = global_worker.ownership
+    metas = table.try_get_all(ids)
+    if metas is not None:
+        return metas
+    # BLOCKING waits park on the local table only in driver processes. A
+    # WORKER blocked in get() must go through the head so its CPU lease is
+    # released while it waits (recursive task graphs deadlock otherwise —
+    # the nested task needs this worker's slot to run).
+    if global_worker.mode == DRIVER_MODE and table.covers(ids):
+        # Tell the in-process scheduler a thread is parked owner-side (burst
+        # coalescing yields; remote contexts have no deferral to yield).
+        hint = getattr(global_worker.context, "note_owner_wait", None)
+        if hint is not None:
+            hint(1)
+        try:
+            metas = table.wait_all(ids, timeout)
+        finally:
+            if hint is not None:
+                hint(-1)
+        if metas is not None:
+            return metas
+        # None means timeout OR the entries left the table under us (session
+        # reset / client reader death): only a still-covered wait is a real
+        # timeout — otherwise fall through so the context surfaces its own
+        # error (e.g. a closed head connection), not a bogus timeout.
+        if timeout is not None and table.covers(ids):
+            raise exceptions.GetTimeoutError(
+                f"get() timed out after {timeout}s waiting for {len(ids)} object(s)"
+            )
+    return global_worker.context.get_metas(ids, timeout)
+
+
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
     """Fetch object values, raising remote errors (reference: `worker.py:2424`)."""
     _auto_init()
@@ -1263,7 +1390,7 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
         if not isinstance(r, ObjectRef):
             raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
     ids = [r.binary() for r in ref_list]
-    metas = global_worker.context.get_metas(ids, timeout)
+    metas = _resolve_metas(ids, timeout)
     values = []
     ctx = global_worker.context
     for meta in metas:
@@ -1298,7 +1425,14 @@ def wait(
     if num_returns > len(refs):
         raise ValueError("num_returns cannot exceed the number of refs.")
     ids = [r.binary() for r in refs]
-    ready_ids = set(global_worker.context.wait(ids, num_returns, timeout))
+    # Owner-side fast path: enough locally-resolved objects answer without a
+    # head round trip (the table resolves as seal forwards arrive).
+    table = global_worker.ownership
+    local_ready = [i for i in ids if table.get_local(i) is not None]
+    if len(local_ready) >= num_returns:
+        ready_ids = set(local_ready)
+    else:
+        ready_ids = set(global_worker.context.wait(ids, num_returns, timeout))
     # At most num_returns refs are reported ready; the remainder (including any
     # extra already-finished ones) go to not_ready, per the reference contract.
     ready = [r for r in refs if r.binary() in ready_ids][:num_returns]

@@ -50,7 +50,28 @@ class WorkerArgs:
 # Hard-close for the failpoint "close" action and send-failure cleanup: the
 # ONE implementation (dup-fd shutdown(SHUT_RDWR) so a blocked reader sees a
 # real EOF) lives with the data plane, which needs the same teardown.
-from ray_tpu._private.object_transfer import _abrupt_close  # noqa: E402
+from ray_tpu._private.object_transfer import (  # noqa: E402
+    PRIORITY_TASK_ARGS,
+    _abrupt_close,
+)
+
+# Lazily-bound runtime modules for the exec hot path: importing them at
+# module top would close an import cycle (scheduler -> worker_main ->
+# worker -> scheduler), and a per-task function-level import pays the
+# sys.modules + fromlist machinery on every execution.
+_worker_mod = None
+_exceptions = None
+
+
+def _runtime_mods():
+    global _worker_mod, _exceptions
+    if _worker_mod is None:
+        from ray_tpu import exceptions as _e
+        from ray_tpu._private import worker as _w
+
+        _worker_mod = _w
+        _exceptions = _e
+    return _worker_mod, _exceptions
 
 
 class WorkerConnection:
@@ -115,15 +136,18 @@ class WorkerConnection:
     def flush_batch(self) -> None:
         self.batch.flush()
 
-    def send_done(self, payload: tuple, batch: bool = False) -> None:
+    def send_done(self, payload: tuple, batch: bool = False,
+                  nbytes: int | None = None) -> None:
         """Send (or buffer) one task-completion payload. Completion order
         must reach the scheduler in execution order (lease accounting
         transfers on each done); the shared batch buffer preserves it, and
         an immediate send flushes first by construction. batch=True defers
         to the dispatch loop's queue-empty flush (pure buffering): a
-        pipelined run of N tasks pays one frame, not N."""
+        pipelined run of N tasks pays one frame, not N. `nbytes` carries the
+        result-payload size the executor already computed, skipping the
+        generic message-size estimator on the completion hot path."""
         if batch:
-            self.batch.buffer(("done",) + payload)
+            self.batch.buffer(("done",) + payload, nbytes=nbytes)
         else:
             self.send(("done",) + payload)
 
@@ -155,6 +179,13 @@ class WorkerConnection:
                     self.prefetch_hook(msg[1])
                 except Exception:  # noqa: BLE001 — prefetch is best-effort
                     pass
+        elif kind == "own_meta":
+            # Seal forward for an object THIS process owns (it submitted the
+            # creating task): resolve it in the local ownership table so
+            # get() answers without a head round trip.
+            from ray_tpu._private import worker as worker_mod
+
+            worker_mod.global_worker.ownership.deliver_owned(msg[1])
         elif kind == "object_locations":
             from ray_tpu._private import object_transfer
 
@@ -593,8 +624,7 @@ def _run_generator(rt: WorkerRuntime, req: ExecRequest, out, progress: Dict[byte
 
 
 def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
-    from ray_tpu import exceptions
-    from ray_tpu._private import worker as worker_mod
+    worker_mod, exceptions = _runtime_mods()
 
     spec = req.spec
     rt.current_task_id = spec.task_id
@@ -607,6 +637,10 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
     if spec.env_vars:
         for k, v in spec.env_vars.items():
             os.environ[k] = v
+        if "RAY_TPU_TRACING" in spec.env_vars:
+            from ray_tpu.util import tracing
+
+            tracing.refresh_env()  # is_enabled() caches the environ flag
     exec_span = None
     if spec.trace_context is not None:
         from ray_tpu.util import tracing
@@ -631,8 +665,6 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
             # Partial-failure injection: die before any argument bytes are
             # touched — the task must retry cleanly with its deps re-pinned.
             failpoints.maybe_crash("worker.crash_before_args_fetched")
-        from ray_tpu._private.object_transfer import PRIORITY_TASK_ARGS
-
         args = [rt.fetch_value(m, priority=PRIORITY_TASK_ARGS)
                 for m in req.arg_metas]
         kwargs = {k: rt.fetch_value(m, priority=PRIORITY_TASK_ARGS)
@@ -705,10 +737,19 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
             # exec_end/result_stored pipeline makes observable.
             failpoints.maybe_crash("worker.crash_after_exec_end")
         metas = []
+        done_nbytes = 96
         for oid, value in zip(req.return_ids, values):
             sv = serialization.serialize(value)
             meta = rt.store.put_serialized(oid, sv, cfg.max_direct_call_object_size)
             metas.append(meta)
+            if meta.segment is None:
+                # Only inline payloads ride IN the done frame; a segment-
+                # backed meta is ~200 wire bytes however big the object —
+                # counting meta.size would trip the batch byte threshold on
+                # every completion and defeat done coalescing.
+                done_nbytes += meta.size
+            else:
+                done_nbytes += 160
         if failpoints.ENABLED:
             # Crash with results IN the store but the done message unsent:
             # the scheduler must treat the task as dead (segments orphaned),
@@ -722,7 +763,7 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
         worker_mod.flush_ref_ops()
         done = (spec.task_id.binary(), True, metas)
         rt.wc.send_done(done if stages is None else done + (stages,),
-                        batch=batch_done)
+                        batch=batch_done, nbytes=done_nbytes)
     except Exception as e:  # noqa: BLE001 — every task error must be captured
         if exec_span is not None:
             from ray_tpu.util import tracing
@@ -779,6 +820,20 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
 
 def worker_loop(conn, args: WorkerArgs):
     """Entry point run in the spawned worker process."""
+    if os.environ.get("RAY_TPU_WORKER_PROFILE"):
+        # Debug: cProfile this worker's dispatch loop, dump stats to the
+        # given directory at exit (perf investigations on the exec path).
+        import atexit
+        import cProfile
+
+        prof = cProfile.Profile()
+        outdir = os.environ["RAY_TPU_WORKER_PROFILE"]
+        atexit.register(
+            lambda: prof.dump_stats(
+                os.path.join(outdir, f"worker_{os.getpid()}.pstats")
+            )
+        )
+        prof.enable()
     set_config(args.config)
     for k, v in args.env_vars.items():
         os.environ.setdefault(k, v)

@@ -154,6 +154,12 @@ class ActorHandle:
         try:
             entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
             return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]
+            # Owner-side record (ownership.py): registered before the submit
+            # so the seal forward resolves this process's gets in-process.
+            if return_ids:
+                global_worker.ownership.expect(
+                    [oid.binary() for oid in return_ids]
+                )
             req = ExecRequest(spec=spec, arg_metas=[], kwarg_metas={}, return_ids=return_ids)
             req._arg_entries = entries
             req._kwarg_entries = kwentries

@@ -1,9 +1,9 @@
-"""rt-lint CLI: run the six invariant passes over the ray_tpu tree.
+"""rt-lint CLI: run the seven invariant passes over the ray_tpu tree.
 
 Usage::
 
     python -m ray_tpu.devtools.lint [package_dir] [--allowlist FILE]
-        [--passes protocol,blocking,affinity,config,metrics,failpoints] [-q]
+        [--passes protocol,blocking,affinity,config,metrics,failpoints,ownership] [-q]
 
 Exit status: 0 = clean (after allowlist), 1 = violations / allowlist format
 errors / unused allowlist entries. Designed for CI (tools/check.sh) and for
@@ -27,7 +27,7 @@ from typing import Callable, Dict, List
 
 from ray_tpu.devtools import (
     pass_affinity, pass_blocking, pass_config, pass_failpoints, pass_metrics,
-    pass_protocol,
+    pass_ownership, pass_protocol,
 )
 from ray_tpu.devtools.astutil import (
     Package, Violation, apply_allowlist, load_allowlist, load_package,
@@ -40,6 +40,7 @@ PASSES: Dict[str, Callable[[Package], List[Violation]]] = {
     "config": pass_config.run,
     "metrics": pass_metrics.run,
     "failpoints": pass_failpoints.run,
+    "ownership": pass_ownership.run,
 }
 
 _HERE = os.path.dirname(os.path.abspath(__file__))

@@ -94,6 +94,15 @@ class ObjectLostError(RayTpuError):
     """An object's segment is gone and it cannot be reconstructed."""
 
 
+class OwnerDiedError(ObjectLostError):
+    """The process that owned an object (submitted the task / called put)
+    died before the result resolved. Ownership semantics (the reference's
+    distributed-futures model): the owner holds the object's record of
+    truth, so its death makes unresolved results permanently unavailable —
+    dependent `get()`s raise this instead of hanging, and lineage
+    reconstruction refuses to re-execute a dead owner's tasks."""
+
+
 class TaskCancelledError(RayTpuError):
     """The task was cancelled before/while running."""
 

@@ -5,13 +5,15 @@ GCS function table, then submit TaskSpecs referencing it by hash)."""
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional
 
-from ray_tpu._private import serialization, worker as worker_mod
+from ray_tpu._private import failpoints, serialization, worker as worker_mod
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.protocol import FunctionDescriptor, TaskSpec
-from ray_tpu._private.scheduler import TaskRecord
+from ray_tpu._private.scheduler import TaskRecord, fast_task_record
 from ray_tpu._private.worker import ObjectRef, global_worker
+from ray_tpu.util import tracing
 
 _VALID_OPTIONS = {
     "num_cpus",
@@ -32,6 +34,14 @@ _VALID_OPTIONS = {
 # Function ids this process has already shipped/registered.
 _sent_functions: set = set()
 _sent_lock = threading.Lock()
+
+# Wire suffix of return-object index 1 (the single-return common case).
+_RETURN_IDX1 = (1).to_bytes(4, "little")
+
+# Hot-path local aliases: module-attribute loads add up at >100k calls/s.
+_time = time.time
+_spec_new = TaskSpec.__new__
+_oid_trusted = ObjectID._trusted
 
 # Default producer-side window for streaming tasks (reference:
 # `_generator_backpressure_num_objects`): bounds how far a producer runs
@@ -97,6 +107,13 @@ class RemoteFunction:
                 raise ValueError(f"Invalid @remote option: {k}")
         self._blob: Optional[bytes] = None
         self._function_id: Optional[str] = None
+        # Submission template (built on first `.remote()`): every option-
+        # derived TaskSpec field is identical across calls of the same
+        # RemoteFunction, so the hot path copies a prebuilt field dict and
+        # stamps only task_id/submitted_ts instead of re-deriving ~20 fields
+        # per call (`.remote()` is the control-plane hot path).
+        self._spec_proto: Optional[dict] = None
+        self._dispatch_key: Optional[tuple] = None
         self.__name__ = getattr(function, "__name__", "remote_function")
 
     def _ensure_pickled(self):
@@ -128,8 +145,11 @@ class RemoteFunction:
 
         return FunctionNode(self, args, kwargs)
 
-    def _remote(self, args, kwargs, opts):
-        worker_mod._auto_init()
+    def _build_template(self, opts) -> None:
+        """Precompute the option-derived TaskSpec fields + dispatch class.
+        Everything here is invariant across `.remote()` calls of this
+        RemoteFunction (options() returns a NEW RemoteFunction), so the hot
+        path pays one dict copy instead of re-deriving each field."""
         self._ensure_pickled()
         nr = opts.get("num_returns", 1)
         returns_mode = None
@@ -144,10 +164,9 @@ class RemoteFunction:
             num_returns = 1 if nr == "dynamic" else 0
         else:
             num_returns = int(nr)
-        task_id = global_worker.next_task_id()
         renv = dict(opts.get("runtime_env") or {})
         spec = TaskSpec(
-            task_id=task_id,
+            task_id=None,  # stamped per call
             func=FunctionDescriptor(self._function_id, self.__name__),
             num_returns=num_returns,
             returns_mode=returns_mode,
@@ -159,7 +178,64 @@ class RemoteFunction:
             runtime_env={k: v for k, v in renv.items() if k != "env_vars"} or None,
         )
         _apply_strategy(spec, opts.get("scheduling_strategy"))
-        from ray_tpu.util import tracing
+        # The dispatch class is option-derived too: precomputing it here
+        # saves the scheduler a frozenset+env_hash per record (shared tuple).
+        from ray_tpu._private.scheduler import _PendingQueue
+
+        probe = TaskRecord.__new__(TaskRecord)
+        probe.spec = spec
+        probe.dispatch_key = None
+        self._dispatch_key = _PendingQueue.key_of(probe)
+        # NOTE: resources/env_vars/runtime_env dicts are SHARED across the
+        # specs built from this template — the runtime treats spec fields as
+        # immutable after submit (the tracing slow path copies before it
+        # mutates).
+        self._spec_proto = dict(spec.__dict__)
+
+    def _remote(self, args, kwargs, opts):
+        gw = global_worker
+        worker_mod._auto_init()
+        proto = self._spec_proto
+        if proto is None:
+            self._build_template(opts)
+            proto = self._spec_proto
+        task_id = gw.next_task_id()
+        num_returns = proto["num_returns"]
+        returns_mode = proto["returns_mode"]
+
+        spec = _spec_new(TaskSpec)
+        d = dict(proto)
+        d["task_id"] = task_id
+        d["submitted_ts"] = _time()
+        spec.__dict__ = d
+
+        if (
+            not tracing._enabled
+            and not tracing._env_enabled
+            and num_returns == 1
+            and not args
+            and not kwargs
+        ):
+            # Straight-line fast path for the dominant shape (one return, no
+            # args, no tracing): everything below is the general path run in
+            # a specific order — this just skips its branches.
+            rid = _oid_trusted(task_id._binary + _RETURN_IDX1)
+            return_ids = [rid]
+            gw.ownership.expect_one(rid._binary)
+            if failpoints.ENABLED:
+                failpoints.maybe_crash("owner.crash_before_lease_grant")
+            blob = None
+            if self._function_id not in _sent_functions:
+                with _sent_lock:
+                    if self._function_id not in _sent_functions:
+                        blob = self._blob
+                        _sent_functions.add(self._function_id)
+            gw.context.submit_fast(
+                spec, return_ids, blob, self._dispatch_key
+            )
+            # num_returns == 1 here covers plain and "dynamic" tasks; both
+            # hand back the single return ref ("streaming" has 0 returns).
+            return ObjectRef(rid)
 
         submit_span = None
         if tracing.is_enabled():
@@ -171,23 +247,34 @@ class RemoteFunction:
                 "parent_id": submit_span["span_id"],
             }
             # Workers inherit tracing through the task env, so nested
-            # submissions from inside tasks are traced too.
+            # submissions from inside tasks are traced too. The template's
+            # env_vars dict is shared: copy before mutating.
+            spec.env_vars = dict(spec.env_vars)
             spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
         try:
             entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
             return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]
+            # Owner-side record: this process owns the results; the table
+            # entries go in BEFORE the submit so the seal forward can never
+            # race an unregistered object (get() then resolves in-process).
+            if return_ids:
+                global_worker.ownership.expect(
+                    [oid._binary for oid in return_ids]
+                )
+            if failpoints.ENABLED:
+                # Owner dies after recording the submit locally but before
+                # the control plane grants anything: dependents must see
+                # OwnerDiedError, never a hang (tests/test_ownership.py).
+                failpoints.maybe_crash("owner.crash_before_lease_grant")
             blob = None
-            with _sent_lock:
-                if self._function_id not in _sent_functions:
-                    blob = self._blob
-                    _sent_functions.add(self._function_id)
-            rec = TaskRecord(
-                spec=spec,
-                arg_entries=entries,
-                kwarg_entries=kwentries,
-                return_ids=return_ids,
-                func_blob=blob,
-                retries_left=spec.max_retries,
+            if self._function_id not in _sent_functions:
+                with _sent_lock:
+                    if self._function_id not in _sent_functions:
+                        blob = self._blob
+                        _sent_functions.add(self._function_id)
+            rec = fast_task_record(
+                spec, entries, kwentries, return_ids, blob,
+                spec.max_retries, self._dispatch_key,
             )
             global_worker.context.submit(rec)
         finally:

@@ -51,8 +51,20 @@ def enable(exporter: Optional[Callable[[dict], None]] = None) -> None:
     _ensure_flusher()
 
 
+# Cached RAY_TPU_TRACING environ flag: is_enabled() sits on the `.remote()`
+# submission hot path, where a per-call os.environ lookup costs more than the
+# span check itself. The cache refreshes at the points the env can change
+# under us: ray_tpu.init(), and worker-side task env application (_execute).
+_env_enabled = os.environ.get("RAY_TPU_TRACING") == "1"
+
+
+def refresh_env() -> None:
+    global _env_enabled
+    _env_enabled = os.environ.get("RAY_TPU_TRACING") == "1"
+
+
 def is_enabled() -> bool:
-    return _enabled or os.environ.get("RAY_TPU_TRACING") == "1"
+    return _enabled or _env_enabled
 
 
 # ------------------------------------------------------------------ span core

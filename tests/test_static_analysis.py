@@ -490,6 +490,42 @@ def test_failpoints_undocumented_and_bad_names_flagged():
     assert len(violations) == 2
 
 
+# --------------------------------------------------------------- ownership
+def test_ownership_head_table_access_flagged():
+    from ray_tpu.devtools import pass_ownership
+
+    pkg = make_pkg(**{
+        "ray_tpu._private.worker": """
+            def bad(ctx):
+                return ctx.scheduler.tasks[b"k"]
+
+            def also_bad(sched):
+                sched.object_table.pop(b"k", None)
+
+            def fine(ctx):
+                return ctx.scheduler.call("get_metas", None)
+            """,
+    })
+    violations = pass_ownership.run(pkg)
+    keys = sorted(v.key for v in violations)
+    assert any("head_table.tasks" in k for k in keys)
+    assert any("head_table.object_table" in k for k in keys)
+    assert len(violations) == 2
+
+
+def test_ownership_scheduler_module_itself_exempt():
+    from ray_tpu.devtools import pass_ownership
+
+    pkg = make_pkg(**{
+        "ray_tpu._private.scheduler": """
+            class Scheduler:
+                def seal(self, key):
+                    return self.object_table.get(key)
+            """,
+    })
+    assert pass_ownership.run(pkg) == []
+
+
 # --------------------------------------------------------------- allowlist
 def test_allowlist_requires_justification_and_rejects_stale(tmp_path):
     f = tmp_path / "allow.txt"

@@ -12,6 +12,10 @@ if [[ "${1:-}" == "--lint-only" ]]; then
 fi
 
 echo
+echo "== native wire-codec parity fuzz (from-source build + C/py byte parity) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/native_parity_fuzz.py
+
+echo
 echo "== chaos smoke (seeded failpoint schedule) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 

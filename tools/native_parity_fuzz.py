@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Native-vs-Python wire-codec parity fuzz (tools/check.sh stage).
+
+Forces a from-source rebuild of `_native/wire_native.c`, then round-trips a
+randomized message for EVERY tag in MESSAGE_GRAMMAR (plus adversarial value
+shapes) through both codecs, asserting:
+
+  1. byte parity:     C.pack(msg) == PyCodec.pack(msg)
+  2. cross-decode:    PyCodec.unpack(C.pack(msg)) == msg (and vice versa)
+  3. dumps/loads:     serialization round-trips the framed form
+
+Seeded (--seed, default 20260804) so a failure replays exactly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def rebuild_extension() -> None:
+    """Delete the prebuilt .so and build from source — the stage must prove
+    the CURRENT source compiles and loads on this toolchain."""
+    from ray_tpu import _native
+
+    if os.path.exists(_native._WIRE_LIB):
+        os.unlink(_native._WIRE_LIB)
+    mod = _native.load_wire_module()
+    if mod is None:
+        raise SystemExit(
+            "native wire extension failed to build from source "
+            "(g++/Python.h available? see _native/__init__.py)"
+        )
+
+
+def rand_simple(rng: random.Random, depth: int = 0):
+    kinds = ["none", "bool", "int", "float", "bytes", "str"]
+    if depth < 3:
+        kinds += ["tuple", "list", "dict"]
+    k = rng.choice(kinds)
+    if k == "none":
+        return None
+    if k == "bool":
+        return rng.random() < 0.5
+    if k == "int":
+        return rng.choice([
+            0, 1, -1, rng.randint(-2**31, 2**31),
+            rng.randint(-2**62, 2**62), 2**63 - 1, -(2**63),
+            2**80,  # > i64: exercises the big-int hook escape
+        ])
+    if k == "float":
+        return rng.choice([0.0, -1.5, 3.14159, 1e300, -1e-300])
+    if k == "bytes":
+        return rng.randbytes(rng.randint(0, 64))
+    if k == "str":
+        return "".join(
+            rng.choice("abcé中 xyz_") for _ in range(rng.randint(0, 24))
+        )
+    if k == "tuple":
+        return tuple(rand_simple(rng, depth + 1) for _ in range(rng.randint(0, 4)))
+    if k == "list":
+        return [rand_simple(rng, depth + 1) for _ in range(rng.randint(0, 4))]
+    return {
+        rng.choice(["a", "bb", "c" * 3, 7, b"k"]): rand_simple(rng, depth + 1)
+        for _ in range(rng.randint(0, 4))
+    }
+
+
+def rand_meta(rng: random.Random):
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ObjectMeta
+
+    oid = ObjectID(rng.randbytes(28))
+    if rng.random() < 0.5:
+        return ObjectMeta(
+            object_id=oid, size=rng.randint(0, 1 << 20),
+            inband=rng.randbytes(rng.randint(0, 128)),
+            inline_buffers=[rng.randbytes(8) for _ in range(rng.randint(0, 2))],
+            is_error=rng.random() < 0.1,
+        )
+    return ObjectMeta(
+        object_id=oid, size=rng.randint(0, 1 << 30),
+        segment=f"/dev/shm/seg_{rng.randint(0, 999)}",
+        buffer_layout=[(0, 8), (8, rng.randint(1, 99))],
+        node_id=rng.randbytes(16),
+        arena_offset=rng.choice([None, rng.randint(0, 1 << 30)]),
+        spilled=rng.random() < 0.2,
+    )
+
+
+def rand_spec(rng: random.Random):
+    from ray_tpu._private.ids import ActorID, JobID, TaskID
+    from ray_tpu._private.protocol import FunctionDescriptor, TaskSpec
+
+    tid = TaskID.for_task(ActorID(b"\x00" * 12 + JobID.from_int(1).binary()))
+    return TaskSpec(
+        task_id=tid,
+        func=FunctionDescriptor(rng.randbytes(8).hex(), "fuzz_fn"),
+        num_returns=rng.randint(0, 3),
+        resources={"CPU": float(rng.randint(0, 4))},
+        max_retries=rng.randint(0, 3),
+        name="fuzz", env_vars={"K": "v"} if rng.random() < 0.3 else {},
+    )
+
+
+def rand_exec(rng: random.Random):
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.protocol import ExecRequest
+
+    spec = rand_spec(rng)
+    return ExecRequest(
+        spec=spec,
+        arg_metas=[rand_meta(rng) for _ in range(rng.randint(0, 2))],
+        kwarg_metas={"k": rand_meta(rng)} if rng.random() < 0.3 else {},
+        func_blob=rng.randbytes(32) if rng.random() < 0.3 else None,
+        return_ids=[ObjectID(rng.randbytes(28)) for _ in range(spec.num_returns)],
+    )
+
+
+def rand_record(rng: random.Random):
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.scheduler import fast_task_record
+
+    spec = rand_spec(rng)
+    return fast_task_record(
+        spec,
+        [("id", rng.randbytes(28)), ("meta", rand_meta(rng))],
+        {"kw": ("id", rng.randbytes(28))},
+        [ObjectID(rng.randbytes(28))],
+        rng.randbytes(16) if rng.random() < 0.3 else None,
+        rng.randint(0, 3),
+    )
+
+
+def message_for_tag(tag: str, rng: random.Random):
+    """A randomized, arity-correct message for each grammar tag."""
+    from ray_tpu._private.protocol import MESSAGE_GRAMMAR
+
+    tid = rng.randbytes(24)
+    special = {
+        "done": lambda: ("done", tid, rng.random() < 0.9,
+                         [rand_meta(rng) for _ in range(rng.randint(0, 2))],
+                         {"exec_start": rng.random(), "exec_end": rng.random()}),
+        "exec": lambda: ("exec", rand_exec(rng)),
+        "cmd": lambda: ("cmd", "submit", rand_record(rng)),
+        "req": lambda: ("req", rng.randint(0, 1 << 30), "get_metas",
+                        [rng.randbytes(28)]),
+        "resp": lambda: ("resp", rng.randint(0, 1 << 30), True,
+                         [rand_meta(rng)]),
+        "ref_ops": lambda: ("ref_ops", [
+            (rng.choice(["add", "rel", "genrel", "srel"]), rng.randbytes(28))
+            for _ in range(rng.randint(0, 8))
+        ]),
+        "own_meta": lambda: ("own_meta", rand_meta(rng)),
+        "stream": lambda: ("stream", tid, rng.randint(0, 100), rand_meta(rng)),
+        "batch": lambda: ("batch", [
+            ("done", rng.randbytes(24), True, [rand_meta(rng)], None)
+            for _ in range(rng.randint(1, 5))
+        ]),
+        "object_locations": lambda: ("object_locations", rng.randint(0, 99), {
+            rng.randbytes(28): (rand_meta(rng),
+                                [(rng.randbytes(16), "127.0.0.1:1")]),
+        }),
+    }
+    if tag in special:
+        return special[tag]()
+    lo, hi = MESSAGE_GRAMMAR[tag]["arity"]
+    n = rng.randint(lo, hi)
+    return (tag,) + tuple(rand_simple(rng) for _ in range(n - 1))
+
+
+def norm(x):
+    """Structural normal form for equality across dataclass instances."""
+    from ray_tpu._private.object_store import ObjectMeta
+    from ray_tpu._private.protocol import ExecRequest, FunctionDescriptor, TaskSpec
+    from ray_tpu._private.scheduler import TaskRecord
+
+    if isinstance(x, TaskRecord):
+        return ("REC", norm(x.spec), norm(list(x.arg_entries)),
+                norm(x.kwarg_entries), norm(x.return_ids), x.func_blob,
+                x.retries_left)
+    if isinstance(x, ExecRequest):
+        return ("EXEC", norm(x.spec), norm(x.arg_metas), norm(x.kwarg_metas),
+                x.func_blob, norm(x.return_ids))
+    if isinstance(x, (TaskSpec, ObjectMeta)):
+        return tuple(sorted((k, norm(v)) for k, v in x.__dict__.items()))
+    if isinstance(x, FunctionDescriptor):
+        return (x.function_id, x.name)
+    if isinstance(x, tuple):
+        return tuple(norm(i) for i in x)
+    if isinstance(x, list):
+        return ("L",) + tuple(norm(i) for i in x)
+    if isinstance(x, dict):
+        pairs = [(repr(norm(k)), norm(v)) for k, v in x.items()]
+        pairs.sort(key=lambda kv: kv[0])
+        return ("D",) + tuple(pairs)
+    if hasattr(x, "_binary"):
+        return (type(x).__name__, x._binary)
+    return x
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=20260804)
+    parser.add_argument("--rounds", type=int, default=40,
+                        help="randomized messages per grammar tag")
+    ns = parser.parse_args()
+
+    rebuild_extension()
+    from ray_tpu._private import serialization, wire
+    from ray_tpu._private.protocol import MESSAGE_GRAMMAR
+
+    native = wire._load_codec()
+    assert wire.native_available(), "C codec must be active after rebuild"
+    py = wire._PyCodec
+
+    rng = random.Random(ns.seed)
+    checked = 0
+    for tag in sorted(MESSAGE_GRAMMAR):
+        for _ in range(ns.rounds):
+            msg = message_for_tag(tag, rng)
+            c_bytes = native.pack(msg)
+            p_bytes = py.pack(msg)
+            assert c_bytes == p_bytes, (
+                f"byte divergence for tag {tag!r}: "
+                f"C={c_bytes[:60]!r} PY={p_bytes[:60]!r}"
+            )
+            via_c = native.unpack(p_bytes)
+            via_py = py.unpack(c_bytes)
+            want = norm(msg)
+            assert norm(via_c) == want, f"C decode mismatch for {tag!r}"
+            assert norm(via_py) == want, f"Python decode mismatch for {tag!r}"
+            # Framed end-to-end through serialization (magic dispatch).
+            framed = wire.encode(msg)
+            assert framed is not None and framed[:1] == wire.MAGIC
+            assert norm(serialization.loads(framed)) == want
+            checked += 1
+    # Adversarial simple-value structures (no tag constraint).
+    for _ in range(600):
+        val = ("cmd", "kv", rand_simple(rng))
+        c_bytes = native.pack(val)
+        assert c_bytes == py.pack(val), f"byte divergence for {val!r}"
+        assert norm(py.unpack(c_bytes)) == norm(val)
+        assert norm(native.unpack(c_bytes)) == norm(val)
+        checked += 1
+    print(f"native parity fuzz OK: {checked} messages, seed {ns.seed}, "
+          f"{len(MESSAGE_GRAMMAR)} grammar tags")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
