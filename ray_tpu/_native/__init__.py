@@ -10,6 +10,7 @@ Current components:
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -21,13 +22,58 @@ _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
+# Stale-binary guard markers: each built .so embeds
+# "<marker><sha256-of-its-source>\0" (see the #define stanzas in the C
+# sources), so source<->binary drift is detectable by reading the binary —
+# no dlopen needed. devtools/verify and tools/check.sh use the same scheme.
+ARENA_HASH_MARKER = b"RAY_TPU_ARENA_SRC_SHA256="
+WIRE_HASH_MARKER = b"RAY_TPU_WIRE_SRC_SHA256="
+
+
+def source_sha256(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def embedded_source_hash(lib_path: str, marker: bytes) -> Optional[str]:
+    """The source hash stamped into a built .so, or None when the binary is
+    missing or predates the stamp (treated as stale by callers)."""
+    try:
+        with open(lib_path, "rb") as fh:
+            data = fh.read()
+    except OSError:
+        return None
+    i = data.find(marker)
+    if i < 0:
+        return None
+    i += len(marker)
+    end = data.find(b"\x00", i)
+    if end < 0:
+        return None
+    try:
+        return data[i:end].decode("ascii")
+    except UnicodeDecodeError:
+        return None
+
+
+def _binary_is_current(lib_path: str, marker: bytes, src_path: str) -> bool:
+    src = source_sha256(src_path)
+    return src is not None and embedded_source_hash(lib_path, marker) == src
+
 
 def _build() -> bool:
     src = os.path.join(_SRC_DIR, "shm_arena.cpp")
     # pid-unique tmp + atomic replace: concurrent first-use builds from many
     # worker processes each publish a COMPLETE .so (last writer wins).
     tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src, "-lpthread"]
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f'-DARENA_SRC_SHA256="{source_sha256(src)}"',
+        "-o", tmp, src, "-lpthread",
+    ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
@@ -49,8 +95,11 @@ def load_arena_lib() -> Optional[ctypes.CDLL]:
     with _build_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(
-            os.path.join(_SRC_DIR, "shm_arena.cpp")
+        # Source hash, not mtime, decides staleness: git checkouts give
+        # source and binary arbitrary mtime order, and a committed .so from
+        # a drifted source must rebuild regardless of timestamps.
+        if not _binary_is_current(
+            _LIB_PATH, ARENA_HASH_MARKER, os.path.join(_SRC_DIR, "shm_arena.cpp")
         ):
             if not _build():
                 _build_failed = True
@@ -165,7 +214,9 @@ def _build_wire() -> bool:
         return False
     tmp = f"{_WIRE_LIB}.tmp.{os.getpid()}"
     cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-I", include, "-o", tmp, _WIRE_SRC,
+        "g++", "-O2", "-shared", "-fPIC", "-I", include,
+        f'-DWIRE_SRC_SHA256="{source_sha256(_WIRE_SRC)}"',
+        "-o", tmp, _WIRE_SRC,
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
@@ -188,9 +239,7 @@ def load_wire_module():
     with _wire_lock:
         if _wire_mod is not None:
             return _wire_mod
-        if not os.path.exists(_WIRE_LIB) or os.path.getmtime(
-            _WIRE_LIB
-        ) < os.path.getmtime(_WIRE_SRC):
+        if not _binary_is_current(_WIRE_LIB, WIRE_HASH_MARKER, _WIRE_SRC):
             if not _build_wire():
                 _wire_failed = True
                 return None

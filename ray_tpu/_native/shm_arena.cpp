@@ -33,6 +33,16 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+// Source-hash stamp (stale-binary guard): the build flow passes
+// -DARENA_SRC_SHA256="<hex>" with the sha256 of this file; the marker string
+// makes the hash greppable from the binary without loading it, and
+// arena_source_hash() exposes it to the loader for self-heal rebuilds.
+#ifndef ARENA_SRC_SHA256
+#define ARENA_SRC_SHA256 "unknown"
+#endif
+__attribute__((used)) static const char arena_src_marker[] =
+    "RAY_TPU_ARENA_SRC_SHA256=" ARENA_SRC_SHA256;
+
 namespace {
 
 constexpr uint64_t kMagic = 0x52415954505541ULL;  // "RAYTPUA"
@@ -128,11 +138,29 @@ void* arena_attach(const char* path) {
   if (fd < 0) return nullptr;
   struct stat st;
   if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  // A truncated/empty file cannot hold even the header + first block: mapping
+  // it and dereferencing the header would read past EOF (SIGBUS on the last
+  // partial page). Validate BEFORE touching the mapping.
+  if (static_cast<uint64_t>(st.st_size) < kHeaderSize + kBlockSize) {
+    close(fd);
+    return nullptr;
+  }
   void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
   auto* hd = reinterpret_cast<ArenaHeader*>(mem);
-  if (hd->magic != kMagic) { munmap(mem, st.st_size); return nullptr; }
+  // Reject a header whose claimed capacity exceeds the real mapping: every
+  // block-walk bound derives from map_size, but used/capacity accounting
+  // trusts the header, and a corrupt capacity would let a split carve blocks
+  // past EOF on a file that shrank underneath us. Compare by SUBTRACTION:
+  // `kHeaderSize + kBlockSize + capacity` wraps for a hostile capacity near
+  // 2^64 (unsigned wrap is defined behavior — UBSan stays silent) and would
+  // step right around this check.
+  if (hd->magic != kMagic ||
+      hd->capacity > static_cast<uint64_t>(st.st_size) - kHeaderSize - kBlockSize) {
+    munmap(mem, st.st_size);
+    return nullptr;
+  }
   auto* h = new Handle{reinterpret_cast<uint8_t*>(mem), static_cast<uint64_t>(st.st_size)};
   return h;
 }
@@ -233,5 +261,8 @@ uint64_t arena_map_size(void* handle) {
   auto* h = static_cast<Handle*>(handle);
   return h ? h->map_size : 0;
 }
+
+// Hash of the source this binary was built from (stale-binary guard).
+const char* arena_source_hash(void) { return ARENA_SRC_SHA256; }
 
 }  // extern "C"

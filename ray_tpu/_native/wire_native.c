@@ -33,8 +33,25 @@
 
 #define WIRE_MAX_DEPTH 100
 
+/* Source-hash stamp: the build flow (_native/__init__.py) passes
+ * -DWIRE_SRC_SHA256="<hex>" with the sha256 of THIS file, exported both as a
+ * module constant (SOURCE_HASH) and as a greppable marker string inside the
+ * binary, so a checked-in .so that no longer matches its source is
+ * detectable without loading it (tools/check.sh stale-binary guard). */
+#ifndef WIRE_SRC_SHA256
+#define WIRE_SRC_SHA256 "unknown"
+#endif
+__attribute__((used)) static const char wire_src_marker[] =
+    "RAY_TPU_WIRE_SRC_SHA256=" WIRE_SRC_SHA256;
+
 static PyObject *enc_hook = NULL; /* obj -> (tag:int 0..255, payload) | None */
 static PyObject *dec_hook = NULL; /* (tag, payload) -> obj */
+
+/* Decode-side frame ceiling (config knob wire_max_frame_bytes, pushed in by
+ * wire.py via set_limits). A frame larger than this is rejected up front —
+ * no interior length field of a hostile frame is ever trusted into an
+ * allocation bigger than the frame itself (see the count checks below). */
+static Py_ssize_t max_frame_bytes = 256 * 1024 * 1024;
 
 /* ------------------------------------------------------------------ writer */
 typedef struct {
@@ -284,6 +301,13 @@ static PyObject *decode_obj(Reader *r, int depth) {
         uint32_t n;
         if (r_u32(r, &n) < 0)
             return NULL;
+        /* Each element costs at least 1 byte: a count beyond the remaining
+         * input is a lie — reject BEFORE presizing (a 5-byte frame claiming
+         * 2^32-1 elements must not allocate a 34GB container). */
+        if ((Py_ssize_t)n > r->end - r->p) {
+            PyErr_SetString(PyExc_ValueError, "wire: truncated frame");
+            return NULL;
+        }
         PyObject *tup = PyTuple_New(n);
         if (!tup)
             return NULL;
@@ -301,6 +325,10 @@ static PyObject *decode_obj(Reader *r, int depth) {
         uint32_t n;
         if (r_u32(r, &n) < 0)
             return NULL;
+        if ((Py_ssize_t)n > r->end - r->p) {
+            PyErr_SetString(PyExc_ValueError, "wire: truncated frame");
+            return NULL;
+        }
         PyObject *lst = PyList_New(n);
         if (!lst)
             return NULL;
@@ -318,6 +346,12 @@ static PyObject *decode_obj(Reader *r, int depth) {
         uint32_t n;
         if (r_u32(r, &n) < 0)
             return NULL;
+        /* A pair costs at least 2 bytes; unlike PyList_New's lazy pages,
+         * the presized dict table is TOUCHED, so this bound matters. */
+        if ((Py_ssize_t)n > (r->end - r->p) / 2) {
+            PyErr_SetString(PyExc_ValueError, "wire: truncated frame");
+            return NULL;
+        }
         PyObject *dct = _PyDict_NewPresized(n);
         if (!dct)
             return NULL;
@@ -337,6 +371,14 @@ static PyObject *decode_obj(Reader *r, int depth) {
                 Py_DECREF(k);
                 Py_DECREF(v);
                 Py_DECREF(dct);
+                /* Unhashable key: the encoder never emits container keys,
+                 * so this is a forged/corrupt frame — typed rejection
+                 * (fuzzer-found; keep in sync with the Python twin). */
+                if (PyErr_ExceptionMatches(PyExc_TypeError)) {
+                    PyErr_Clear();
+                    PyErr_SetString(PyExc_ValueError,
+                                    "wire: unhashable dict key in frame");
+                }
                 return NULL;
             }
             Py_DECREF(k);
@@ -397,6 +439,12 @@ static PyObject *py_unpack(PyObject *self, PyObject *args) {
         PyErr_SetString(PyExc_ValueError, "wire: bad offset");
         return NULL;
     }
+    if (view.len - offset > max_frame_bytes) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_ValueError,
+                        "wire: frame exceeds wire_max_frame_bytes");
+        return NULL;
+    }
     Reader r = {(const char *)view.buf + offset,
                 (const char *)view.buf + view.len};
     PyObject *out = decode_obj(&r, 0);
@@ -424,6 +472,18 @@ static PyObject *py_set_hooks(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
+static PyObject *py_set_limits(PyObject *self, PyObject *args) {
+    Py_ssize_t max_frame;
+    if (!PyArg_ParseTuple(args, "n", &max_frame))
+        return NULL;
+    if (max_frame <= 0) {
+        PyErr_SetString(PyExc_ValueError, "wire: max_frame_bytes must be > 0");
+        return NULL;
+    }
+    max_frame_bytes = max_frame;
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef wire_methods[] = {
     {"pack", py_pack, METH_O,
      "pack(obj) -> bytes — encode a simple-value structure (hooks for the rest)."},
@@ -431,6 +491,8 @@ static PyMethodDef wire_methods[] = {
      "unpack(data[, offset]) -> obj — decode a frame produced by pack()."},
     {"set_hooks", py_set_hooks, METH_VARARGS,
      "set_hooks(encode_cb, decode_cb) — install the dataclass/pickle escape hooks."},
+    {"set_limits", py_set_limits, METH_VARARGS,
+     "set_limits(max_frame_bytes) — decode-side frame-size ceiling."},
     {NULL, NULL, 0, NULL},
 };
 
@@ -439,4 +501,14 @@ static struct PyModuleDef wire_module = {
     "Compact tagged wire codec for ray_tpu control messages.", -1, wire_methods,
 };
 
-PyMODINIT_FUNC PyInit_wire_native(void) { return PyModule_Create(&wire_module); }
+PyMODINIT_FUNC PyInit_wire_native(void) {
+    PyObject *mod = PyModule_Create(&wire_module);
+    if (!mod)
+        return NULL;
+    /* Stale-binary guard: the hash of the source this .so was built from. */
+    if (PyModule_AddStringConstant(mod, "SOURCE_HASH", WIRE_SRC_SHA256) < 0) {
+        Py_DECREF(mod);
+        return NULL;
+    }
+    return mod;
+}

@@ -83,6 +83,14 @@ class Config:
     # (pure-Python codec without a toolchain); False sends pickle only.
     # Receivers accept BOTH formats regardless (magic-byte dispatch).
     use_native_protocol: Optional[bool] = None
+    # Hard ceiling on one framed wire message (decode side, both codecs).
+    # Control frames are small (batches cap at control_plane_batch_max_bytes;
+    # large object bytes ride the data plane as RAW chunk frames, never the
+    # codec), so a frame claiming more than this is malformed or hostile and
+    # is rejected with a typed WireDecodeError BEFORE any length field is
+    # trusted into an allocation. Interior length/count fields are further
+    # validated against the actual remaining bytes of the frame.
+    wire_max_frame_bytes: int = 256 * 1024 * 1024
     # When a put would exceed object_store_memory, relocate the just-written
     # (not yet visible) object to the disk spill directory instead of raising —
     # the analogue of plasma's fallback allocations to /tmp

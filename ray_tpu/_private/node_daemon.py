@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Dict
 
-from ray_tpu._private import failpoints, serialization
+from ray_tpu._private import failpoints, serialization, session_monitor
 
 
 class NodeDaemon:
@@ -290,6 +290,8 @@ class NodeDaemon:
     def _dispatch(self, msg) -> bool:
         """Handle one head->daemon message; False means shutdown."""
         kind = msg[0]
+        if session_monitor.ENABLED:
+            session_monitor.check_tag("daemon.dispatch", kind)
         if kind == "batch":
             # Coalesced control frame (head-side micro-batching, e.g. a
             # delete burst): process every contained message.

@@ -59,7 +59,7 @@ import socket as _socket
 import threading
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import failpoints, serialization
+from ray_tpu._private import failpoints, serialization, session_monitor
 from ray_tpu._private.concurrency import any_thread, lock_guarded
 
 # Pull priorities: smaller drains first (reference: pull_manager.h queues
@@ -185,6 +185,8 @@ def locate_via(send: Callable[[tuple], None], keys: List[bytes],
         _locate_token += 1
         token = _locate_token
         _locate_pending[token] = q
+    if session_monitor.ENABLED:
+        session_monitor.expect("locate_object", token)
     try:
         send(("locate_object", token, keys))
         return q.get(timeout=timeout)
@@ -193,11 +195,15 @@ def locate_via(send: Callable[[tuple], None], keys: List[bytes],
     finally:
         with _locate_lock:
             _locate_pending.pop(token, None)
+        if session_monitor.ENABLED:
+            session_monitor.forget("locate_object", token)
 
 
 @any_thread
 def deliver_locations(token: int, payload) -> None:
     """Reader-side hook: route an object_locations reply to its waiter."""
+    if session_monitor.ENABLED:
+        session_monitor.resolve("object_locations", token)
     with _locate_lock:
         q = _locate_pending.get(token)
     if q is not None:
@@ -252,6 +258,9 @@ class _PeerConnection:
         # (mutated under the manager lock; read by the reader thread).
         self.active: Dict[int, _PullRequest] = {}
         self._thread: Optional[threading.Thread] = None
+        # Session-machine conformance (None unless RAY_TPU_DEBUG_INVARIANTS):
+        # chunk/end frames must reference a stream this side opened.
+        self._smon = session_monitor.stream()
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -285,6 +294,8 @@ class _PeerConnection:
             path, offset = meta.segment, meta.arena_offset
         else:
             path, offset = meta.object_id.hex(), None
+        if self._smon is not None:
+            self._smon.note("transfer_begin", req_id)
         try:
             self.sender.send(
                 ("transfer_begin", req_id, path, offset,
@@ -301,6 +312,9 @@ class _PeerConnection:
             while True:
                 msg = serialization.loads(self.conn.recv_bytes())
                 kind = msg[0]
+                if session_monitor.ENABLED:
+                    session_monitor.check_tag("transfer.pull", kind)
+                    self._smon.note(kind, msg[1])
                 if kind == "transfer_chunk":
                     # Header frame; the payload rides the NEXT frame raw
                     # (never pickled — see the module docstring).
@@ -466,6 +480,10 @@ class PullManager:
             self._settle_locked(req, "cancelled",
                                 PullCancelled(f"pull of {key.hex()} cancelled"))
             if req.conn is not None and req.req_id is not None:
+                if req.conn._smon is not None:
+                    # Locally-originated close: retire the stream in the
+                    # monitor (the peer never echoes a cancel back).
+                    req.conn._smon.note("transfer_cancel", req.req_id)
                 try:
                     req.conn.sender.send_async(("transfer_cancel", req.req_id))
                 except (OSError, ValueError):
@@ -849,6 +867,9 @@ class PushEndpoint:
         self.shm_root = os.path.realpath(manager.shm_dir)
         self.window = manager.window
         self._states: Dict[int, _PushState] = {}
+        # Session-machine conformance (None unless RAY_TPU_DEBUG_INVARIANTS):
+        # ack/cancel frames must reference a stream this side saw begun.
+        self._smon = session_monitor.stream()
 
     def serve(self) -> None:
         try:
@@ -871,6 +892,10 @@ class PushEndpoint:
 
     def _dispatch(self, msg) -> None:
         kind = msg[0]
+        if session_monitor.ENABLED:
+            session_monitor.check_tag("transfer.push", kind)
+            if kind != "batch":
+                self._smon.note(kind, msg[1])
         if kind == "batch":
             # Puller-side BatchedSender coalesces acks/begins into one frame.
             for m in msg[1]:
@@ -951,6 +976,10 @@ class PushEndpoint:
             st.fh.close()
         except OSError:
             pass
+        if self._smon is not None:
+            # The SENT close retires the stream too — without this, every
+            # normally-completed transfer stays "active" in the monitor.
+            self._smon.note("transfer_end", st.req_id)
         self._send(("transfer_end", st.req_id, ok, err))
 
     def _send(self, msg) -> None:

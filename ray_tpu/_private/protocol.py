@@ -86,12 +86,12 @@ MESSAGE_GRAMMAR = {
                "5th element as optional",
     },
     "req": {
-        "dir": "worker->head", "arity": (4, 4),
+        "dir": "worker+driver->head", "arity": (4, 4),
         "readers": ("scheduler.worker", "scheduler.driver"),
         "doc": "(req_id, method, payload) — blocking control-plane RPC",
     },
     "cmd": {
-        "dir": "worker->head", "arity": (3, 3),
+        "dir": "worker+driver->head", "arity": (3, 3),
         "readers": ("scheduler.worker", "scheduler.driver"),
         "doc": "(method, payload) — one-way request, no ack (pipelined submits)",
     },
@@ -106,7 +106,7 @@ MESSAGE_GRAMMAR = {
         "doc": "(worker_id_hex, pid, stream, task_name, lines) — stdout/err ship",
     },
     "ref_ops": {
-        "dir": "worker->head", "arity": (2, 2),
+        "dir": "worker+driver->head", "arity": (2, 2),
         "readers": ("scheduler.worker", "scheduler.driver"),
         "doc": "([(op, key), ...],) — batched refcount ops",
     },
@@ -315,6 +315,67 @@ MESSAGE_GRAMMAR = {
         "dir": "handshake", "arity": (2, 4), "readers": (),
         "doc": "(payload, ...) — registration accepted (daemon: node_id_hex + "
                "monitor settings; driver: session info dict)",
+    },
+}
+
+# --------------------------------------------------------------------------
+# Per-connection SESSION machine. MESSAGE_GRAMMAR pins each tag's shape;
+# this spec pins the STATEFUL rules between tags — which role may speak
+# which tag, which request expects which reply (token-paired), and which
+# tags form a streaming sequence. PURE LITERAL like the grammar: the static
+# checker (`python -m ray_tpu.devtools.verify`, pass `session`) reads it
+# with ast.literal_eval and cross-checks every sender site's module role and
+# the spec's own coherence against the grammar; the runtime conformance
+# monitor (`_private/session_monitor.py`, armed by RAY_TPU_DEBUG_INVARIANTS)
+# is compiled from the same spec and flags out-of-state frames live —
+# a reply whose token was never requested, a transfer_chunk for a stream
+# that never saw transfer_begin, a tag arriving at a dispatcher the grammar
+# does not route it to.
+#
+#   module_roles -- which protocol role(s) each sender module speaks; the
+#                   sender side of a tag's "dir" ("worker" of "worker->head",
+#                   split on "+" for multi-role tags, "any"/"handshake"
+#                   always allowed) must intersect the module's roles.
+#   pairs        -- request tag -> its reply tag. token_elem is the tuple
+#                   index (on both sides) carrying the correlation token;
+#                   the runtime monitor flags replies with unknown tokens.
+#   streams      -- named streaming sequences: `open` starts a keyed stream
+#                   (key_elem indexes the stream id in every frame), `data`
+#                   tags may only refer to a key the endpoint has seen
+#                   opened, `close` tags retire it (late data frames for a
+#                   RETIRED key stay legal: acks/chunks drain in flight).
+# --------------------------------------------------------------------------
+
+SESSION_SPEC = {
+    "module_roles": {
+        "scheduler.py": ("head",),
+        "head.py": ("head",),
+        "worker_main.py": ("worker",),
+        "worker_entry.py": ("worker",),
+        "worker.py": ("driver",),
+        "node_daemon.py": ("daemon",),
+        # Generic transport: BatchedSender wraps ANY buffered message in
+        # ("batch", ...) frames; it never originates a protocol tag itself.
+        "batching.py": ("any",),
+        # The data plane runs in every reader/server process: pull side
+        # speaks puller tags, push side pusher tags (+ location queries,
+        # which the grammar marks any->head).
+        "object_transfer.py": ("puller", "pusher"),
+    },
+    "pairs": {
+        "req": {"reply": "resp", "token_elem": 1},
+        "dump_stacks": {"reply": "stacks_data", "token_elem": 1},
+        "profile_stop": {"reply": "profile_data", "token_elem": 1},
+        "locate_object": {"reply": "object_locations", "token_elem": 1},
+        "read_object": {"reply": "object_data", "token_elem": 1},
+    },
+    "streams": {
+        "transfer": {
+            "open": "transfer_begin",
+            "data": ("transfer_chunk", "transfer_ack"),
+            "close": ("transfer_end", "transfer_cancel"),
+            "key_elem": 1,
+        },
     },
 }
 

@@ -32,7 +32,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private import failpoints, serialization
+from ray_tpu._private import failpoints, serialization, session_monitor
 from ray_tpu._private.batching import approx_msg_nbytes as _approx_msg_nbytes
 from ray_tpu._private.concurrency import any_thread, loop_thread_only
 from ray_tpu._private.config import Config
@@ -955,6 +955,8 @@ class Scheduler:
         for token, (key, meta) in list(self._pending_pulls.items()):
             if meta.node_id == source_node_id:
                 del self._pending_pulls[token]
+                if session_monitor.ENABLED:
+                    session_monitor.forget("read_object", token)
                 for respond in self._relay_waiters.pop(key, []):
                     respond(False, ConnectionError(
                         "object source node died during pull"))
@@ -1393,6 +1395,8 @@ class Scheduler:
     @loop_thread_only
     def _on_daemon_message(self, daemon: DaemonHandle, msg):
         kind = msg[0]
+        if session_monitor.ENABLED:
+            session_monitor.check_tag("scheduler.daemon", kind)
         if kind == "batch":
             for m in msg[1]:
                 self._on_daemon_message(daemon, m)
@@ -1415,6 +1419,8 @@ class Scheduler:
             _, token, ok, data = msg
             self._finish_pull(token, ok, data)
         elif kind == "stacks_data" or kind == "profile_data":
+            if session_monitor.ENABLED:
+                session_monitor.resolve(kind, msg[1])
             self._on_introspect_reply(msg[1], msg[2])
         elif kind == "memory_pressure":
             from ray_tpu._private.memory_monitor import MemorySnapshot
@@ -1445,6 +1451,8 @@ class Scheduler:
     @loop_thread_only
     def _on_driver_message(self, dh: DriverHandle, msg):
         kind = msg[0]
+        if session_monitor.ENABLED:
+            session_monitor.check_tag("scheduler.driver", kind)
         if kind == "batch":
             for m in msg[1]:
                 self._on_driver_message(dh, m)
@@ -2043,6 +2051,8 @@ class Scheduler:
     @loop_thread_only
     def _on_worker_message(self, wh: WorkerHandle, msg):
         kind = msg[0]
+        if session_monitor.ENABLED:
+            session_monitor.check_tag("scheduler.worker", kind)
         if kind == "batch":
             # Coalesced frame: apply every contained message now; scheduling
             # work runs once per loop iteration regardless of batch size.
@@ -2083,6 +2093,8 @@ class Scheduler:
         elif kind == "locate_object":
             self._on_locate_object(wh, msg[1], msg[2])
         elif kind == "stacks_data" or kind == "profile_data":
+            if session_monitor.ENABLED:
+                session_monitor.resolve(kind, msg[1])
             self._on_introspect_reply(msg[1], msg[2])
 
     @any_thread
@@ -3795,14 +3807,20 @@ class Scheduler:
         self._pull_token += 1
         token = self._pull_token
         self._pending_pulls[token] = (object_key, meta)
+        if session_monitor.ENABLED:
+            session_monitor.expect("read_object", token)
         if not source.send(
             ("read_object", token, meta.segment, meta.arena_offset, meta.size)
         ):
             self._pending_pulls.pop(token, None)
+            if session_monitor.ENABLED:
+                session_monitor.forget("read_object", token)
             for r in self._relay_waiters.pop(object_key, []):
                 r(False, ConnectionError("object source node is unreachable"))
 
     def _finish_pull(self, token: int, ok: bool, data):
+        if session_monitor.ENABLED:
+            session_monitor.resolve("object_data", token)
         ent = self._pending_pulls.pop(token, None)
         if ent is None:
             return
@@ -3844,6 +3862,13 @@ class Scheduler:
         """Allocate a reply token routing back to (collection, target)."""
         self._introspect_token += 1
         self._introspect_pending[self._introspect_token] = (coll, key)
+        if session_monitor.ENABLED:
+            # OOB-relayed dumps still answer with the stacks_data tag, so
+            # the conceptual request for monitor pairing is dump_stacks.
+            session_monitor.expect(
+                "dump_stacks" if coll.kind == "stacks" else "profile_stop",
+                self._introspect_token,
+            )
         return self._introspect_token
 
     def _start_stack_collection(self, respond: Callable[[dict], None],
@@ -3921,6 +3946,10 @@ class Scheduler:
         stale = [t for t, (c, _k) in self._introspect_pending.items() if c is coll]
         for t in stale:
             del self._introspect_pending[t]
+            if session_monitor.ENABLED:
+                session_monitor.forget(
+                    "dump_stacks" if coll.kind == "stacks" else "profile_stop", t
+                )
         try:
             coll.respond(coll.results)
         except Exception:  # noqa: BLE001 — a dead requester must not kill the loop

@@ -51,9 +51,47 @@ TAG_FUNCDESC = 12
 
 _MAX_DEPTH = 100
 
+# Decode-side ceiling on one framed message; resolved from the config knob
+# `wire_max_frame_bytes` on first use (refresh() re-resolves). Both codecs
+# enforce the SAME limit so reject-parity holds between the twins.
+_DEFAULT_MAX_FRAME = 256 * 1024 * 1024
+_max_frame_bytes: Optional[int] = None
 
-class _WireError(ValueError):
-    pass
+
+class WireDecodeError(ValueError):
+    """Typed rejection of a malformed/hostile wire frame. Every decode
+    failure — truncated frame, oversized length field, unknown type byte,
+    hook payload of the wrong shape, even a hook exception — surfaces as
+    this type via `decode()`, so readers can distinguish "bad bytes" from
+    runtime bugs. Malformed bytes must never crash, hang, or overallocate
+    (lengths/counts are validated against the actual remaining input before
+    any allocation; see the fuzz harness in devtools/verify/fuzz_wire.py)."""
+
+
+# Internal alias: raise sites predate the public name.
+_WireError = WireDecodeError
+
+
+def max_frame_bytes() -> int:
+    global _max_frame_bytes
+    if _max_frame_bytes is None:
+        try:
+            from ray_tpu._private.config import get_config
+
+            _max_frame_bytes = int(get_config().wire_max_frame_bytes)
+        except Exception:  # noqa: BLE001 — config unavailable: safe default
+            _max_frame_bytes = _DEFAULT_MAX_FRAME
+        _push_native_limits()
+    return _max_frame_bytes
+
+
+def _push_native_limits() -> None:
+    """Propagate the frame cap into the loaded C codec (no-op for _PyCodec)."""
+    if _codec is not None and _codec_is_native and _max_frame_bytes is not None:
+        try:
+            _codec.set_limits(_max_frame_bytes)
+        except Exception:  # noqa: BLE001 — older .so without set_limits
+            pass
 
 
 # --------------------------------------------------------------------------
@@ -147,15 +185,27 @@ class _PyCodec:
 
     @staticmethod
     def unpack(data, offset: int = 0) -> Any:
+        if offset < 0 or offset > len(data):
+            raise _WireError("wire: bad offset")
+        if len(data) - offset > max_frame_bytes():
+            raise _WireError("wire: frame exceeds wire_max_frame_bytes")
         obj, pos = _PyCodec._dec(data, offset, 0)
         if pos != len(data):
             raise _WireError("wire: trailing bytes in frame")
         return obj
 
+    # Length/count fields are attacker-controlled: every one is validated
+    # against the ACTUAL remaining bytes of the frame before any allocation
+    # (a tuple/list element costs >= 1 byte, a dict pair >= 2), so a 5-byte
+    # frame claiming 2^32-1 elements is rejected as truncated instead of
+    # presizing a multi-GB container. Byte-identical rules in wire_native.c.
     @staticmethod
     def _dec(data, pos: int, depth: int):
         if depth > _MAX_DEPTH:
             raise _WireError("wire: max depth exceeded")
+        end = len(data)
+        if pos >= end:
+            raise _WireError("wire: truncated frame")
         tag = data[pos:pos + 1]
         pos += 1
         if tag == b"N":
@@ -165,35 +215,63 @@ class _PyCodec:
         if tag == b"F":
             return False, pos
         if tag == b"i":
+            if end - pos < 8:
+                raise _WireError("wire: truncated frame")
             return _unpack_i64(data, pos)[0], pos + 8
         if tag == b"f":
+            if end - pos < 8:
+                raise _WireError("wire: truncated frame")
             return _unpack_f64(data, pos)[0], pos + 8
         if tag == b"b":
+            if end - pos < 4:
+                raise _WireError("wire: truncated frame")
             n = _unpack_u32(data, pos)[0]
             pos += 4
+            if n > end - pos:
+                raise _WireError("wire: truncated frame")
             return bytes(data[pos:pos + n]), pos + n
         if tag == b"s":
+            if end - pos < 4:
+                raise _WireError("wire: truncated frame")
             n = _unpack_u32(data, pos)[0]
             pos += 4
+            if n > end - pos:
+                raise _WireError("wire: truncated frame")
             return bytes(data[pos:pos + n]).decode("utf-8"), pos + n
         if tag in (b"t", b"l"):
+            if end - pos < 4:
+                raise _WireError("wire: truncated frame")
             n = _unpack_u32(data, pos)[0]
             pos += 4
+            if n > end - pos:
+                raise _WireError("wire: truncated frame")
             items = []
             for _ in range(n):
                 item, pos = _PyCodec._dec(data, pos, depth + 1)
                 items.append(item)
             return (tuple(items) if tag == b"t" else items), pos
         if tag == b"d":
+            if end - pos < 4:
+                raise _WireError("wire: truncated frame")
             n = _unpack_u32(data, pos)[0]
             pos += 4
+            if n > (end - pos) // 2:
+                raise _WireError("wire: truncated frame")
             d = {}
             for _ in range(n):
                 k, pos = _PyCodec._dec(data, pos, depth + 1)
                 v, pos = _PyCodec._dec(data, pos, depth + 1)
-                d[k] = v
+                try:
+                    d[k] = v
+                except TypeError:
+                    # The encoder never emits container keys, so this frame
+                    # is forged/corrupt: typed rejection, not a TypeError
+                    # leaking out of the decoder (fuzzer-found).
+                    raise _WireError("wire: unhashable dict key in frame") from None
             return d, pos
         if tag == b"H":
+            if pos >= end:
+                raise _WireError("wire: truncated frame")
             htag = data[pos]
             pos += 1
             payload, pos = _PyCodec._dec(data, pos, depth + 1)
@@ -307,23 +385,38 @@ def _decode_hook(tag: int, payload: Any) -> Any:
     if not _hooks_ready:
         _init_hooks()
     if tag == TAG_PICKLE:
+        if type(payload) is not bytes:
+            raise _WireError("wire: pickle hook payload must be bytes")
         return pickle.loads(payload)
     cls = _tag_ids.get(tag)
     if cls is not None:
+        if type(payload) is not bytes:
+            raise _WireError("wire: id hook payload must be bytes")
         return cls._trusted(payload)
+    # Dataclass payloads are field tuples: a malformed frame with a short or
+    # non-tuple payload must raise HERE, not zip() into a half-built object
+    # whose missing attributes explode far from the decode site.
     if tag == TAG_META:
+        if type(payload) is not tuple or len(payload) != len(_meta_fields):
+            raise _WireError("wire: bad ObjectMeta hook payload")
         meta = _ObjectMeta.__new__(_ObjectMeta)
         meta.__dict__.update(zip(_meta_fields, payload))
         return meta
     if tag == TAG_SPEC:
+        if type(payload) is not tuple or len(payload) != len(_spec_fields):
+            raise _WireError("wire: bad TaskSpec hook payload")
         spec = _TaskSpec.__new__(_TaskSpec)
         spec.__dict__.update(zip(_spec_fields, payload))
         return spec
     if tag == TAG_FUNCDESC:
+        if type(payload) is not tuple or len(payload) != 2:
+            raise _WireError("wire: bad FunctionDescriptor hook payload")
         fd = _FunctionDescriptor.__new__(_FunctionDescriptor)
         fd.function_id, fd.name = payload
         return fd
     if tag == TAG_EXEC:
+        if type(payload) is not tuple or len(payload) != 9:
+            raise _WireError("wire: bad ExecRequest hook payload")
         (spec, arg_metas, kwarg_metas, func_blob, return_ids,
          arg_entries, kwarg_entries, saved_args, saved_kwargs) = payload
         req = _ExecRequest.__new__(_ExecRequest)
@@ -340,6 +433,8 @@ def _decode_hook(tag: int, payload: Any) -> Any:
             req._saved_kwarg_entries = saved_kwargs
         return req
     if tag == TAG_RECORD:
+        if type(payload) is not tuple or len(payload) != 6:
+            raise _WireError("wire: bad TaskRecord hook payload")
         spec, arg_entries, kwarg_entries, return_ids, func_blob, retries = payload
         return _fast_task_record(
             spec, arg_entries, kwarg_entries, return_ids, func_blob, retries
@@ -370,6 +465,12 @@ def _load_codec(prefer_native: bool = True):
                 mod.set_hooks(_encode_hook, _decode_hook)
                 _codec = mod
                 _codec_is_native = True
+                # Resolve the frame cap NOW and push it into the C static:
+                # the native decode path never re-reads the config, and a
+                # set_config that ran before this lazy load was a no-op push
+                # (_codec was still None then).
+                max_frame_bytes()
+                _push_native_limits()
                 return _codec
             except Exception:  # noqa: BLE001 — fall through to Python codec
                 pass
@@ -384,10 +485,16 @@ def native_available() -> bool:
 
 
 def refresh() -> None:
-    """Re-resolve the send knob from the current config (set_config calls
-    this; the decode path is knob-independent)."""
-    global _send_enabled
+    """Re-resolve the send knob and frame-size limit from the current config
+    (set_config calls this; decode FORMAT acceptance is knob-independent,
+    but the max-frame bound follows the config)."""
+    global _send_enabled, _max_frame_bytes
     _send_enabled = None
+    _max_frame_bytes = None
+    if _codec is not None:
+        # The C codec caches the limit in a module static: push the new
+        # value now (the native decode path never re-reads the config).
+        max_frame_bytes()
 
 
 def send_enabled() -> bool:
@@ -417,6 +524,16 @@ def encode(msg: Any) -> Optional[bytes]:
 
 
 def decode(data, offset: int = 1) -> Any:
-    """Decode a MAGIC-prefixed frame (offset skips the magic byte)."""
+    """Decode a MAGIC-prefixed frame (offset skips the magic byte).
+
+    Every failure mode — truncated/oversized/unknown bytes from the codec,
+    a hook blowing up on a malformed payload (bad pickle, wrong field
+    tuple) — surfaces as WireDecodeError, so callers get ONE typed signal
+    for "these bytes are not a valid frame"."""
     codec = _codec if _codec is not None else _load_codec()
-    return codec.unpack(data, offset)
+    try:
+        return codec.unpack(data, offset)
+    except WireDecodeError:
+        raise
+    except Exception as e:  # noqa: BLE001 — typed-error contract
+        raise WireDecodeError(f"wire: frame rejected: {type(e).__name__}: {e}") from e

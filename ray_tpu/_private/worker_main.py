@@ -21,7 +21,7 @@ import traceback
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
-from ray_tpu._private import failpoints, serialization
+from ray_tpu._private import failpoints, serialization, session_monitor
 from ray_tpu._private.config import Config, set_config
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import LocalObjectStore, ObjectMeta
@@ -158,12 +158,16 @@ class WorkerConnection:
             self._next_req_id += 1
             q: "queue.SimpleQueue" = queue.SimpleQueue()
             self._pending[req_id] = q
+        if session_monitor.ENABLED:
+            session_monitor.expect("req", req_id)
         self.send(("req", req_id, method, payload))
         try:
             ok, result = q.get(timeout=timeout)
         except queue.Empty:
             with self._req_lock:
                 self._pending.pop(req_id, None)
+            if session_monitor.ENABLED:
+                session_monitor.forget("req", req_id)
             raise TimeoutError(f"request {method} timed out after {timeout}s") from None
         if not ok:
             raise result
@@ -172,6 +176,13 @@ class WorkerConnection:
     def _dispatch(self, msg) -> bool:
         """Route one control message; False stops the reader (shutdown)."""
         kind = msg[0]
+        if session_monitor.ENABLED:
+            # One physical connection serves worker.dispatch tags and — for
+            # client-mode drivers (misc_handler installed) — driver.misc ones.
+            session_monitor.check_tag(
+                ("worker.dispatch", "driver.misc") if self.misc_handler
+                else "worker.dispatch", kind,
+            )
         if kind == "exec":
             self.task_queue.put(msg[1])
             if self.prefetch_hook is not None:
@@ -192,6 +203,8 @@ class WorkerConnection:
             object_transfer.deliver_locations(msg[1], msg[2])
         elif kind == "resp":
             _, req_id, ok, payload = msg
+            if session_monitor.ENABLED:
+                session_monitor.resolve("resp", req_id)
             with self._req_lock:
                 q = self._pending.pop(req_id, None)
             if q is not None:

@@ -89,18 +89,16 @@ class Package:
         return self.functions.get(ref)
 
 
-def load_package(root: str, package_name: Optional[str] = None,
-                 exclude: Sequence[str] = ("devtools",)) -> Package:
-    """Parse every .py under `root` (a package directory or a single file).
-    Module names are dotted paths rooted at `package_name` (defaults to the
-    directory's basename). `exclude` prunes top-level subpackage names."""
-    pkg = Package()
-    if os.path.isfile(root):
-        name = os.path.splitext(os.path.basename(root))[0]
-        with open(root, "r", encoding="utf-8") as fh:
-            pkg.add_module(name, root, fh.read())
-        return pkg
-    base = package_name or os.path.basename(os.path.normpath(root))
+# One parsed Package per (root, name, excludes) per process, revalidated by
+# a cheap per-file (mtime_ns, size) signature walk. Every rt-lint pass, every
+# rt-verify pass, and every test that loads the live tree shares ONE parse
+# (parsing ~250 files costs ~1s; the suite used to pay it per run_all call
+# inside tier-1). Passes treat Package as read-only by contract.
+_pkg_cache: dict = {}
+
+
+def _tree_signature(root: str, exclude: Sequence[str]) -> tuple:
+    sig = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(
             d for d in dirnames
@@ -108,20 +106,50 @@ def load_package(root: str, package_name: Optional[str] = None,
             and not (os.path.relpath(dirpath, root) == "." and d in exclude)
         )
         for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            fpath = os.path.join(dirpath, fname)
-            rel = os.path.relpath(fpath, root)
-            parts = rel[:-3].split(os.sep)
-            if parts[-1] == "__init__":
-                parts = parts[:-1]
-            module = ".".join([base, *parts]) if parts else base
-            try:
-                with open(fpath, "r", encoding="utf-8") as fh:
-                    pkg.add_module(module, fpath, fh.read())
-            except SyntaxError:
-                # A file the runtime can't import either; not lint's problem.
-                continue
+            if fname.endswith(".py"):
+                fpath = os.path.join(dirpath, fname)
+                try:
+                    st = os.stat(fpath)
+                except OSError:
+                    continue
+                sig.append((fpath, st.st_mtime_ns, st.st_size))
+    return tuple(sig)
+
+
+def load_package(root: str, package_name: Optional[str] = None,
+                 exclude: Sequence[str] = ("devtools",)) -> Package:
+    """Parse every .py under `root` (a package directory or a single file).
+    Module names are dotted paths rooted at `package_name` (defaults to the
+    directory's basename). `exclude` prunes top-level subpackage names.
+    Results are cached per process and revalidated by file stat signature;
+    callers must treat the returned Package as read-only."""
+    pkg = Package()
+    if os.path.isfile(root):
+        name = os.path.splitext(os.path.basename(root))[0]
+        with open(root, "r", encoding="utf-8") as fh:
+            pkg.add_module(name, root, fh.read())
+        return pkg
+    cache_key = (os.path.abspath(root), package_name, tuple(exclude))
+    sig = _tree_signature(root, exclude)
+    cached = _pkg_cache.get(cache_key)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    base = package_name or os.path.basename(os.path.normpath(root))
+    for fpath, _mtime, _size in sig:
+        rel = os.path.relpath(fpath, root)
+        parts = rel[:-3].split(os.sep)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        module = ".".join([base, *parts]) if parts else base
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                pkg.add_module(module, fpath, fh.read())
+        except SyntaxError:
+            # A file the runtime can't import either; not lint's problem.
+            continue
+        except OSError:
+            continue  # vanished between the signature walk and the read
+    _pkg_cache[cache_key] = (sig, pkg)
     return pkg
 
 

@@ -1,0 +1,98 @@
+"""rt-verify CLI.
+
+Usage::
+
+    python -m ray_tpu.devtools.verify [package_dir]
+        [--passes session,lockorder,native,stale] [--allowlist FILE] [-q]
+        [--fuzz N] [--fuzz-seed S] [--corpus DIR]
+
+Default: the four static passes over the shipped package (allowlisted).
+``--fuzz N`` additionally runs N structure-aware mutation cases per codec
+against both wire decoders (corpus replay first; crashers persisted under
+<corpus>/crashers/ and named in the failure).
+
+Exit status: 0 clean, 1 violations / allowlist errors / fuzz failure,
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict
+
+from ray_tpu.devtools.verify import DEFAULT_ALLOWLIST, PASS_NAMES, run_all
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.verify", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("package", nargs="?", default=None)
+    parser.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
+    parser.add_argument("--passes", default=None,
+                        help="comma-separated subset of: " + ",".join(PASS_NAMES)
+                             + " (or 'none' to skip statics, e.g. with --fuzz)")
+    parser.add_argument("--fuzz", type=int, default=0, metavar="N",
+                        help="also fuzz both wire codecs with N cases each")
+    parser.add_argument("--fuzz-seed", type=int, default=20260804)
+    parser.add_argument("--corpus", default=None,
+                        help="fuzz corpus dir (default tools/fuzz_corpus)")
+    parser.add_argument("-q", "--quiet", action="store_true")
+    ns = parser.parse_args(argv)
+
+    package_dir = ns.package or os.path.dirname(os.path.dirname(_HERE))
+    passes = ns.passes.split(",") if ns.passes else None
+    if ns.passes == "none":
+        passes = []  # fuzz-only / explicit no-op: don't re-run the statics
+    elif passes:
+        unknown = [p for p in passes if p not in PASS_NAMES]
+        if unknown:
+            print(f"rt-verify: unknown pass(es): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    if passes == []:
+        # Fuzz-only mode: no static passes, and no allowlist application
+        # (every entry would spuriously count as stale against zero
+        # violations).
+        violations, errors = [], []
+    else:
+        violations, errors = run_all(package_dir, passes=passes,
+                                     allowlist_path=ns.allowlist)
+    if not ns.quiet:
+        for v in violations:
+            print(v.render())
+        for e in errors:
+            print(f"ALLOWLIST ERROR: {e}")
+    by_pass: Dict[str, int] = {}
+    for v in violations:
+        by_pass[v.pass_id] = by_pass.get(v.pass_id, 0) + 1
+    detail = ", ".join(f"{k}={c}" for k, c in sorted(by_pass.items()))
+    status = "FAILED" if (violations or errors) else "OK"
+    print(f"rt-verify {status}: {len(violations)} violation(s)"
+          + (f" ({detail})" if detail else "")
+          + (f", {len(errors)} allowlist error(s)" if errors else ""))
+    rc = 1 if (violations or errors) else 0
+
+    if ns.fuzz > 0:
+        from ray_tpu.devtools.verify import fuzz_wire
+
+        try:
+            fuzz_wire.run_fuzz(
+                rounds=ns.fuzz, seed=ns.fuzz_seed,
+                corpus_dir=ns.corpus or fuzz_wire.DEFAULT_CORPUS,
+                quiet=ns.quiet,
+            )
+        except fuzz_wire.FuzzFailure as e:
+            print(f"rt-verify FUZZ FAILED: {e}")
+            return 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -575,6 +575,350 @@ def test_grammar_is_a_pure_literal():
         assert 1 <= lo <= hi, tag
 
 
+# ============================================================== rt-verify
+# The system-level passes (ray_tpu.devtools.verify): session machine,
+# lock-order cycles, native C checks, stale binaries. Same two-layer
+# structure as rt-lint: pinned fixtures + the live tree must verify clean.
+
+FIX_SESSION_GRAMMAR = {
+    "ping": {"dir": "worker->head", "arity": (2, 2), "readers": ("d",)},
+    "pong": {"dir": "head->worker", "arity": (2, 2), "readers": ("w",)},
+    "reply": {"dir": "worker->head", "arity": (2, 2), "readers": ("d",)},
+}
+FIX_SESSION_SPEC = {
+    "module_roles": {"fix.py": ("worker",)},
+    "pairs": {"ping": {"reply": "pong", "token_elem": 1}},
+    "streams": {},
+}
+
+
+def run_session(src: str, spec=None, grammar=None):
+    from ray_tpu.devtools.verify import pass_session
+
+    pkg = make_pkg(fix=src)
+    return pass_session.run(
+        pkg, grammar=grammar or FIX_SESSION_GRAMMAR,
+        spec=spec or FIX_SESSION_SPEC, sender_modules=("fix",),
+    )
+
+
+def test_session_good_fixture_is_clean():
+    violations = run_session(
+        """
+        class Conn:
+            def emit(self):
+                self.out.send(("ping", 1))
+                self.out.send(("reply", 2))
+        """
+    )
+    assert violations == []
+
+
+def test_session_role_violation_flagged():
+    # fix.py speaks "worker"; "pong" is head->worker, so sending it here is
+    # a role violation — the dir field is enforced, not documentation.
+    violations = run_session(
+        """
+        class Conn:
+            def emit(self):
+                self.out.send(("pong", 1))
+        """
+    )
+    assert len(violations) == 1
+    assert "role" in violations[0].key and "pong" in violations[0].message
+
+
+def test_session_unmapped_module_flagged():
+    violations = run_session(
+        """
+        class Conn:
+            def emit(self):
+                self.out.send(("ping", 1))
+        """,
+        spec={"module_roles": {}, "pairs": {}, "streams": {}},
+    )
+    assert any("module-unmapped" in v.key for v in violations)
+
+
+def test_session_spec_coherence_checks():
+    # Pair naming an unknown tag + reply that does not reverse direction.
+    violations = run_session(
+        """
+        class Conn:
+            def emit(self):
+                self.out.send(("ping", 1))
+                self.out.send(("reply", 2))
+        """,
+        spec={
+            "module_roles": {"fix.py": ("worker",)},
+            "pairs": {
+                "ping": {"reply": "ghost", "token_elem": 1},
+                "reply": {"reply": "ping", "token_elem": 1},  # w->h -> w->h
+            },
+            "streams": {},
+        },
+    )
+    keys = sorted(v.key for v in violations)
+    assert any("spec-unknown" in k for k in keys)
+    assert any("direction" in k for k in keys)
+
+
+def test_session_stream_coverage():
+    grammar = dict(FIX_SESSION_GRAMMAR)
+    grammar["xfer_begin"] = {"dir": "any", "arity": (2, 2), "readers": ("d",)}
+    grammar["xfer_stray"] = {"dir": "any", "arity": (2, 2), "readers": ("d",)}
+    violations = run_session(
+        """
+        class Conn:
+            def emit(self):
+                self.out.send(("ping", 1))
+                self.out.send(("reply", 2))
+        """,
+        grammar=grammar,
+        spec={
+            "module_roles": {"fix.py": ("worker",)},
+            "pairs": {},
+            "streams": {"xfer": {"open": "xfer_begin", "data": (),
+                                 "close": (), "key_elem": 1}},
+        },
+    )
+    assert any("stream-coverage" in v.key and "xfer_stray" in v.message
+               for v in violations)
+
+
+def test_lockorder_cycle_and_self_cycle_detected():
+    from ray_tpu.devtools.verify import pass_lockorder
+
+    pkg = make_pkg(fix="""
+        import threading
+
+        class A:
+            def __init__(self, b: "B"):
+                self._lock = threading.Lock()
+                self.b = b
+            def one(self):
+                with self._lock:
+                    self.b.poke()
+        class B:
+            def __init__(self, a: "A"):
+                self._lock = threading.Lock()
+                self.a = a
+            def poke(self):
+                with self._lock:
+                    pass
+            def two(self):
+                with self._lock:
+                    self.a.one()
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def outer(self):
+                with self._lock:
+                    self.helper()
+            def helper(self):
+                with self._lock:
+                    pass
+        """)
+    violations = pass_lockorder.run(pkg)
+    keys = sorted(v.key for v in violations)
+    assert any("cycle=A._lock>B._lock" in k for k in keys), keys
+    assert any("self-cycle=C._lock" in k for k in keys), keys
+
+
+def test_lockorder_clean_fixture_and_nested_def_excluded():
+    from ray_tpu.devtools.verify import pass_lockorder
+
+    pkg = make_pkg(fix="""
+        import threading
+
+        class A:
+            def __init__(self, b: "B"):
+                self._lock = threading.Lock()
+                self.b = b
+            def one(self):
+                with self._lock:
+                    pass
+                self.b.poke()          # outside the lock: no edge
+            def deferred(self):
+                with self._lock:
+                    def cb():
+                        self.b.poke()  # runs later, elsewhere: no edge
+                    register(cb)
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def poke(self):
+                with self._lock:
+                    pass
+            def two(self):
+                with self._lock:
+                    pass
+        """)
+    assert pass_lockorder.run(pkg) == []
+
+
+def test_lockorder_guard_decorator_counts_as_held():
+    from ray_tpu.devtools.verify import pass_lockorder
+
+    pkg = make_pkg(fix="""
+        import threading
+        from ray_tpu._private.concurrency import lock_guarded
+
+        class A:
+            def __init__(self, b: "B"):
+                self._lock = threading.Lock()
+                self.b = b
+            @lock_guarded("_lock")
+            def flush_locked(self):
+                self.b.poke()
+        class B:
+            def __init__(self, a: "A"):
+                self._lock = threading.Lock()
+                self.a = a
+            def poke(self):
+                with self._lock:
+                    pass
+            def two(self):
+                with self._lock:
+                    self.a.flush_locked()
+        """)
+    violations = pass_lockorder.run(pkg)
+    assert any("cycle" in v.key for v in violations)
+
+
+NATIVE_BAD_FIXTURE = r"""
+static PyObject *leaky(void) {
+    PyObject *a = PyList_New(2);
+    if (!a) return NULL;
+    if (bad_thing()) {
+        return NULL;   /* leaks a */
+    }
+    return a;
+}
+static int unchecked_alloc(void) {
+    char *m = (char *)PyMem_Malloc(64);
+    m[0] = 'x';
+    return 0;
+}
+static void unchecked_copy(char *dst, const char *src, unsigned n) {
+    memcpy(dst, src, n);
+}
+"""
+
+NATIVE_GOOD_FIXTURE = r"""
+static PyObject *clean(void) {
+    PyObject *a = PyList_New(2);
+    if (!a) return NULL;
+    if (bad_thing()) {
+        Py_DECREF(a);
+        return NULL;
+    }
+    return a;
+}
+static int checked_alloc(void) {
+    char *m = (char *)PyMem_Malloc(64);
+    if (!m) return -1;
+    m[0] = 'x';
+    return 0;
+}
+static void checked_copy(char *dst, const char *src, unsigned n) {
+    if (n > 64) return;
+    memcpy(dst, src, n);
+}
+"""
+
+
+def test_native_pass_bad_fixture_flags_all_kinds():
+    from ray_tpu.devtools.verify import pass_native
+
+    violations = pass_native.run(sources={"fix.c": NATIVE_BAD_FIXTURE})
+    keys = sorted(v.key for v in violations)
+    assert any("leak=a" in k for k in keys), keys
+    assert any("alloc=m:unchecked" in k for k in keys), keys
+    assert any("len=n:memcpy" in k for k in keys), keys
+
+
+def test_native_pass_good_fixture_is_clean():
+    from ray_tpu.devtools.verify import pass_native
+
+    assert pass_native.run(sources={"fix.c": NATIVE_GOOD_FIXTURE}) == []
+
+
+def test_stale_binary_guard(tmp_path):
+    from ray_tpu.devtools.verify import stale
+
+    src = tmp_path / "wire_native.c"
+    so = tmp_path / "wire_native.so"
+    src.write_bytes(b"int x;\n")
+    import hashlib
+
+    good = hashlib.sha256(b"int x;\n").hexdigest()
+    # Matching stamp: clean.
+    so.write_bytes(b"\x7fELF" + b"RAY_TPU_WIRE_SRC_SHA256=" + good.encode() + b"\x00")
+    assert stale.run(native_dir=str(tmp_path)) == []
+    # Source drifts: violation.
+    src.write_bytes(b"int y;\n")
+    violations = stale.run(native_dir=str(tmp_path))
+    assert len(violations) == 1 and "drift" in violations[0].key
+    # Unstamped binary: violation.
+    so.write_bytes(b"\x7fELF no stamp\x00")
+    violations = stale.run(native_dir=str(tmp_path))
+    assert len(violations) == 1 and "unstamped" in violations[0].key
+    # Missing binary: not a violation (built on demand).
+    so.unlink()
+    assert stale.run(native_dir=str(tmp_path)) == []
+
+
+def test_checked_in_binaries_match_their_sources():
+    # The live stale check: the committed .so files embed the sha256 of the
+    # exact sources they were built from.
+    from ray_tpu.devtools.verify import stale
+
+    violations = stale.run()
+    assert violations == [], "\n".join(v.render() for v in violations)
+
+
+def test_verify_live_tree_is_clean_under_shipped_allowlist():
+    from ray_tpu.devtools import verify
+
+    violations, errors = verify.run_all(
+        PACKAGE_DIR, allowlist_path=verify.DEFAULT_ALLOWLIST,
+    )
+    msg = "\n".join(v.render() for v in violations) + "\n".join(errors)
+    assert not violations and not errors, f"rt-verify regressions:\n{msg}"
+
+
+def test_verify_cli_exits_zero_on_live_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.verify", PACKAGE_DIR, "-q"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_session_spec_is_a_pure_literal():
+    # Like MESSAGE_GRAMMAR: the session spec must stay literal_eval-able or
+    # the static pass silently loses its input.
+    from ray_tpu.devtools.astutil import load_package
+    from ray_tpu.devtools.verify import pass_session
+
+    pkg = load_package(PACKAGE_DIR, package_name="ray_tpu")
+    spec = pass_session._literal_from_source(pkg, ("SESSION_SPEC",)).get(
+        "SESSION_SPEC")
+    assert isinstance(spec, dict)
+    assert spec["pairs"] and spec["streams"] and spec["module_roles"]
+
+
+def test_parsed_ast_cache_shared_across_passes():
+    # Satellite: one parse per file per process. Two loads of the live tree
+    # return the IDENTICAL Package object (stat-signature validated).
+    from ray_tpu.devtools.astutil import load_package
+
+    p1 = load_package(PACKAGE_DIR, package_name="ray_tpu")
+    p2 = load_package(PACKAGE_DIR, package_name="ray_tpu")
+    assert p1 is p2
+
+
 # ------------------------------------------------------------ runtime guards
 _GUARD_SNIPPET = """
 import threading

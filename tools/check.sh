@@ -7,6 +7,10 @@ cd "$(dirname "$0")/.."
 echo "== rt-lint (ray_tpu.devtools) =="
 python -m ray_tpu.devtools.lint ray_tpu
 
+echo
+echo "== rt-verify (session machine + lock order + native C + stale binaries) =="
+python -m ray_tpu.devtools.verify ray_tpu
+
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
@@ -14,6 +18,14 @@ fi
 echo
 echo "== native wire-codec parity fuzz (from-source build + C/py byte parity) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/native_parity_fuzz.py
+
+echo
+echo "== wire decoder fuzz (structure-aware mutations, corpus replay, >=10k/codec) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ray_tpu.devtools.verify ray_tpu --passes none --fuzz 12000
+
+echo
+echo "== sanitizer replay (ASan/UBSan rebuild + fuzz corpus + arena stress) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/sanitize_native.py
 
 echo
 echo "== chaos smoke (seeded failpoint schedule) =="

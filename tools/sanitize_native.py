@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Sanitizer stage: ASan/UBSan rebuild of both native extensions + replay.
+
+What the normal fuzz stage cannot see, the sanitizers can: a heap overflow
+that happens to land in writable memory, a use-after-free the allocator
+hasn't recycled yet, signed-overflow UB the current compiler folds
+benignly. This stage:
+
+  1. probes the toolchain (g++ with -fsanitize=address,undefined AND a
+     resolvable libasan for LD_PRELOAD) — absent toolchain is a LOUD SKIP,
+     exit 0, so check.sh stays green on minimal hosts;
+  2. rebuilds `wire_native.c` with ASan+UBSan (halt_on_error) into a temp
+     dir and, in a subprocess with libasan preloaded, replays the whole
+     fuzz corpus (tools/fuzz_corpus/{seeds,interesting,crashers}) plus
+     seeded structure-aware mutation rounds through the sanitized decoder
+     (devtools.verify.fuzz_wire with the sanitized module injected);
+  3. rebuilds `shm_arena.cpp` + its stress harness (`arena_stress.cpp`)
+     with ASan+UBSan and runs the multi-threaded alloc/verify/free stress.
+
+Any sanitizer report aborts the subprocess (halt_on_error=1) and fails the
+stage. Usage: python tools/sanitize_native.py [--rounds N] [--seed S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import sysconfig
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "ray_tpu", "_native")
+SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+             "-O1", "-g", "-fno-omit-frame-pointer"]
+
+
+def _run(cmd, **kw):
+    return subprocess.run(cmd, capture_output=True, text=True, **kw)
+
+
+def probe_toolchain():
+    """(libasan_path, None) when sanitizers are usable, else (None, reason)."""
+    with tempfile.TemporaryDirectory() as td:
+        probe = os.path.join(td, "p.c")
+        with open(probe, "w") as fh:
+            fh.write("int main(void){return 0;}\n")
+        out = os.path.join(td, "p")
+        try:
+            r = _run(["g++", *SAN_FLAGS, "-o", out, probe], timeout=60)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return None, f"g++ unavailable ({e})"
+        if r.returncode != 0:
+            return None, f"g++ lacks -fsanitize support: {r.stderr.strip()[:200]}"
+        try:
+            r = _run([out], timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return None, f"sanitized binary does not run ({e})"
+        if r.returncode != 0:
+            return None, "sanitized probe binary failed to run"
+    r = _run(["g++", "-print-file-name=libasan.so"])
+    libasan = r.stdout.strip()
+    if r.returncode != 0 or not os.path.sep in libasan or not os.path.exists(libasan):
+        return None, f"libasan.so not resolvable ({libasan!r})"
+    return libasan, None
+
+
+def build_wire_asan(tmpdir: str):
+    include = sysconfig.get_paths().get("include")
+    if not include or not os.path.exists(os.path.join(include, "Python.h")):
+        return None, "Python.h not available"
+    out = os.path.join(tmpdir, "wire_native_asan.so")
+    cmd = ["g++", *SAN_FLAGS, "-shared", "-fPIC", "-I", include,
+           '-DWIRE_SRC_SHA256="asan"',
+           "-o", out, os.path.join(NATIVE, "wire_native.c")]
+    r = _run(cmd, timeout=180)
+    if r.returncode != 0:
+        return None, f"wire ASan build failed:\n{r.stderr[:800]}"
+    return out, None
+
+
+_REPLAY_SNIPPET = """
+import sys
+import importlib.machinery, importlib.util
+so, rounds, seed = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+loader = importlib.machinery.ExtensionFileLoader("wire_native", so)
+spec = importlib.util.spec_from_file_location("wire_native", so, loader=loader)
+mod = importlib.util.module_from_spec(spec)
+loader.exec_module(mod)
+from ray_tpu.devtools.verify import fuzz_wire
+stats = fuzz_wire.run_fuzz(rounds=rounds, seed=seed, native_module=mod,
+                           persist=False, quiet=True)
+print(f"SANITIZED-REPLAY-OK cases={stats.cases}")
+"""
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--rounds", type=int, default=6000)
+    parser.add_argument("--seed", type=int, default=20260804)
+    parser.add_argument("--stress-iters", type=int, default=150)
+    ns = parser.parse_args()
+
+    libasan, reason = probe_toolchain()
+    if libasan is None:
+        print(f"SANITIZER STAGE SKIPPED (no usable toolchain): {reason}")
+        print("-> install g++ with libasan/libubsan to enable this stage")
+        return 0
+
+    env = dict(
+        os.environ,
+        LD_PRELOAD=libasan,
+        ASAN_OPTIONS="detect_leaks=0:halt_on_error=1:abort_on_error=1",
+        UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1",
+        JAX_PLATFORMS="cpu",
+    )
+
+    with tempfile.TemporaryDirectory() as td:
+        # --- wire codec under ASan/UBSan ---------------------------------
+        so, err = build_wire_asan(td)
+        if so is None:
+            print(f"SANITIZER STAGE SKIPPED: {err}")
+            return 0
+        r = _run(
+            [sys.executable, "-c", _REPLAY_SNIPPET, so,
+             str(ns.rounds), str(ns.seed)],
+            env=env, cwd=REPO, timeout=600,
+        )
+        if r.returncode != 0 or "SANITIZED-REPLAY-OK" not in r.stdout:
+            print("SANITIZER FAILURE (wire_native under ASan/UBSan):")
+            print(r.stdout[-2000:])
+            print(r.stderr[-4000:])
+            return 1
+        print(f"wire_native ASan/UBSan replay: {r.stdout.strip().splitlines()[-1]}")
+
+        # --- shm arena stress under ASan/UBSan ---------------------------
+        stress = os.path.join(td, "arena_stress_asan")
+        r = _run(
+            ["g++", *SAN_FLAGS, "-std=c++17", "-pthread",
+             '-DARENA_SRC_SHA256="asan"',
+             os.path.join(NATIVE, "arena_stress.cpp"),
+             os.path.join(NATIVE, "shm_arena.cpp"),
+             "-o", stress],
+            timeout=180,
+        )
+        if r.returncode != 0:
+            # The toolchain is PROVEN by this point (probe + wire build
+            # succeeded): a compile failure here means the checked-in C++
+            # is broken, and must fail the stage, not skip it.
+            print(f"SANITIZER FAILURE (arena stress build failed):\n{r.stderr[:800]}")
+            return 1
+        arena_path = os.path.join(td, "arena_asan")
+        r = _run([stress, arena_path, str(ns.stress_iters)], env=env,
+                 timeout=300)
+        if r.returncode != 0:
+            print("SANITIZER FAILURE (shm_arena stress under ASan/UBSan):")
+            print(r.stdout[-2000:])
+            print(r.stderr[-4000:])
+            return 1
+        print(f"shm_arena ASan/UBSan stress: {r.stdout.strip()}")
+    print("sanitizer stage OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
