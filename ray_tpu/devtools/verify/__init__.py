@@ -58,6 +58,11 @@ from typing import Dict, List, Optional, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_ALLOWLIST = os.path.join(_HERE, "verify_allowlist.txt")
+# The shipped package's native dir — the fallback when run_all is given no
+# package_dir-derived location (single definition; pass_native and stale
+# import it from here).
+DEFAULT_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)),
+                                  "_native")
 
 
 def run_all(package_dir: str, passes: Optional[List[str]] = None,
@@ -72,6 +77,12 @@ def run_all(package_dir: str, passes: Optional[List[str]] = None,
     from ray_tpu.devtools.verify import (
         pass_lockorder, pass_native, pass_session, stale,
     )
+
+    if native_dir is None:
+        # Verify the TARGET tree's native sources/binaries, not whichever
+        # installation this module was imported from.
+        cand = os.path.join(package_dir, "_native")
+        native_dir = cand if os.path.isdir(cand) else DEFAULT_NATIVE_DIR
 
     table: Dict[str, object] = {
         "session": pass_session.run,

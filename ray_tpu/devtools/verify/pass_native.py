@@ -48,10 +48,7 @@ _STEAL_FNS = ("PyTuple_SET_ITEM", "PyList_SET_ITEM", "PyModule_AddObject")
 # Checker helpers whose call constitutes a bounds validation of an operand.
 _BOUND_CHECK_FNS = ("r_need", "w_reserve", "w_u32", "r_u32")
 
-DEFAULT_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "_native",
-)
+from ray_tpu.devtools.verify import DEFAULT_NATIVE_DIR  # noqa: E402
 
 
 def strip_comments_and_strings(src: str) -> str:

@@ -26,11 +26,7 @@ from ray_tpu._native import (
     ARENA_HASH_MARKER, WIRE_HASH_MARKER, embedded_source_hash, source_sha256,
 )
 from ray_tpu.devtools.astutil import Violation, make_key
-
-DEFAULT_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "_native",
-)
+from ray_tpu.devtools.verify import DEFAULT_NATIVE_DIR
 
 # binary -> (source, embedded marker prefix).
 BINARIES: Dict[str, Tuple[str, bytes]] = {
