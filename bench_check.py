@@ -63,13 +63,31 @@ REQUIRED_METRICS_DATAPLANE = (
     "transfer_speedup_10MB",
 )
 
+# Serve ingress suite (bench_serve.py -> BENCH_SERVE.json): the front
+# door's acceptance contract — sustained open-loop RPS with the latency
+# distribution, shed-not-collapse at 2x saturation, multi-proxy scaling.
+REQUIRED_METRICS_SERVE = (
+    "serve_capacity_rps",
+    "serve_sustained_rps",
+    "serve_p50_ms",
+    "serve_p95_ms",
+    "serve_p99_ms",
+    "serve_saturation_goodput_ratio",
+    "serve_shed_latency_ms",
+    "serve_p99_admitted_ms",
+    "serve_2proxy_aggregate_rps",
+    "serve_proxy_scaling_ratio",
+)
+
 # Which REQUIRED set applies is decided by what the BASELINE contains
 # (--baseline invites arbitrary copied/renamed paths, so a filename key
 # would silently drop the data-plane contract): a baseline carrying any
-# data-plane metric is held to the data-plane REQUIRED set.
+# data-plane/serve metric is held to that suite's REQUIRED set.
 def required_for(baseline_metrics: Dict[str, float]) -> tuple:
     if any(m in baseline_metrics for m in REQUIRED_METRICS_DATAPLANE):
         return REQUIRED_METRICS_DATAPLANE
+    if any(m in baseline_metrics for m in REQUIRED_METRICS_SERVE):
+        return REQUIRED_METRICS_SERVE
     return REQUIRED_METRICS
 
 # Absolute floors, enforced regardless of the baseline's value: trajectory
@@ -78,12 +96,25 @@ def required_for(baseline_metrics: Dict[str, float]) -> tuple:
 # cross-node 10MB get, per the data-plane acceptance criterion).
 HARD_FLOORS = {
     "transfer_speedup_10MB": 3.0,
+    # Shed-not-collapse: at 2x offered load, goodput must hold >= 80% of
+    # single-proxy capacity (admission control converts overload into fast
+    # 503s, never latency collapse).
+    "serve_saturation_goodput_ratio": 0.8,
+    # Ingress must scale with proxies: 2-proxy aggregate >= 1.5x single.
+    "serve_proxy_scaling_ratio": 1.5,
 }
 
 # Metrics where SMALLER is better (seconds of recovery, not ops/s): the
 # regression test inverts — a value above baseline by more than the
 # threshold fails, a drop is an improvement.
-LOWER_IS_BETTER = frozenset({"worker_kill_recovery_s"})
+LOWER_IS_BETTER = frozenset({
+    "worker_kill_recovery_s",
+    "serve_p50_ms",
+    "serve_p95_ms",
+    "serve_p99_ms",
+    "serve_shed_latency_ms",
+    "serve_p99_admitted_ms",
+})
 
 
 def load_metrics(path: str) -> Dict[str, float]:

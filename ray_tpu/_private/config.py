@@ -213,6 +213,39 @@ class Config:
     # Bounded dead-replica resubmits per Serve request (was hard-coded 1).
     serve_resubmit_attempts: int = 2
 
+    # --- Serve ingress tier (admission control / shedding / drain / SLO) ---
+    # Per-app admitted-but-unfinished request cap at EACH HTTP proxy; above
+    # it the proxy sheds with a fast `503 + Retry-After` instead of queueing
+    # toward collapse (reference: max_queued_requests on the proxy router).
+    # A deployment's `max_queued_requests` option overrides per app; 0 here
+    # disables proxy admission control entirely.
+    serve_queue_cap_default: int = 256
+    # Router-side overload guard: when EVERY live replica's in-flight load
+    # reaches max_concurrent_queries * this factor, route() sheds instead of
+    # queueing deeper (reason="replica_inflight"). 0 disables (default: the
+    # handle API keeps its unbounded-queue semantics; HTTP ingress is capped
+    # by the proxy's per-app admission control above).
+    serve_replica_inflight_cap_factor: float = 0.0
+    # Bounded per-proxy forwarding pipeline: at most this many requests per
+    # proxy hop to replicas concurrently (the uvicorn-worker / envoy
+    # max_concurrent analogue). Requests over the bound wait as parked
+    # coroutines (cheap) until a slot frees — the per-app queue cap above
+    # sheds the true excess. Keeps the proxy event loop responsive under
+    # saturation (sheds stay FAST) and makes single-proxy capacity a
+    # per-proxy resource, so adding proxies adds ingress throughput.
+    # 0 = auto: 4 x cpu count, floor 4.
+    serve_proxy_max_concurrent: int = 0
+    # Retry-After seconds returned with shed 503s (clients use it to back
+    # off; the bench's open-loop generator ignores it on purpose).
+    serve_retry_after_s: float = 1.0
+    # Graceful-drain ceiling: a stopping replica/proxy gets this long to
+    # finish its in-flight window after the routing table stops sending it
+    # new work; whatever still runs at the deadline is killed with the actor.
+    serve_drain_timeout_s: float = 30.0
+    # Sliding window over which routers compute the route-wait p95 they
+    # report to the controller (the SLO-aware autoscaling signal).
+    serve_slo_window_s: float = 30.0
+
     # --- task events / tracing (reference: task_event_buffer.h, gcs_task_manager.h) ---
     # Ring-buffer capacity of the GCS task-event store; oldest events drop
     # first. Doubles as state.summarize()'s listing budget (its task/object

@@ -271,6 +271,38 @@ MESSAGE_GRAMMAR = {
         "readers": ("daemon.dispatch", "driver.misc"),
         "doc": "(path[, arena_offset]) — free a sealed segment at its owner",
     },
+    # ---- Serve ingress tier (proxy service directory + graceful drain) ----
+    "serve_proxy_up": {
+        "dir": "worker->head", "arity": (2, 2),
+        "readers": ("scheduler.worker",),
+        "doc": "({proxy_id, node_id, port, pid},) — a Serve HTTP proxy bound "
+               "its listener: register it in the head's service directory so "
+               "ingress endpoints are discoverable cluster-wide (the "
+               "reference's per-node HTTPProxy set in http_state.py)",
+    },
+    "serve_proxy_down": {
+        "dir": "worker->head", "arity": (2, 2),
+        "readers": ("scheduler.worker",),
+        "doc": "(proxy_id,) — proxy withdrew from the service directory "
+               "(draining or stopping); clients should stop dialing it. "
+               "Worker death prunes the entry implicitly",
+    },
+    "serve_drain": {
+        "dir": "head->worker", "arity": (3, 3),
+        "readers": ("worker.dispatch",),
+        "doc": "(token, deadline_s) — begin graceful drain of the Serve "
+               "actor hosted by this worker (proxy or replica): it stops "
+               "ACCEPTING new work immediately (the flag is set by the "
+               "reader thread, in-band — an actor call could never overtake "
+               "the very requests being drained) and finishes its in-flight "
+               "window; replies serve_drained when idle or at the deadline",
+    },
+    "serve_drained": {
+        "dir": "worker->head", "arity": (4, 4),
+        "readers": ("scheduler.worker",),
+        "doc": "(token, ok, inflight) — drain finished (ok=True, idle) or "
+               "timed out with `inflight` requests still running",
+    },
     # ---- head -> daemon ---------------------------------------------------
     "spawn_worker": {
         "dir": "head->daemon", "arity": (2, 2),
@@ -368,6 +400,7 @@ SESSION_SPEC = {
         "profile_stop": {"reply": "profile_data", "token_elem": 1},
         "locate_object": {"reply": "object_locations", "token_elem": 1},
         "read_object": {"reply": "object_data", "token_elem": 1},
+        "serve_drain": {"reply": "serve_drained", "token_elem": 1},
     },
     "streams": {
         "transfer": {

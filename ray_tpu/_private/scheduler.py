@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import base64
 import concurrent.futures
+import itertools
 import multiprocessing
 import os
 import pickle
@@ -661,6 +662,17 @@ class Scheduler:
         self._leases: Dict[tuple, List[WorkerHandle]] = {}
         self._last_memory_check = 0.0
         self._last_hb_check = 0.0
+        # Serve ingress service directory: proxy_id -> {node_id, port, pid,
+        # worker_id} for every announced HTTP proxy (serve_proxy_up/down;
+        # pruned on worker death). The head answers *discovery* queries only —
+        # request bytes flow client -> proxy -> replica, never through here.
+        self._serve_proxies: Dict[str, dict] = {}
+        # Pending graceful drains: token -> (reply_to, deadline, target_hex).
+        # reply_to is ("conn", wh, req_id) or ("future", fut); resolved by the
+        # serve_drained reply, the target worker's death (drained by
+        # definition), or the deadline sweep.
+        self._serve_drains: Dict[int, tuple] = {}
+        self._serve_drain_tokens = itertools.count(1)
         # (when, rec) pairs re-queued after a delay (OOM retry backoff).
         self._delayed_retries: List[Tuple[float, TaskRecord]] = []
         # Pubsub plane (reference: src/ray/pubsub/publisher.h — long-poll
@@ -1227,6 +1239,7 @@ class Scheduler:
             # Self-gated by memory_monitor_refresh_ms (NOT the 0.5s health
             # gate — sub-500ms refresh settings must be honored).
             self._memory_monitor_tick(now)
+            self._sweep_serve_drains(now)
             # Telemetry snapshot: self-gated by internal_metrics_interval_s,
             # so a loop spinning per-message never pays per-iteration gauges.
             self.telemetry.on_iteration(self, now)
@@ -1711,6 +1724,7 @@ class Scheduler:
                 pass
         self._drop_holder_everywhere(wh.worker_id.hex())
         self._dead_holders.add(wh.worker_id.hex())
+        self._prune_serve_state_for_worker(wh.worker_id.hex())
         self._fail_tasks_of_dead_owner(wh.worker_id.hex())
         self._kill_actors_owned_by(wh.worker_id.hex())
         if wh.actor_id is not None:
@@ -2092,10 +2106,116 @@ class Scheduler:
             self._apply_ref_ops(msg[1], wh.worker_id.hex())
         elif kind == "locate_object":
             self._on_locate_object(wh, msg[1], msg[2])
+        elif kind == "serve_proxy_up":
+            self._serve_proxy_up(wh, msg[1])
+        elif kind == "serve_proxy_down":
+            self._serve_proxies.pop(msg[1], None)
+        elif kind == "serve_drained":
+            if session_monitor.ENABLED:
+                session_monitor.resolve("serve_drained", msg[1])
+            self._on_serve_drained(msg[1], msg[2], msg[3])
         elif kind == "stacks_data" or kind == "profile_data":
             if session_monitor.ENABLED:
                 session_monitor.resolve(kind, msg[1])
             self._on_introspect_reply(msg[1], msg[2])
+
+    # ------------------------------------------------------ serve ingress tier
+    def _serve_proxy_up(self, wh: WorkerHandle, info: dict) -> None:
+        """Service-directory registration for a Serve HTTP proxy: the head
+        records WHERE ingress listens (node, port, pid) so clients/dashboards
+        discover endpoints; it never relays request bytes."""
+        entry = dict(info)
+        entry["worker_id"] = wh.worker_id.hex()
+        proxy_id = entry.get("proxy_id") or wh.worker_id.hex()
+        entry["proxy_id"] = proxy_id
+        self._serve_proxies[proxy_id] = entry
+
+    def _cmd_serve_directory(self, _arg=None):
+        return [dict(v) for v in self._serve_proxies.values()]
+
+    def _cmd_serve_actor_inflight(self, actor_id_bytes: bytes):
+        """Submitted-but-unfinished call count for one actor — the precise
+        inflight window a graceful drain must let finish (the actor itself
+        cannot see calls still parked in its ordered queue)."""
+        ar = self.actors.get(ActorID(actor_id_bytes))
+        if ar is None:
+            return 0
+        return len(ar.inflight) + len(ar.backlog)
+
+    def _start_serve_drain(self, actor_id_bytes: bytes, timeout_s: float,
+                           reply_to: tuple) -> None:
+        ar = self.actors.get(ActorID(actor_id_bytes))
+        target = None
+        if ar is not None and ar.worker is not None:
+            target = self._workers_by_id.get(ar.worker.hex())
+        if target is None:
+            # Dead or never placed: drained by definition.
+            self._finish_serve_drain(reply_to, {"ok": True, "inflight": 0})
+            return
+        token = next(self._serve_drain_tokens)
+        self._serve_drains[token] = (
+            reply_to, time.time() + float(timeout_s) + 5.0,
+            target.worker_id.hex(),
+        )
+        if session_monitor.ENABLED:
+            session_monitor.expect("serve_drain", token)
+        self._send_to(target, ("serve_drain", token, float(timeout_s)))
+
+    def _finish_serve_drain(self, reply_to: tuple, result: dict) -> None:
+        if reply_to[0] == "conn":
+            self._respond(reply_to[1], reply_to[2], True, result)
+        elif not reply_to[1].done():
+            reply_to[1].set_result(result)
+
+    def _req_serve_drain_actor(self, wh, req_id: Optional[int], payload):
+        actor_id_bytes, timeout_s = payload
+        self._start_serve_drain(actor_id_bytes, timeout_s, ("conn", wh, req_id))
+
+    def _cmd_serve_drain_actor(self, payload):
+        # In-process driver form: (actor_id_bytes, timeout_s, inner_future).
+        actor_id_bytes, timeout_s, fut = payload
+        self._start_serve_drain(actor_id_bytes, timeout_s, ("future", fut))
+        return _ASYNC
+
+    def _on_serve_drained(self, token, ok, inflight) -> None:
+        entry = self._serve_drains.pop(token, None)
+        if entry is None:
+            return  # deadline sweep answered first; late reply tolerated
+        reply_to, _deadline, _target = entry
+        self._finish_serve_drain(
+            reply_to, {"ok": bool(ok), "inflight": int(inflight)}
+        )
+
+    def _sweep_serve_drains(self, now: float) -> None:
+        if not self._serve_drains:
+            return
+        for token, (reply_to, deadline, _target) in list(
+            self._serve_drains.items()
+        ):
+            if now >= deadline:
+                del self._serve_drains[token]
+                if session_monitor.ENABLED:
+                    session_monitor.forget("serve_drain", token)
+                self._finish_serve_drain(
+                    reply_to, {"ok": False, "inflight": -1}
+                )
+
+    def _prune_serve_state_for_worker(self, worker_id_hex: str) -> None:
+        """Worker death: its proxy directory entries vanish and any drain
+        targeting it completes — a dead actor's inflight window is over."""
+        for pid_, entry in list(self._serve_proxies.items()):
+            if entry.get("worker_id") == worker_id_hex:
+                del self._serve_proxies[pid_]
+        for token, (reply_to, _deadline, target) in list(
+            self._serve_drains.items()
+        ):
+            if target == worker_id_hex:
+                del self._serve_drains[token]
+                if session_monitor.ENABLED:
+                    session_monitor.forget("serve_drain", token)
+                self._finish_serve_drain(
+                    reply_to, {"ok": True, "inflight": 0}
+                )
 
     @any_thread
     def _respond(self, wh: WorkerHandle, req_id: Optional[int], ok: bool, payload):
@@ -3609,7 +3729,8 @@ class Scheduler:
             "free", "register_function", "remove_pg", "cancel", "task_events",
             "task_latency", "list_actors", "list_tasks", "list_objects",
             "get_nodes", "add_node", "remove_node", "autoscaler_state",
-            "memory_summary", "transfer_stats",
+            "memory_summary", "transfer_stats", "serve_directory",
+            "serve_actor_inflight",
         }
     )
 

@@ -455,5 +455,37 @@ def router_metrics() -> dict:
             "resubmits": Counter("ray_tpu_serve_resubmit_total",
                                  "requests resubmitted to another replica "
                                  "after a replica death", ("deployment",)),
+            "slo_p95": Gauge("ray_tpu_serve_route_wait_p95_s",
+                             "windowed route-wait p95 this router reports to "
+                             "the controller (the SLO autoscaling signal)",
+                             ("deployment",)),
         }
     return _router_metrics
+
+
+# ---------------------------------------------------------- serve ingress tier
+_ingress_metrics: Optional[dict] = None
+
+
+def serve_ingress_metrics() -> dict:
+    """Lazy Serve front-door metric set. ONE shared object set per process:
+    the proxy (app_queue/draining sheds) and the router (replica_inflight
+    sheds) both count into the same ray_tpu_serve_shed_total series."""
+    global _ingress_metrics
+    if _ingress_metrics is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        _ingress_metrics = {
+            "shed": Counter("ray_tpu_serve_shed_total",
+                            "requests shed by admission control, by app and "
+                            "reason (app_queue/replica_inflight/batch_queue/"
+                            "draining)", ("app", "reason")),
+            "proxy_requests": Counter("ray_tpu_serve_proxy_requests_total",
+                                      "HTTP requests admitted by this proxy",
+                                      ("app",)),
+            "proxy_queue_depth": Gauge("ray_tpu_serve_proxy_queue_depth",
+                                       "admitted-but-unfinished requests at "
+                                       "this proxy (per-app admission gauge)",
+                                       ("app",)),
+        }
+    return _ingress_metrics

@@ -516,6 +516,22 @@ class DriverContext:
     def nodes(self, payload=None):
         return self.scheduler.call("get_nodes", payload).result()
 
+    def serve_directory(self):
+        return self.scheduler.call("serve_directory", None).result()
+
+    def serve_actor_inflight(self, actor_id_bytes: bytes) -> int:
+        return self.scheduler.call("serve_actor_inflight", actor_id_bytes).result()
+
+    def serve_drain_actor(self, actor_id_bytes: bytes, timeout_s: float) -> dict:
+        inner: concurrent.futures.Future = concurrent.futures.Future()
+        self.scheduler.call(
+            "serve_drain_actor", (actor_id_bytes, timeout_s, inner)
+        ).result()
+        try:
+            return inner.result(timeout=timeout_s + 10.0)
+        except concurrent.futures.TimeoutError:
+            return {"ok": False, "inflight": -1}
+
     def dump_stacks(self, timeout_s=None):
         inner: concurrent.futures.Future = concurrent.futures.Future()
         self.scheduler.call("dump_stacks", (timeout_s, inner)).result()
@@ -759,6 +775,23 @@ class RemoteDriverContext:
     def nodes(self, payload=None):
         return self.wc.request("driver_cmd", ("get_nodes", payload))
 
+    def serve_directory(self):
+        return self.wc.request("driver_cmd", ("serve_directory", None))
+
+    def serve_actor_inflight(self, actor_id_bytes: bytes) -> int:
+        return self.wc.request(
+            "driver_cmd", ("serve_actor_inflight", actor_id_bytes)
+        )
+
+    def serve_drain_actor(self, actor_id_bytes: bytes, timeout_s: float) -> dict:
+        try:
+            return self.wc.request(
+                "serve_drain_actor", (actor_id_bytes, timeout_s),
+                timeout=timeout_s + 10.0,
+            )
+        except TimeoutError:
+            return {"ok": False, "inflight": -1}
+
     def dump_stacks(self, timeout_s=None):
         return self.wc.request(
             "dump_stacks", timeout_s, timeout=(timeout_s or 30.0) + 15.0
@@ -934,6 +967,23 @@ class WorkerProcContext:
 
     def nodes(self, payload=None):
         return self.rt.wc.request("driver_cmd", ("get_nodes", payload))
+
+    def serve_directory(self):
+        return self.rt.wc.request("driver_cmd", ("serve_directory", None))
+
+    def serve_actor_inflight(self, actor_id_bytes: bytes) -> int:
+        return self.rt.wc.request(
+            "driver_cmd", ("serve_actor_inflight", actor_id_bytes)
+        )
+
+    def serve_drain_actor(self, actor_id_bytes: bytes, timeout_s: float) -> dict:
+        try:
+            return self.rt.wc.request(
+                "serve_drain_actor", (actor_id_bytes, timeout_s),
+                timeout=timeout_s + 10.0,
+            )
+        except TimeoutError:
+            return {"ok": False, "inflight": -1}
 
     def dump_stacks(self, timeout_s=None):
         return self.rt.wc.request(

@@ -119,6 +119,9 @@ class WorkerConnection:
         # reader thread serves dump_stacks itself — it stays responsive while
         # the main thread runs user code, which is the whole point.
         self.introspect_fn = None
+        # Back-reference to this process's WorkerRuntime (set by main()): the
+        # serve_drain handler reaches the hosted actor instance through it.
+        self.runtime = None
         # Worker processes die with their control connection: once the head is
         # unreachable nothing can collect results, and a task stuck in user code
         # (e.g. a long sleep) would otherwise outlive its node daemon forever.
@@ -219,6 +222,8 @@ class WorkerConnection:
             from ray_tpu._private import profiler
 
             self.send(("profile_data", msg[1], profiler.stop()))
+        elif kind == "serve_drain":
+            self._begin_serve_drain(msg[1], msg[2])
         elif kind == "cancel_queued":
             with self._cancelled_lock:
                 self.cancelled[msg[1]] = None
@@ -230,6 +235,49 @@ class WorkerConnection:
         elif self.misc_handler is not None:
             self.misc_handler(msg)
         return True
+
+    def _begin_serve_drain(self, token, deadline_s) -> None:
+        """Graceful drain of the Serve actor hosted here, driven IN-BAND by
+        the reader thread: the stop-accepting flag must be set ahead of any
+        queued actor calls (an ordinary actor call would park behind the very
+        requests being drained on a max_concurrency=1 replica). The wait for
+        in-flight work happens on a side thread; the reader stays free."""
+        rt = self.runtime
+        inst = getattr(rt, "actor_instance", None) if rt is not None else None
+        begin = getattr(inst, "_serve_begin_drain", None)
+        gauge = getattr(inst, "_serve_inflight", None)
+        if begin is not None:
+            try:
+                begin()
+            except Exception:  # noqa: BLE001 — drain must still reply
+                pass
+        if gauge is None:
+            # Nothing drainable hosted here: idle by definition.
+            self.send(("serve_drained", token, True, 0))
+            return
+
+        def wait_drained():
+            deadline = time.monotonic() + float(deadline_s)
+            # Sample BEFORE the deadline loop: a zero/expired deadline must
+            # report the true in-flight count, never a phantom clean drain.
+            try:
+                left = int(gauge())
+            except Exception:  # noqa: BLE001 — treat as idle
+                left = 0
+            while left > 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+                try:
+                    left = int(gauge())
+                except Exception:  # noqa: BLE001 — treat as idle
+                    left = 0
+            try:
+                self.send(("serve_drained", token, left <= 0, max(0, left)))
+            except Exception:  # noqa: BLE001 — connection gone
+                pass
+
+        threading.Thread(
+            target=wait_drained, daemon=True, name="serve-drain"
+        ).start()
 
     def _introspect_payload(self):
         from ray_tpu._private import introspection
@@ -273,6 +321,37 @@ class WorkerConnection:
                 for q in self._pending.values():
                     q.put((False, ConnectionError("driver connection closed")))
                 self._pending.clear()
+
+
+def _serve_runtime():
+    """This process's WorkerRuntime, or None outside a worker process (unit
+    tests constructing serve actors in-proc have no control connection)."""
+    from ray_tpu._private import worker as worker_mod
+
+    return getattr(worker_mod.global_worker.context, "rt", None)
+
+
+def announce_serve_proxy(info: dict) -> bool:
+    """Register this worker's Serve HTTP proxy in the head's service
+    directory (the reference's per-node proxy set in http_state.py). The
+    node id is filled in here — the proxy actor doesn't know where the
+    controller placed it. Returns False outside a worker process."""
+    rt = _serve_runtime()
+    if rt is None:
+        return False
+    entry = dict(info)
+    entry.setdefault("node_id", rt.args.node_id_hex)
+    rt.wc.send(("serve_proxy_up", entry))
+    return True
+
+
+def withdraw_serve_proxy(proxy_id: str) -> bool:
+    """Remove a proxy from the head's service directory (drain/stop)."""
+    rt = _serve_runtime()
+    if rt is None:
+        return False
+    rt.wc.send(("serve_proxy_down", proxy_id))
+    return True
 
 
 # Cumulative log lines dropped by this process's _LogShipper overflow path:
@@ -855,6 +934,7 @@ def worker_loop(conn, args: WorkerArgs):
     wc = WorkerConnection(conn)
     wc.exit_on_eof = True
     rt = WorkerRuntime(args, wc)
+    wc.runtime = rt  # serve_drain reaches the hosted actor through this
 
     # Live introspection: in-band stack dumps served by the reader thread
     # (annotated with the task each thread is executing), plus the SIGUSR1

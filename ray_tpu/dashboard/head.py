@@ -20,17 +20,51 @@ class DashboardServer:
     # Every kind `/api/{kind}` serves; the 404 for anything else lists them.
     VALID_KINDS = (
         "actors", "cluster", "jobs", "memory", "nodes", "objects", "profile",
-        "stacks", "tasks", "timeline",
+        "serve", "stacks", "tasks", "timeline",
     )
     # Ceiling on `/api/profile?duration=` (the handler blocks an executor
     # thread for the duration).
     MAX_PROFILE_DURATION_S = 60.0
 
     # ------------------------------------------------------------- handlers
+    def _serve_payload(self, app: Optional[str] = None):
+        """Serve ingress view: apps/replicas with live queue depth, inflight
+        and shed counters (from the controller + its proxy fleet) plus the
+        head's proxy service directory. Unknown ?app= raises KeyError -> a
+        JSON 400 (the PR 5 error-shape convention)."""
+        import ray_tpu
+        from ray_tpu._private.worker import global_worker
+
+        out = {"apps": {}, "proxies": [], "directory": []}
+        ctx = global_worker.context
+        if ctx is not None:
+            try:
+                out["directory"] = ctx.serve_directory()
+            except Exception:  # noqa: BLE001 — head gone/not a driver
+                pass
+        try:
+            from ray_tpu.serve._private.common import CONTROLLER_NAME
+
+            named = ray_tpu.get_actor(CONTROLLER_NAME)
+            from ray_tpu.actor import ActorHandle
+
+            ctrl = ActorHandle(named._actor_id, "ServeController")
+            out.update(ray_tpu.get(ctrl.ingress_status.remote()))
+        except ValueError:
+            pass  # Serve not running: empty view, not an error
+        if app is not None:
+            if app not in out["apps"]:
+                raise KeyError(app)
+            out["apps"] = {app: out["apps"][app]}
+        return out
+
     def _payload(self, kind: str, limit: Optional[int] = None,
-                 duration: Optional[float] = None):
+                 duration: Optional[float] = None,
+                 app: Optional[str] = None):
         from ray_tpu.util import state as state_api
 
+        if kind == "serve":
+            return self._serve_payload(app)
         if kind == "cluster":
             return state_api.summarize()
         if kind == "nodes":
@@ -93,11 +127,19 @@ class DashboardServer:
                 return web.json_response(
                     {"error": f"invalid duration {raw_duration!r}"}, status=400
                 )
+        app = request.query.get("app")
         loop = asyncio.get_event_loop()
         try:
             payload = await loop.run_in_executor(
-                None, self._payload, kind, limit, duration
+                None, self._payload, kind, limit, duration, app
             )
+        except KeyError as e:
+            if kind == "serve" and app is not None:
+                # /api/serve?app=<unknown>: caller error, not service failure.
+                return web.json_response(
+                    {"error": f"unknown app {app!r}"}, status=400
+                )
+            return web.json_response({"error": str(e)}, status=503)
         except Exception as e:  # noqa: BLE001 — e.g. profiler disabled
             return web.json_response({"error": str(e)}, status=503)
         return web.json_response(json.loads(json.dumps(payload, default=str)))

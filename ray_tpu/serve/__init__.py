@@ -33,7 +33,7 @@ from ray_tpu.serve.handle import (
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
-from ray_tpu.serve._private.common import AutoscalingConfig
+from ray_tpu.serve._private.common import AutoscalingConfig, RequestShedded
 from ray_tpu.serve._private.http_proxy import ProxyRequest
 
 __all__ = [
@@ -47,6 +47,7 @@ __all__ = [
     "DeploymentResponse",
     "DeploymentResponseGenerator",
     "ProxyRequest",
+    "RequestShedded",
     "delete",
     "deployment",
     "get_deployment_handle",

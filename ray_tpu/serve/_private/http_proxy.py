@@ -1,11 +1,20 @@
-"""HTTP proxy: the HTTP front door, one actor (per node at scale).
+"""HTTP proxy: the HTTP front door, one actor per node at scale.
 
 Reference: `python/ray/serve/_private/http_proxy.py:250` (`HTTPProxy`, served
-by uvicorn at `:434`). Here the server is aiohttp running on a background
-thread inside the proxy actor; each request resolves its route by longest
-prefix match against the controller's route table (cached), then hops to a
-replica through the same Router/power-of-two path as Python handles, with
-the blocking result fetch pushed onto the loop's executor.
+by uvicorn at `:434`) + `http_state.py` (the controller-managed per-node
+proxy fleet). Here the server is aiohttp running on a background thread
+inside the proxy actor; each request resolves its route by longest prefix
+match against the controller's route table (cached), then hops to a replica
+through the same Router/power-of-two path as Python handles, with the
+blocking result fetch pushed onto the loop's executor.
+
+Admission control: each app has a per-proxy cap on admitted-but-unfinished
+requests (deployment option `max_queued_requests`, default
+`serve_queue_cap_default`); beyond it the proxy answers a FAST
+`503 + Retry-After` (counted in `ray_tpu_serve_shed_total{app,reason}`)
+instead of queueing toward collapse. A draining proxy (serve_drain tag, or
+controller drain_proxy) sheds everything new, withdraws from the head's
+service directory, and finishes its in-flight window.
 """
 
 from __future__ import annotations
@@ -16,6 +25,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.serve._private.common import RequestShedded
 
 
 @dataclass
@@ -47,9 +58,24 @@ def _asgi_route_kwargs(request) -> Dict[str, Any]:
     return {MODEL_ID_KWARG: mid} if mid else {}
 
 
+def _ingress_metrics():
+    """Front-door metric set, or None when enable_metrics is off."""
+    from ray_tpu._private import telemetry
+
+    return (
+        telemetry.serve_ingress_metrics()
+        if telemetry.metrics_enabled() else None
+    )
+
+
 class HTTPProxy:
-    def __init__(self, controller, port: Optional[int] = None):
+    def __init__(self, controller, port: Optional[int] = None,
+                 proxy_id: Optional[str] = None):
         self._controller = controller
+        # Controller-assigned identity (EveryNode fleet): the service
+        # directory and the controller's proxy registry then share ONE
+        # proxy_id, so the two /api/serve views join on it, not on ports.
+        self._proxy_id = proxy_id
         self._handles: Dict[str, Any] = {}
         self._routes: Dict[str, str] = {}
         self._routes_fetched = 0.0
@@ -59,6 +85,17 @@ class HTTPProxy:
         self._start_error: Optional[str] = None
         self._bind_error: Optional[str] = None
         self._routes_thread_started = False
+        # ---- admission control / drain state ----
+        # deployment -> per-proxy cap on admitted-but-unfinished requests
+        # (pushed with the route table; 0 = uncapped).
+        self._app_caps: Dict[str, int] = {}
+        self._ingress_lock = threading.Lock()
+        self._app_inflight: Dict[str, int] = {}
+        self._app_shed: Dict[str, int] = {}
+        self._app_requests: Dict[str, int] = {}
+        self._total_inflight = 0
+        self._draining = False
+        self._announced_id: Optional[str] = None
         if port is not None:
             # Bind during creation so a crash-restart (max_restarts replays
             # the creation task) comes back LISTENING on the same port — the
@@ -102,7 +139,12 @@ class HTTPProxy:
 
     # -------------------------------------------------------------- lifecycle
     def start(self, host: str = "127.0.0.1", port: int = 8000) -> int:
-        """Start serving; returns the bound port (0 picks a free one)."""
+        """Start serving; returns the bound port (0 picks a free one).
+        Idempotent on a LIVE listener: concurrent starters (the controller's
+        ensure_proxies racing its reconcile tick) must not stack a second
+        HTTP server inside the actor."""
+        if self._port is not None:
+            return self._port
         t = threading.Thread(
             target=self._serve_thread, args=(host, port), daemon=True, name="http"
         )
@@ -127,17 +169,85 @@ class HTTPProxy:
             threading.Thread(
                 target=self._routes_listen_loop, daemon=True, name="routes-listen"
             ).start()
+        self._announce()
         return self._port
+
+    def _announce(self) -> None:
+        """Register this proxy's listener in the head's service directory
+        (serve_proxy_up tag; no-op outside a worker process)."""
+        import os
+
+        from ray_tpu._private import worker_main
+
+        proxy_id = self._proxy_id or f"proxy-{os.getpid()}-{self._port}"
+        if worker_main.announce_serve_proxy(
+            {"proxy_id": proxy_id, "port": self._port, "pid": os.getpid()}
+        ):
+            self._announced_id = proxy_id
+
+    # ------------------------------------------------------------------ drain
+    def _serve_begin_drain(self) -> None:
+        """Out-of-band drain hook (worker reader thread, serve_drain tag):
+        stop accepting — every new request sheds 503 + Retry-After — and
+        withdraw from the service directory; in-flight requests finish."""
+        self._draining = True
+        if self._announced_id is not None:
+            from ray_tpu._private import worker_main
+
+            worker_main.withdraw_serve_proxy(self._announced_id)
+            self._announced_id = None
+
+    def _serve_inflight(self) -> int:
+        return self._total_inflight
+
+    def prepare_drain(self) -> int:
+        """Actor-call form of the drain flag (tests/tooling)."""
+        self._serve_begin_drain()
+        return self._total_inflight
+
+    def ingress_stats(self) -> Dict[str, Any]:
+        """Live per-app admission counters (dashboard /api/serve)."""
+        with self._ingress_lock:
+            apps = {
+                dep: {
+                    "inflight": self._app_inflight.get(dep, 0),
+                    "shed": self._app_shed.get(dep, 0),
+                    "requests": self._app_requests.get(dep, 0),
+                    "cap": self._app_caps.get(dep, 0),
+                }
+                for dep in (
+                    set(self._app_inflight) | set(self._app_shed)
+                    | set(self._app_requests) | set(self._app_caps)
+                )
+            }
+        return {
+            "port": self._port,
+            "draining": self._draining,
+            "total_inflight": self._total_inflight,
+            "apps": apps,
+        }
 
     def port(self) -> Optional[int]:
         return self._port
 
     def _serve_thread(self, host: str, port: int):
+        import os
+
         from aiohttp import web
+
+        from ray_tpu._private.config import get_config
 
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
         self._loop = loop
+        # Bounded forwarding pipeline (serve_proxy_max_concurrent): requests
+        # over the bound park on the semaphore (cheap coroutines) instead of
+        # flooding the executor — the event loop stays responsive, so shed
+        # 503s are fast even at 2x saturation.
+        bound = int(get_config().serve_proxy_max_concurrent)
+        if bound <= 0:
+            bound = max(4, 4 * (os.cpu_count() or 1))
+        self._forward_slots = asyncio.Semaphore(bound)
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", self._handle)
@@ -155,18 +265,20 @@ class HTTPProxy:
 
     # ---------------------------------------------------------------- routing
     def _routes_listen_loop(self):
-        """Park in the controller's long poll for route-table pushes (client
-        half of the reference's LongPollHost)."""
+        """Park in the controller's long poll for route-table AND admission
+        cap pushes (client half of the reference's LongPollHost). Every
+        proxy mirrors ONE routing table this way — adding a node just adds
+        another parked listener."""
         import time
 
         import ray_tpu
 
-        version = -1
+        versions = {"routes": -1, "app_caps": -1}
         failures = 0
         while True:
             try:
                 updates = ray_tpu.get(
-                    self._controller.listen_for_change.remote({"routes": version}),
+                    self._controller.listen_for_change.remote(dict(versions)),
                     timeout=60,
                 )
                 failures = 0
@@ -177,8 +289,11 @@ class HTTPProxy:
                 time.sleep(0.5)
                 continue
             if "routes" in updates:
-                version, routes = updates["routes"]
+                versions["routes"], routes = updates["routes"]
                 self._routes = routes
+            if "app_caps" in updates:
+                versions["app_caps"], caps = updates["app_caps"]
+                self._app_caps = caps
 
     def _refresh_routes(self) -> None:
         """Pull the route table directly from the controller (the long-poll
@@ -186,6 +301,12 @@ class HTTPProxy:
         import ray_tpu
 
         self._routes = ray_tpu.get(self._controller.get_routes.remote())
+        try:
+            self._app_caps = ray_tpu.get(
+                self._controller.get_app_caps.remote()
+            )
+        except Exception:  # noqa: BLE001 — caps follow on the next push
+            pass
         self._routes_fetched = time.time()
 
     def has_route(self, prefix: str) -> bool:
@@ -246,6 +367,77 @@ class HTTPProxy:
             self._handles[dep] = handle
         return handle
 
+    # ------------------------------------------------------ admission control
+    @staticmethod
+    def _shed_of(exc) -> Optional[RequestShedded]:
+        """The RequestShedded behind `exc`, if any: raised directly (router
+        inflight cap) or wrapped in a RayTaskError (a shed-aware
+        @serve.batch queue inside the replica). The CAUSE wins over the
+        outer exception: RayTaskError.as_instanceof_cause builds a derived
+        RayTaskError(RequestShedded) whose MRO re-ran RequestShedded's
+        __init__ with DEFAULT reason/retry_after_s — only the original
+        cause carries the real shed attributes."""
+        cause = getattr(exc, "cause", None) or exc.__cause__
+        if isinstance(cause, RequestShedded):
+            return cause
+        if isinstance(exc, RequestShedded):
+            return exc
+        return None
+
+    def _shed_response(self, app: str, reason: str,
+                       retry_after_s: Optional[float] = None,
+                       count: bool = True):
+        """Fast 503 + Retry-After: overload converts to an explicit backoff
+        signal, never a hung connection (shed-not-collapse). `count=False`
+        skips the shared shed counter for sheds the ORIGIN already counted
+        (the router's replica_inflight raise) — one shed, one count."""
+        from aiohttp import web
+
+        if retry_after_s is None:
+            from ray_tpu._private.config import get_config
+
+            retry_after_s = get_config().serve_retry_after_s
+        with self._ingress_lock:
+            self._app_shed[app] = self._app_shed.get(app, 0) + 1
+        m = _ingress_metrics() if count else None
+        if m is not None:
+            m["shed"].inc(1, {"app": app, "reason": reason})
+        import math
+
+        # RFC 9110: Retry-After delay-seconds is a non-negative INTEGER —
+        # fractional values break conforming clients' parsers. Round up so
+        # a sub-second knob still signals a backoff.
+        return web.json_response(
+            {"error": "shed", "reason": reason, "app": app},
+            status=503,
+            headers={"Retry-After": str(max(1, math.ceil(retry_after_s)))},
+        )
+
+    def _admit(self, dep: str) -> bool:
+        """Count one request in, unless the app is at its per-proxy cap."""
+        cap = self._app_caps.get(dep, 0)
+        with self._ingress_lock:
+            inflight = self._app_inflight.get(dep, 0)
+            if cap and inflight >= cap:
+                return False
+            self._app_inflight[dep] = inflight + 1
+            self._app_requests[dep] = self._app_requests.get(dep, 0) + 1
+            self._total_inflight += 1
+        m = _ingress_metrics()
+        if m is not None:
+            m["proxy_requests"].inc(1, {"app": dep})
+            m["proxy_queue_depth"].set(inflight + 1, {"app": dep})
+        return True
+
+    def _release(self, dep: str) -> None:
+        with self._ingress_lock:
+            left = max(0, self._app_inflight.get(dep, 0) - 1)
+            self._app_inflight[dep] = left
+            self._total_inflight = max(0, self._total_inflight - 1)
+        m = _ingress_metrics()
+        if m is not None:
+            m["proxy_queue_depth"].set(left, {"app": dep})
+
     async def _handle(self, request):
         from aiohttp import web
 
@@ -255,14 +447,32 @@ class HTTPProxy:
                 {"error": f"no route for {request.path}"}, status=404
             )
         dep, is_asgi, rest = match
-        body = await request.read()
-        handle = self._handle_for(dep)
+        if self._draining:
+            return self._shed_response(dep, "draining")
+        if not self._admit(dep):
+            return self._shed_response(dep, "app_queue")
         try:
-            if is_asgi:
-                return await self._handle_asgi(request, handle, rest, body)
-            return await self._handle_plain(request, handle, rest, body)
-        except Exception as e:  # noqa: BLE001 — surface as a 500
-            return web.json_response({"error": str(e)}, status=500)
+            body = await request.read()
+            handle = self._handle_for(dep)
+            try:
+                async with self._forward_slots:
+                    if is_asgi:
+                        return await self._handle_asgi(
+                            request, handle, rest, body
+                        )
+                    return await self._handle_plain(
+                        request, handle, rest, body
+                    )
+            except Exception as e:  # noqa: BLE001 — surface as a 500
+                shed = self._shed_of(e)
+                if shed is not None:
+                    return self._shed_response(
+                        dep, shed.reason, shed.retry_after_s,
+                        count=shed.reason != "replica_inflight",
+                    )
+                return web.json_response({"error": str(e)}, status=500)
+        finally:
+            self._release(dep)
 
     async def _handle_plain(self, request, handle, rest: str, body: bytes):
         """Non-ASGI deployment: one streaming call; a generator return
@@ -302,11 +512,13 @@ class HTTPProxy:
                 ev = await loop.run_in_executor(None, stream.next_or_none)
             await resp.write_eof()
             return resp
-        except Exception as e:  # noqa: BLE001
+        except Exception:  # noqa: BLE001
             # After prepare() the status line is on the wire: no second
             # response is possible — drop the connection mid-stream instead.
+            # Pre-prepare failures re-raise so _handle classifies them
+            # (shed -> 503 + Retry-After, anything else -> 500).
             if resp is None:
-                return web.json_response({"error": str(e)}, status=500)
+                raise
             return resp
         finally:
             stream.close()  # releases unconsumed items + router load unit
@@ -368,9 +580,9 @@ class HTTPProxy:
                 return web.Response(status=204)
             await resp.write_eof()
             return resp
-        except Exception as e:  # noqa: BLE001
+        except Exception:  # noqa: BLE001
             if resp is None:
-                return web.json_response({"error": str(e)}, status=500)
+                raise  # _handle classifies: shed -> 503, else 500
             return resp  # mid-stream failure: connection ends where it stopped
         finally:
             stream.close()

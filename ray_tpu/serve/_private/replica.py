@@ -20,10 +20,20 @@ class ServeReplica:
     def __init__(self, deployment_name: str, blob: bytes, init_args: Tuple,
                  init_kwargs: Dict[str, Any],
                  max_concurrent_queries: int = 1):
+        import threading
         from concurrent.futures import ThreadPoolExecutor
 
         from ray_tpu._private import serialization
 
+        # Graceful-drain bookkeeping: requests EXECUTING right now (calls
+        # still parked in the actor's ordered queue are counted by the
+        # scheduler's ActorRecord — the controller polls that side). The
+        # draining flag is set out-of-band by the worker's reader thread
+        # (serve_drain tag) or via prepare_drain(); stragglers routed by a
+        # not-yet-pushed table still run — drain never drops admitted work.
+        self._active = 0
+        self._active_lock = threading.Lock()
+        self._draining = False
         self.deployment_name = deployment_name
         target = serialization.loads(blob)
         if isinstance(target, type):
@@ -48,6 +58,36 @@ class ServeReplica:
     def _count_request(self) -> None:
         self._requests = next(self._request_counter)
 
+    # --------------------------------------------------------------- draining
+    def _admit(self) -> None:
+        with self._active_lock:
+            self._active += 1
+
+    def _release(self) -> None:
+        with self._active_lock:
+            self._active -= 1
+
+    def _serve_begin_drain(self) -> None:
+        """Out-of-band drain hook (worker reader thread, serve_drain tag)."""
+        self._draining = True
+
+    def _serve_inflight(self) -> int:
+        return self._active
+
+    def prepare_drain(self) -> int:
+        """Actor-call form of the drain flag (threaded replicas; the wire
+        form covers max_concurrency=1 replicas whose call queue is busy)."""
+        self._draining = True
+        return self._active
+
+    async def _release_after(self, coro):
+        # An async user method: the load unit must live until the coroutine
+        # actually finishes, not until handle_request returns it.
+        try:
+            return await coro
+        finally:
+            self._release()
+
     def _resolve(self, method_name: str):
         if method_name == "__call__":
             target = self._callable
@@ -59,6 +99,21 @@ class ServeReplica:
         return getattr(self._callable, method_name)
 
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict[str, Any]):
+        import inspect
+
+        self._admit()
+        try:
+            out = self._handle_request_inner(method_name, args, kwargs)
+        except BaseException:
+            self._release()
+            raise
+        if inspect.iscoroutine(out):
+            return self._release_after(out)
+        self._release()
+        return out
+
+    def _handle_request_inner(self, method_name: str, args: Tuple,
+                              kwargs: Dict[str, Any]):
         import inspect
 
         from ray_tpu.serve.multiplex import (
@@ -89,6 +144,17 @@ class ServeReplica:
 
     async def handle_request_stream(self, method_name: str, args: Tuple,
                                     kwargs: Dict[str, Any]):
+        self._admit()
+        try:
+            async for ev in self._handle_request_stream_inner(
+                method_name, args, kwargs
+            ):
+                yield ev
+        finally:
+            self._release()
+
+    async def _handle_request_stream_inner(self, method_name: str, args: Tuple,
+                                           kwargs: Dict[str, Any]):
         """Streaming variant (called with num_returns="streaming"): a user
         method returning a generator streams each item as its own object; a
         plain return streams one ("single", value) event. First element of
@@ -206,6 +272,13 @@ class ServeReplica:
             yield ("single", out)
 
     def handle_asgi(self, scope: Dict[str, Any], body: bytes):
+        self._admit()
+        try:
+            yield from self._handle_asgi_inner(scope, body)
+        finally:
+            self._release()
+
+    def _handle_asgi_inner(self, scope: Dict[str, Any], body: bytes):
         """Run one HTTP request through the deployment's ASGI app, yielding
         ASGI messages ({"type": "http.response.start"/"http.response.body"})
         as the app sends them — consumed by the proxy over a streaming actor
@@ -299,6 +372,8 @@ class ServeReplica:
         return {
             "deployment": self.deployment_name,
             "requests": self._requests,
+            "inflight": self._active,
+            "draining": self._draining,
             "uptime_s": time.time() - self._started,
         }
 

@@ -31,6 +31,7 @@ _VALID_DEPLOYMENT_OPTIONS = {
     "autoscaling_config",
     "route_prefix",
     "max_concurrent_queries",
+    "max_queued_requests",
     "user_config",
     "version",
 }
@@ -209,15 +210,14 @@ def start(
 ) -> None:
     """Start Serve system actors ahead of `serve.run` (reference:
     `serve.start`, `http_options={"location": "EveryNode"}`). With
-    `proxy_location="EveryNode"` one HTTP proxy actor is pinned to EVERY
-    cluster node (the reference's per-node `HTTPProxy`,
-    `_private/http_proxy.py:250`), removing the single-proxy throughput
-    ceiling/SPOF; each binds its own port (`port=0` picks a free one —
-    required when virtual nodes share one machine). `serve.proxy_ports()`
-    lists them."""
-    from ray_tpu.serve._private.http_proxy import HTTPProxy
-    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
-
+    `proxy_location="EveryNode"` the CONTROLLER spawns and manages one HTTP
+    proxy actor per cluster node — exactly like replicas (the reference's
+    `http_state.py` fleet): each is registered in the head's service
+    directory on bind, mirrors the shared routing table via the controller
+    long poll, and is respawned/re-bound by the controller's reconcile loop;
+    nodes that join later get a proxy automatically. Each binds its own
+    port (`port=0` picks a free one — required when virtual nodes share one
+    machine). `serve.proxy_ports()` lists them."""
     ray_tpu._private.worker._auto_init()
     opts = dict(http_options or {})
     location = opts.get("location", proxy_location)
@@ -226,40 +226,20 @@ def start(
     if location != "EveryNode":
         _get_proxy(create=True, port=port)
         return
-    proxies = _client.setdefault("node_proxies", {})
-    for node in ray_tpu.nodes():
-        node_id = node["node_id"]
-        if node_id in proxies or not node.get("alive", True):
-            # A hard affinity to a dead node would never place.
-            continue
-        name = f"{PROXY_NAME}::{node_id[:8]}"
-        handle = (
-            ray_tpu.remote(HTTPProxy)
-            .options(
-                name=name,
-                num_cpus=0.1,
-                get_if_exists=True,
-                lifetime="detached",
-                scheduling_strategy=NodeAffinitySchedulingStrategy(
-                    node_id=node_id, soft=False
-                ),
-            )
-            .remote(controller)
-        )
-        # get_if_exists may return a proxy another driver already started:
-        # starting it again would stack a second HTTP server inside the actor.
-        bound = ray_tpu.get(handle.port.remote())
-        if bound is None:
-            # Virtual nodes share a host: every proxy after the first would
-            # collide on a fixed port, so EveryNode always binds a free one.
-            bound = ray_tpu.get(handle.start.remote(port=0))
-        proxies[node_id] = (handle, bound)
+    ray_tpu.get(controller.ensure_proxies.remote(port=0))
+    _client["managed_proxies"] = True
 
 
 def proxy_ports() -> Dict[str, int]:
-    """node_id -> bound HTTP port for per-node proxies (+ the default proxy
-    under "head" when present)."""
-    out = {nid: port for nid, (_h, port) in _client.get("node_proxies", {}).items()}
+    """node_id -> bound HTTP port for per-node (controller-managed) proxies
+    (+ the default proxy under "head" when present)."""
+    out: Dict[str, int] = {}
+    if _client.get("managed_proxies") and "controller" in _client:
+        try:
+            proxies = ray_tpu.get(_client["controller"].get_proxies.remote())
+            out.update({nid: p["port"] for nid, p in proxies.items()})
+        except Exception:
+            pass
     if "http_port" in _client:
         out["head"] = _client["http_port"]
     return out
@@ -332,6 +312,9 @@ def run(
             max_concurrent_queries=int(
                 dep._options.get("max_concurrent_queries", 1)
             ),
+            max_queued_requests=int(
+                dep._options.get("max_queued_requests", 0)
+            ),
             ray_actor_options=dep._options.get("ray_actor_options") or {},
             autoscaling_config=_coerce_autoscaling(
                 dep._options.get("autoscaling_config")
@@ -362,12 +345,23 @@ def run(
 
 
 def _wait_routes_live(prefix: str, timeout: float = 30.0) -> None:
-    """Block until every responsive proxy (head + per-node) can route
-    `prefix`. A proxy that never answers within the deadline (dead node,
-    crash-looping restart) is pruned from the per-node registry rather than
-    failing the deploy — the app IS live on every proxy that can serve it."""
+    """Block until every responsive proxy (head + controller-managed) can
+    route `prefix`. A proxy that never answers within the deadline (dead
+    node, crash-looping restart) is skipped rather than failing the deploy —
+    the app IS live on every proxy that can serve it (the controller's
+    reconcile loop brings stragglers back)."""
+    from ray_tpu.actor import ActorHandle
+
     named = [("head", h) for h in ([_client["proxy"]] if "proxy" in _client else [])]
-    named += [(nid, h) for nid, (h, _p) in _client.get("node_proxies", {}).items()]
+    if _client.get("managed_proxies") and "controller" in _client:
+        try:
+            proxies = ray_tpu.get(_client["controller"].get_proxies.remote())
+            named += [
+                (nid, ActorHandle(p["actor_id"], "HTTPProxy"))
+                for nid, p in proxies.items()
+            ]
+        except Exception:
+            pass
     deadline = time.time() + timeout
     for nid, h in named:
         responded = False
@@ -387,7 +381,6 @@ def _wait_routes_live(prefix: str, timeout: float = 30.0) -> None:
                         f"route {prefix!r} was not live at proxy {nid} "
                         f"within {timeout}s"
                     )
-                _client.get("node_proxies", {}).pop(nid, None)
                 break
             time.sleep(0.05)
 
@@ -429,9 +422,6 @@ def shutdown() -> None:
             ray_tpu.kill(_client["proxy"])
         except Exception:
             pass
-    for handle, _port in _client.get("node_proxies", {}).values():
-        try:
-            ray_tpu.kill(handle)
-        except Exception:
-            pass
+    # Controller-managed (EveryNode) proxies are killed by
+    # controller.shutdown() above.
     _client.clear()

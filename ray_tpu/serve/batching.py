@@ -19,20 +19,35 @@ from typing import Any, List, Optional, Tuple
 
 
 class _BatchQueue:
-    """Accumulates (item, future) pairs on the running event loop; one drain
-    task flushes full or timed-out batches through the wrapped function."""
+    """Accumulates (item, future, enqueue_ts) triples on the running event
+    loop; one drain task flushes full or timed-out batches through the
+    wrapped function.
 
-    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+    Shedding: with `max_queue_len` set, a submit finding the queue at
+    capacity is rejected IMMEDIATELY with RequestShedded (fast 503 at the
+    front door) instead of deepening the backlog; with `shed_timeout_s`
+    set, members that waited past it are shed individually at flush time —
+    one slow batch must not time out every queued member behind it. A
+    member is settled exactly once (executed OR shed): the shed scan runs
+    after the batch is popped, and both paths guard on fut.done()."""
+
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float,
+                 max_queue_len: int = 0,
+                 shed_timeout_s: Optional[float] = None):
         self._fn = fn
         self.max_batch_size = int(max_batch_size)
         self.batch_wait_timeout_s = float(batch_wait_timeout_s)
-        self._items: List[Tuple[Any, Any]] = []
+        self.max_queue_len = int(max_queue_len)
+        self.shed_timeout_s = shed_timeout_s
+        self._items: List[Tuple[Any, Any, float]] = []
         self._loop: Optional[Any] = None
         self._full: Optional[Any] = None
         self._drainer: Optional[Any] = None
         # Observability: sizes of executed batches (surfaced in tests and
         # debugging; the reference exposes similar counters via metrics).
         self.batch_sizes: List[int] = []
+        # Members shed (queue cap + stale-wait), surfaced in tests/stats.
+        self.shed_count = 0
 
     def _bind_loop(self, loop) -> None:
         """The Event (and the drainer task) belong to ONE event loop. A queue
@@ -62,16 +77,60 @@ class _BatchQueue:
 
     async def submit(self, self_obj, item):
         import asyncio
+        import time
+
+        from ray_tpu.serve._private.common import RequestShedded
 
         loop = asyncio.get_running_loop()
         self._bind_loop(loop)
+        if self.max_queue_len and len(self._items) >= self.max_queue_len:
+            from ray_tpu._private.config import get_config
+
+            # Admission control at the queue door: shedding here is what
+            # keeps a saturated batch deployment answering in O(1) instead
+            # of timing out ALL queued members together.
+            self.shed_count += 1
+            raise RequestShedded(
+                f"@serve.batch queue at capacity ({self.max_queue_len})",
+                reason="batch_queue",
+                retry_after_s=get_config().serve_retry_after_s,
+            )
         fut = loop.create_future()
-        self._items.append((item, fut))
+        self._items.append((item, fut, time.monotonic()))
         if len(self._items) >= self.max_batch_size:
             self._full.set()
         if self._drainer is None or self._drainer.done():
             self._drainer = loop.create_task(self._drain(self_obj))
         return await fut
+
+    def _shed_stale(self, batch):
+        """Split a popped batch into (live, shed) by shed_timeout_s. Runs
+        AFTER the pop, so the flush timer and the shed race settle each
+        future exactly once (both sides guard on fut.done())."""
+        import time
+
+        from ray_tpu.serve._private.common import RequestShedded
+
+        if self.shed_timeout_s is None:
+            return batch
+        from ray_tpu._private.config import get_config
+
+        retry_after = get_config().serve_retry_after_s
+        now = time.monotonic()
+        live = []
+        for item, fut, ts in batch:
+            if now - ts > self.shed_timeout_s:
+                self.shed_count += 1
+                if not fut.done():
+                    fut.set_exception(RequestShedded(
+                        f"@serve.batch member waited "
+                        f"{now - ts:.3f}s > shed_timeout_s="
+                        f"{self.shed_timeout_s}", reason="batch_queue",
+                        retry_after_s=retry_after,
+                    ))
+            else:
+                live.append((item, fut, ts))
+        return live
 
     async def _drain(self, self_obj) -> None:
         import asyncio
@@ -87,9 +146,10 @@ class _BatchQueue:
             self._full.clear()
             batch = self._items[: self.max_batch_size]
             del self._items[: len(batch)]
+            batch = self._shed_stale(batch)
             if not batch:
                 continue
-            items = [it for it, _ in batch]
+            items = [it for it, _, _ in batch]
             try:
                 if self_obj is not None:
                     results = await self._fn(self_obj, items)
@@ -110,12 +170,12 @@ class _BatchQueue:
                         + ")"
                     )
             except Exception as e:  # noqa: BLE001 — every waiter sees the error
-                for _, fut in batch:
+                for _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(e)
                 continue
             self.batch_sizes.append(len(items))
-            for (_, fut), res in zip(batch, results):
+            for (_, fut, _), res in zip(batch, results):
                 if not fut.done():
                     fut.set_result(res)
 
@@ -124,19 +184,29 @@ class _BatchWrapper:
     """Descriptor form of @serve.batch: binding to an instance lazily creates
     that instance's queue (replicas must not share batches across instances)."""
 
-    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float,
+                 max_queue_len: int = 0,
+                 shed_timeout_s: Optional[float] = None):
         self._fn = fn
         self._max = max_batch_size
         self._wait = batch_wait_timeout_s
+        self._max_queue = max_queue_len
+        self._shed_timeout = shed_timeout_s
         self._queue_attr = f"__serve_batch_queue_{fn.__name__}__"
         self._free_queue: Optional[_BatchQueue] = None
         self.__name__ = fn.__name__
         self.__doc__ = fn.__doc__
 
+    def _make_queue(self) -> _BatchQueue:
+        return _BatchQueue(
+            self._fn, self._max, self._wait,
+            max_queue_len=self._max_queue, shed_timeout_s=self._shed_timeout,
+        )
+
     def _instance_queue(self, obj) -> _BatchQueue:
         q = obj.__dict__.get(self._queue_attr)
         if q is None:
-            q = _BatchQueue(self._fn, self._max, self._wait)
+            q = self._make_queue()
             obj.__dict__[self._queue_attr] = q
         return q
 
@@ -154,12 +224,14 @@ class _BatchWrapper:
     async def __call__(self, item):
         # Free-function form: one module-level queue.
         if self._free_queue is None:
-            self._free_queue = _BatchQueue(self._fn, self._max, self._wait)
+            self._free_queue = self._make_queue()
         return await self._free_queue.submit(None, item)
 
 
 def batch(_func=None, *, max_batch_size: int = 10,
-          batch_wait_timeout_s: float = 0.01):
+          batch_wait_timeout_s: float = 0.01,
+          max_queue_len: int = 0,
+          shed_timeout_s: Optional[float] = None):
     """Decorate an `async def` taking a LIST of items (after self) so that
     concurrent single-item calls coalesce into one call of the underlying
     function. Callers invoke it with ONE item and await one result.
@@ -169,15 +241,27 @@ def batch(_func=None, *, max_batch_size: int = 10,
             async def predict(self, inputs: list) -> list: ...
             async def __call__(self, request):
                 return await self.predict(request)
+
+    With `max_queue_len`, submits finding the queue at capacity shed
+    immediately (RequestShedded -> 503 + Retry-After at the front door);
+    with `shed_timeout_s`, members that waited past it shed individually at
+    flush time instead of the whole batch timing out together.
     """
     if max_batch_size < 1:
         raise ValueError("max_batch_size must be >= 1")
     if batch_wait_timeout_s < 0:
         raise ValueError("batch_wait_timeout_s must be >= 0")
+    if max_queue_len < 0:
+        raise ValueError("max_queue_len must be >= 0 (0 = unbounded)")
+    if shed_timeout_s is not None and shed_timeout_s < 0:
+        raise ValueError("shed_timeout_s must be >= 0")
 
     def deco(fn):
         if not inspect.iscoroutinefunction(fn):
             raise TypeError("@serve.batch requires an `async def` function")
-        return _BatchWrapper(fn, max_batch_size, batch_wait_timeout_s)
+        return _BatchWrapper(
+            fn, max_batch_size, batch_wait_timeout_s,
+            max_queue_len=max_queue_len, shed_timeout_s=shed_timeout_s,
+        )
 
     return deco if _func is None else deco(_func)

@@ -45,6 +45,52 @@ def close_all_routers() -> None:
         r.close()
 
 
+def _router_listen_loop(router_ref, deployment_name: str, controller):
+    """Long-poll client parked at the controller. Holds only a WEAKREF to
+    its router: when the last handle drops, the router is GC'd, its
+    __del__ cancels the parked listener (cancel_listener) and this thread
+    exits — controller call slots don't leak across app redeploys
+    (previously the bound-method thread target kept every router alive
+    forever)."""
+    import ray_tpu
+
+    key = f"replicas::{deployment_name}"
+    version = -1
+    failures = 0
+    while True:
+        r = router_ref()
+        if r is None or r._closed:
+            return
+        router_id = r._router_id
+        del r  # never hold the router across the blocking poll
+        try:
+            updates = ray_tpu.get(
+                controller.listen_for_change.remote(
+                    {key: version}, router_id
+                ),
+                timeout=60,
+            )
+            failures = 0
+        except Exception:
+            failures += 1
+            if failures >= 6:
+                # Controller gone (serve.shutdown without closing handles):
+                # stop spinning; route() falls back to direct fetches.
+                return
+            time.sleep(0.5)
+            continue
+        r = router_ref()
+        if r is None or r._closed:
+            return
+        if key in updates:
+            version, replicas = updates[key]
+            with r._lock:
+                r._version = version
+                r._replicas = replicas
+            r._have_table.set()
+        del r
+
+
 class Router:
     def __init__(self, deployment_name: str, controller):
         self._name = deployment_name
@@ -73,42 +119,41 @@ class Router:
         self._model_affinity: "_c.OrderedDict[str, str]" = _c.OrderedDict()
         self._model_affinity_cap = 4096
         self._last_load_report = 0.0
+        # Route-wait samples (ts, seconds) for the windowed p95 reported to
+        # the controller — the SLO-aware autoscaling signal. Own lock: the
+        # append happens after route() releases self._lock, while the p95
+        # scan iterates from under it — iterating a deque another thread is
+        # appending to raises RuntimeError.
+        import collections as _c2
+
+        self._wait_samples: "_c2.deque" = _c2.deque(maxlen=2048)
+        self._samples_lock = threading.Lock()
         self._closed = False
         _all_routers.add(self)
         threading.Thread(
-            target=self._listen_loop, daemon=True, name=f"serve-listen-{deployment_name}"
+            target=_router_listen_loop,
+            args=(weakref.ref(self), deployment_name, controller),
+            daemon=True, name=f"serve-listen-{deployment_name}",
         ).start()
 
-    def _listen_loop(self):
-        """Park in the controller's long poll; apply pushed replica tables."""
-        import ray_tpu
-
-        key = f"replicas::{self._name}"
-        failures = 0
-        while not self._closed:
-            try:
-                updates = ray_tpu.get(
-                    self._controller.listen_for_change.remote({key: self._version}),
-                    timeout=60,
-                )
-                failures = 0
-            except Exception:
-                failures += 1
-                if self._closed or failures >= 6:
-                    # Controller gone (serve.shutdown without closing handles):
-                    # stop spinning; route() falls back to direct fetches.
-                    return
-                time.sleep(0.5)
-                continue
-            if key in updates:
-                version, replicas = updates[key]
-                with self._lock:
-                    self._version = version
-                    self._replicas = replicas
-                self._have_table.set()
-
     def close(self):
+        if self._closed:
+            return
         self._closed = True
+        try:
+            # Unpark this router's listener so its controller call slot
+            # frees now, not at the next server-side timeout.
+            self._controller.cancel_listener.remote(self._router_id)
+        except Exception:
+            pass
+
+    def __del__(self):
+        # GC-driven close (the weakref listen loop makes routers
+        # collectable): a leaked slot per redeploy otherwise.
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _ensure_table(self, force: bool = False):
         """Ensure a table exists. Steady-state updates arrive via push; this
@@ -155,6 +200,20 @@ class Router:
             replica_id, 0
         )
 
+    def _route_wait_p95(self) -> Optional[float]:
+        """p95 of route-wait samples inside the SLO window (PR 2's histogram
+        signal, windowed locally so the controller sees CURRENT latency, not
+        all-time). None with no fresh samples."""
+        from ray_tpu._private.config import get_config
+
+        cutoff = time.time() - float(get_config().serve_slo_window_s)
+        with self._samples_lock:
+            snapshot = list(self._wait_samples)
+        recent = sorted(w for ts, w in snapshot if ts >= cutoff)
+        if not recent:
+            return None
+        return recent[min(len(recent) - 1, int(0.95 * len(recent)))]
+
     def _report_load(self):
         now = time.time()
         if now - self._last_load_report < _LOAD_REPORT_INTERVAL_S:
@@ -163,6 +222,7 @@ class Router:
         total = sum(len(v) for v in self._inflight.values()) + sum(
             self._inflight_streams.values()
         )
+        p95 = self._route_wait_p95()
         m = _metrics()
         if m is not None:
             # Replica saturation: this router's in-flight load over the
@@ -176,8 +236,12 @@ class Router:
             m["inflight"].set(total, tags)
             if capacity:
                 m["saturation"].set(total / capacity, tags)
+            if p95 is not None:
+                m["slo_p95"].set(p95, tags)
         try:
-            self._controller.report_load.remote(self._name, self._router_id, total)
+            self._controller.report_load.remote(
+                self._name, self._router_id, total, p95
+            )
         except Exception:
             pass
 
@@ -185,6 +249,38 @@ class Router:
         """A streaming call finished or was dropped: release its load unit.
         Lock-free (callable from __del__); applied at the next _sweep."""
         self._stream_done_q.append(replica_id)
+
+    def _maybe_shed_overload(self):
+        """Per-replica inflight cap (admission control's router half): when
+        EVERY replica is loaded past max_concurrent_queries * the cap
+        factor, queueing deeper only grows tail latency — shed instead.
+        Called under self._lock. Off by default (factor 0): the proxy's
+        per-app cap is the primary gate; this one bounds the router's own
+        books under direct-handle flood."""
+        from ray_tpu._private.config import get_config
+
+        cfg = get_config()
+        factor = float(cfg.serve_replica_inflight_cap_factor)
+        if factor <= 0:
+            return
+        from ray_tpu.serve._private.common import RequestShedded
+
+        for r in self._replicas:
+            cap = max(1, getattr(r, "max_concurrent_queries", 1)) * factor
+            if self._load_of(r.replica_id) < cap:
+                return
+        from ray_tpu._private import telemetry
+
+        if telemetry.metrics_enabled():
+            telemetry.serve_ingress_metrics()["shed"].inc(
+                1, {"app": self._name, "reason": "replica_inflight"}
+            )
+        raise RequestShedded(
+            f"all replicas of '{self._name}' at "
+            f"max_concurrent_queries x {factor:g}",
+            reason="replica_inflight",
+            retry_after_s=cfg.serve_retry_after_s,
+        )
 
     def route(self, method_name: str, args, kwargs, force_refresh: bool = False,
               stream: bool = False, raw_method: bool = False):
@@ -215,6 +311,7 @@ class Router:
             if not self._replicas:
                 raise RuntimeError(f"no replicas for deployment '{self._name}'")
             self._sweep()
+            self._maybe_shed_overload()
             chosen = None
             if model_id:
                 # Sticky model routing: the replica that served this model
@@ -280,13 +377,18 @@ class Router:
                 ref = handle.handle_request.remote(method_name, tuple(args), kwargs)
                 self._inflight.setdefault(chosen.replica_id, []).append(ref)
             self._report_load()
+        wait = time.perf_counter() - t_route
+        # Sampled regardless of enable_metrics: the SLO autoscaler needs the
+        # p95 signal even on a metrics-off runtime (append is O(1), bounded).
+        with self._samples_lock:
+            self._wait_samples.append((time.time(), wait))
         m = _metrics()
         if m is not None:
             tags = {"deployment": self._name}
             m["requests"].inc(1, tags)
             # Route wait: table fetch + lock + replica pick + submit — the
             # router-side queueing a request pays before reaching a replica.
-            m["route_wait"].observe(time.perf_counter() - t_route, tags)
+            m["route_wait"].observe(wait, tags)
         return ref, chosen.replica_id
 
     def report_failure(self, replica_id: str):

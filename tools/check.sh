@@ -40,6 +40,10 @@ echo "== data-plane smoke (peer-direct transfers, zero head relay) =="
 timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/dataplane_smoke.py
 
 echo
+echo "== serve ingress smoke (2-proxy fleet, burst->shed->recover, drain-on-stop) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
+echo
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

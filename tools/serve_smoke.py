@@ -1,0 +1,167 @@
+"""Serve ingress smoke for tools/check.sh: prove the multi-proxy front door
+works end-to-end on a 2-node mini-cluster, fast (~60s).
+
+Checks, in order:
+  1. controller-managed fleet: `serve.start(proxy_location="EveryNode")`
+     brings up one proxy per node, both route the app, and both appear in
+     the head's service directory (serve_proxy_up);
+  2. burst -> shed -> recover: a burst 4x past the per-app queue cap gets
+     some fast `503 + Retry-After` (shed, counted in the proxy's stats) and
+     ZERO hangs/5xx-other, then a single request succeeds again;
+  3. graceful drain-on-stop: a replica scale-down under live load completes
+     every admitted request (zero drops), and `drain_proxy` walks the wire
+     serve_drain/serve_drained pair — the proxy sheds with "draining",
+     leaves the directory, and its port stops answering.
+
+Exit 0 on success; any assertion/exception fails the check stage.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _get(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, dict(e.headers)
+
+
+def main() -> int:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        cluster.add_node(num_cpus=2)
+
+        @serve.deployment(
+            num_replicas=2, max_concurrent_queries=2, max_queued_requests=4
+        )
+        def app(request):
+            time.sleep(0.15)
+            return "ok"
+
+        serve.run(app.bind(), route_prefix="/app", _blocking_http=False)
+        serve.start(proxy_location="EveryNode")
+        # The controller's reconcile loop converges the fleet (a node that
+        # raced the first ensure_proxies pass gets its proxy within ~2s).
+        deadline = time.time() + 30
+        ports = []
+        while time.time() < deadline:
+            ports = sorted(
+                p for nid, p in serve.proxy_ports().items()
+                if nid != "head" and p
+            )
+            if len(ports) == 2:
+                break
+            time.sleep(0.5)
+        assert len(ports) == 2, f"expected one proxy per node: {ports}"
+        for p in ports:
+            status, _ = _get(f"http://127.0.0.1:{p}/app")
+            assert status == 200, f"proxy on :{p} cannot route /app"
+        from ray_tpu._private.worker import global_worker
+
+        directory = global_worker.context.serve_directory()
+        assert len(directory) >= 2, f"service directory: {directory}"
+        print(f"[serve_smoke] 2-proxy fleet up on ports {ports}, "
+              f"{len(directory)} directory entries")
+
+        # ---- burst -> shed -> recover ---------------------------------
+        target = ports[0]
+        url = f"http://127.0.0.1:{target}/app"
+        codes, lock = [], threading.Lock()
+
+        def fire():
+            t0 = time.monotonic()
+            status, headers = _get(url)
+            with lock:
+                codes.append((status, time.monotonic() - t0, headers))
+
+        burst = [threading.Thread(target=fire) for _ in range(16)]
+        for t in burst:
+            t.start()
+        for t in burst:
+            t.join()
+        got = [c for c, _t, _h in codes]
+        sheds = [(c, t, h) for c, t, h in codes if c == 503]
+        assert got.count(200) >= 4, f"admitted window lost: {got}"
+        assert sheds, f"burst 4x past the cap never shed: {got}"
+        assert all(c in (200, 503) for c in got), f"unexpected codes: {got}"
+        for _c, elapsed, headers in sheds:
+            assert "Retry-After" in headers, "shed without Retry-After"
+            assert elapsed < 1.0, f"slow shed ({elapsed:.2f}s)"
+        status, _ = _get(url)
+        assert status == 200, "no recovery after the burst"
+        print(f"[serve_smoke] burst: {got.count(200)} ok / "
+              f"{len(sheds)} fast sheds, recovered")
+
+        # ---- graceful drain on replica stop --------------------------
+        results, errors = [], []
+
+        def call():
+            try:
+                status, _ = _get(url, timeout=60)
+                results.append(status)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        live = [threading.Thread(target=call) for _ in range(4)]
+        for t in live:
+            t.start()
+        time.sleep(0.05)
+        serve.run(  # scale 2 -> 1 mid-load: drain, don't drop
+            app.options(num_replicas=1).bind(),
+            route_prefix="/app", _blocking_http=False,
+        )
+        for t in live:
+            t.join()
+        assert not errors, f"admitted requests dropped in drain: {errors}"
+        assert all(c in (200, 503) for c in results), results
+        assert results.count(200) >= 1, results
+        print(f"[serve_smoke] scale-down under load: {results} (no drops)")
+
+        # ---- wire drain of one proxy ---------------------------------
+        controller = serve.api._get_controller()
+        proxies = ray_tpu.get(controller.get_proxies.remote())
+        nid = sorted(proxies)[0]
+        drained_port = proxies[nid]["port"]
+        result = ray_tpu.get(
+            controller.drain_proxy.remote(nid, 10.0), timeout=30
+        )
+        assert result["ok"], f"proxy drain failed: {result}"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            directory = global_worker.context.serve_directory()
+            if not any(e.get("port") == drained_port for e in directory):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"drained proxy still listed: {directory}")
+        survivor = [p for p in ports if p != drained_port][0]
+        status, _ = _get(f"http://127.0.0.1:{survivor}/app")
+        assert status == 200, "survivor proxy stopped serving after drain"
+        print(f"[serve_smoke] proxy :{drained_port} drained off the wire; "
+              f"survivor :{survivor} still serving")
+
+        serve.shutdown()
+        print("[serve_smoke] OK")
+        return 0
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
