@@ -285,6 +285,33 @@ class Config:
     # most this often even when iterating per-message under load.
     internal_metrics_interval_s: float = 0.25
 
+    # --- watch-it-over-time layer (_private/timeseries.py, gated by
+    # enable_metrics: knob off = no store, no alert evaluation, no cluster
+    # events, zero extra protocol traffic) ---
+    # Sub-knob under enable_metrics: keep instantaneous metrics but drop the
+    # history/alerting layer (no ObsState, no event recording). Effective
+    # only while enable_metrics is on; also the bench seam that prices THIS
+    # layer alone (task_throughput_obs_ratio) instead of re-pricing the
+    # whole metrics pipeline.
+    enable_obs: bool = True
+    # Minimum spacing between stored samples per series. Samples arriving
+    # faster (per-process registries flush at ~1 Hz each) merge into the
+    # newest stored point instead of appending.
+    obs_series_step_s: float = 1.0
+    # How far back the head keeps samples; the per-series ring holds
+    # retention/step points and evicts the oldest beyond that.
+    obs_series_retention_s: float = 600.0
+    # Label-set cap: total distinct (name, tags, pid) series the store will
+    # track. New series beyond the cap are dropped (and counted) instead of
+    # growing head memory without bound.
+    obs_max_series: int = 4000
+    # Bounded cluster-event ring in the GCS (persisted with --persist, so the
+    # event history survives a head restart).
+    cluster_event_cap: int = 10000
+    # Alert-rule evaluation cadence on the scheduler loop (the flush-cadence
+    # analogue; rules see samples ingested from the per-process KV flushes).
+    alert_eval_interval_s: float = 1.0
+
     # --- collective ---
     # Rendezvous wait ceiling for collective group formation (KV-based
     # barrier in util/collective/rendezvous.py).

@@ -101,6 +101,17 @@ class GCS:
         # event per append, O(1), instead of periodic bulk head-drops.
         self._task_event_cap = 100000
         self.task_events: "deque[TaskEvent]" = deque(maxlen=self._task_event_cap)
+        # Cluster event log (events.py): severity-tagged runtime transitions
+        # (node lifecycle, worker crashes, scale decisions, Serve changes,
+        # alert edges) in a bounded ring that rides the GCS snapshot, so the
+        # event history survives a head restart under --persist. Entries are
+        # plain tuples (ts, severity, kind, source, message, data_dict);
+        # dicts materialize at read time (cluster_event_list).
+        self._cluster_event_cap = 10000
+        self.cluster_events: "deque[tuple]" = deque(maxlen=self._cluster_event_cap)
+        # Monotonic append count (never decremented by ring eviction): the
+        # head's telemetry exports it as ray_tpu_obs_events_total.
+        self.cluster_events_total = 0
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
 
     # --- internal KV (reference: GcsKvManager / experimental.internal_kv) ---
@@ -115,6 +126,16 @@ class GCS:
 
     def kv_keys(self, prefix: bytes, namespace: str = "default") -> List[bytes]:
         return self.store.keys(f"kv:{namespace}", prefix)
+
+    def kv_event(self, payload: tuple) -> bool:
+        """Remote cluster-event append riding the existing kv command
+        (`ctx.kv("event", (kind, message, severity, source, data, ts))`), so
+        non-head processes (Serve controller, autoscaler monitor) emit events
+        with no new wire tag. See events.emit_event."""
+        kind, message, severity, source, data, ts = payload
+        self.append_cluster_event(kind, message, severity=severity,
+                                  source=source, data=data, ts=ts)
+        return True
 
     # --- pubsub (reference: src/ray/pubsub) ---
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
@@ -153,6 +174,48 @@ class GCS:
             for (t, n, s, ts, st) in self.task_events
         ]
 
+    # --- cluster events (events.py; reference: the GCS error/event tables) ---
+    def set_cluster_event_cap(self, cap: int) -> None:
+        cap = max(1, int(cap))
+        if cap != self._cluster_event_cap:
+            self._cluster_event_cap = cap
+            self.cluster_events = deque(self.cluster_events, maxlen=cap)
+
+    def append_cluster_event(self, kind: str, message: str,
+                             severity: str = "info", source: str = "head",
+                             data: Optional[Dict[str, Any]] = None,
+                             ts: Optional[float] = None) -> None:
+        from ray_tpu._private.events import SEVERITIES
+
+        # Normalize unknown severities (a typo'd "warn" would otherwise
+        # create an unfilterable level) instead of dropping the event.
+        if severity not in SEVERITIES:
+            severity = "info"
+        self.cluster_events.append((
+            float(ts) if ts is not None else time.time(),
+            str(severity), str(kind), str(source), str(message),
+            dict(data or {}),
+        ))
+        self.cluster_events_total += 1
+
+    def cluster_event_list(self, limit: Optional[int] = None,
+                           kind: Optional[str] = None,
+                           severity: Optional[str] = None,
+                           since: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Newest-last event dicts, optionally filtered. `limit` keeps the
+        newest N *after* filtering."""
+        out = [
+            {"ts": ts, "severity": sev, "kind": k, "source": src,
+             "message": msg, "data": dict(d)}
+            for (ts, sev, k, src, msg, d) in self.cluster_events
+            if (kind is None or k == kind)
+            and (severity is None or sev == severity)
+            and (since is None or ts >= since)
+        ]
+        if limit is not None and limit >= 0:
+            out = out[-int(limit):]
+        return out
+
     # --- persistence (reference: RedisStoreClient-backed GCS fault tolerance,
     # `store_client/redis_store_client.h:28`, restore at `gcs_server.cc:59`) ---
     def snapshot_bytes(self) -> bytes:
@@ -182,6 +245,10 @@ class GCS:
             "store": data,
             "functions": _copy(self.function_table),
             "detached_actors": _copy(self.detached_actors),
+            # Event history survives head restarts: operators debugging a
+            # crash need the transitions that led up to it, not a fresh ring.
+            "cluster_events": list(self.cluster_events),
+            "cluster_events_total": self.cluster_events_total,
         })
 
     def restore_bytes(self, blob: bytes) -> None:
@@ -192,6 +259,9 @@ class GCS:
             self.store._data = {t: dict(kv) for t, kv in payload["store"].items()}
         self.function_table.update(payload.get("functions", {}))
         self.detached_actors.update(payload.get("detached_actors", {}))
+        for ev in payload.get("cluster_events", ()):
+            self.cluster_events.append(ev)
+        self.cluster_events_total += int(payload.get("cluster_events_total", 0))
 
     def save_to(self, path: str) -> None:
         import os

@@ -75,6 +75,11 @@ def main() -> None:
 
     gcs = GCS()
     if ns.persist and gcs.load_from(ns.persist):
+        # Every process of the previous incarnation is gone: its metrics/span
+        # snapshots would sit frozen in every future /metrics exposition.
+        for prefix in (b"metrics::", b"spans::"):
+            for key in gcs.kv_keys(prefix):
+                gcs.kv_del(key)
         # Jobs that were in flight when the previous head died have no live
         # supervisor anymore: fail them (the reference marks in-flight jobs
         # failed on GCS recovery).

@@ -108,6 +108,9 @@ class NodeDaemon:
                         "labels": self.labels,
                         "shm_dir": self.shm_dir,
                         "data_address": data_address,
+                        # The head prunes this process's metrics::/spans:: KV
+                        # snapshots (and its stored series) when the node dies.
+                        "pid": os.getpid(),
                     },
                 )
             )

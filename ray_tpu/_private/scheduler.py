@@ -136,6 +136,9 @@ class DaemonHandle(_ConnSender):
     def __init__(self, node_id: NodeID, conn):
         super().__init__(conn)
         self.node_id = node_id
+        # OS pid from the registration info (None for legacy daemons): the
+        # death hooks prune this process's metrics::/spans:: KV snapshots.
+        self.pid = None
 
 
 class DriverHandle(_ConnSender):
@@ -154,6 +157,9 @@ class DriverHandle(_ConnSender):
         self.pull_node_id = pull_node_id
         # Identity under which this driver's ObjectRefs are counted.
         self.holder_id = "driver-" + os.urandom(4).hex()
+        # OS pid from the attach info (None for legacy drivers): death-time
+        # pruning of this process's metrics::/spans:: KV snapshots + series.
+        self.pid = None
 
 
 @dataclass
@@ -185,6 +191,10 @@ class WorkerHandle:
     # never a kill signal; a GIL-bound compile must not get its worker shot).
     last_heartbeat: float = field(default_factory=time.time)
     health: str = "ALIVE"
+    # Real OS pid from the worker's ("register", id, pid) hello. process.pid
+    # is -1 for daemon-managed workers (_RemoteProc), so death-time pruning
+    # of metrics::<pid>/spans::<pid> must use THIS, not the process surface.
+    os_pid: Optional[int] = None
     # Flight-recorder stack dump auto-captured at the ALIVE -> SUSPECT
     # transition (or {"dump": {"transport": "unavailable", ...}} when the
     # process couldn't answer) — surfaced on the node's worker entries in
@@ -597,6 +607,15 @@ class Scheduler:
         from ray_tpu._private.telemetry import SchedulerTelemetry
 
         self.telemetry = SchedulerTelemetry(config)
+        # Watch-it-over-time layer (timeseries.py): the head-side series
+        # store + alert engine, fed by the metrics:: KV flushes the _cmd_kv
+        # handler already sees. None when metrics are off — the knob-off
+        # contract is that NOTHING observability-shaped exists.
+        self.obs = None
+        if config.enable_metrics and config.enable_obs:
+            from ray_tpu._private.timeseries import ObsState
+
+            self.obs = ObsState(config, gcs)
         self.nodes: Dict[NodeID, NodeState] = {}
         self.node_order: List[NodeID] = []
         self.object_table: Dict[bytes, ObjectMeta] = {}
@@ -858,6 +877,12 @@ class Scheduler:
             return False
         self._conn_to_worker[conn] = wh
         self._watch_conn(conn)
+        self._emit_event(
+            "worker_started",
+            f"worker {worker_id_hex[:8]} (pid "
+            f"{getattr(wh.process, 'pid', None)}) connected",
+            worker_id=worker_id_hex, node_id=wh.node_id.hex(),
+        )
         return True
 
     def _cmd_attach_daemon(self, payload):
@@ -875,12 +900,21 @@ class Scheduler:
             data_address=info.get("data_address"),
         )
         daemon = DaemonHandle(node_id, conn)
+        # Daemon's OS pid (registration info): worker/daemon metrics flush
+        # under `metrics::<pid>`, and the death hooks prune by that key.
+        daemon.pid = info.get("pid")
         node.daemon = daemon
         self.nodes[node_id] = node
         self.node_order.append(node_id)
         self._conn_to_daemon[conn] = daemon
         self._watch_conn(conn)
         self._pull_sources[node_id.binary()] = daemon
+        self._emit_event(
+            "node_added",
+            f"node {node_id.hex()[:8]} joined with "
+            f"{resources.get('CPU', 0):g} CPU / {resources.get('TPU', 0):g} TPU",
+            node_id=node_id.hex(), resources=dict(resources),
+        )
         daemon.send(
             (
                 "ok",
@@ -900,6 +934,7 @@ class Scheduler:
         info, conn = payload
         pull_hex = info.get("pull_node_id")
         dh = DriverHandle(conn, bytes.fromhex(pull_hex) if pull_hex else None)
+        dh.pid = info.get("pid")
         self._conn_to_driver[conn] = dh
         self._watch_conn(conn)
         self._holder_to_driver[dh.holder_id] = dh
@@ -944,6 +979,9 @@ class Scheduler:
     @loop_thread_only
     def _on_driver_death(self, dh: DriverHandle):
         self._drop_outbound(dh)
+        # A departed driver's frozen snapshots (e.g. its Serve-router p95
+        # gauge) must not keep a gauge-based alert latched forever.
+        self._prune_dead_process(dh.pid)
         self._conn_to_driver.pop(dh.conn, None)
         self._unwatch_conn(dh.conn)
         self._holder_to_driver.pop(dh.holder_id, None)
@@ -980,6 +1018,10 @@ class Scheduler:
         except Exception:
             pass
         self._stopped.set()
+        if self.obs is not None:
+            # Unhook the registry's local flush sink: a later cluster in this
+            # process must not flush into this dead GCS/store.
+            self.obs.close()
         self._transfer.close()
         for listener in (self._listener, self._tcp_listener):
             try:
@@ -1243,6 +1285,10 @@ class Scheduler:
             # Telemetry snapshot: self-gated by internal_metrics_interval_s,
             # so a loop spinning per-message never pays per-iteration gauges.
             self.telemetry.on_iteration(self, now)
+            # Alert evaluation + obs self-gauges: self-gated by
+            # alert_eval_interval_s; absent entirely when metrics are off.
+            if self.obs is not None:
+                self.obs.on_iteration(self, now)
             if self._delayed_retries:
                 due = [x for x in self._delayed_retries if x[0] <= now]
                 if due:
@@ -1526,7 +1572,14 @@ class Scheduler:
         if node is None:
             return False
         node.alive = False
+        self._emit_event(
+            "node_removed",
+            f"node {node_id.hex()[:8]} removed "
+            f"({len(node.workers)} worker(s) terminated)",
+            node_id=node_id.hex(),
+        )
         if node.daemon is not None:
+            self._prune_dead_process(getattr(node.daemon, "pid", None))
             node.daemon.send(("shutdown",))
             self._conn_to_daemon.pop(node.daemon.conn, None)
             self._unwatch_conn(node.daemon.conn)
@@ -1568,7 +1621,9 @@ class Scheduler:
                 "workers": [
                     {
                         "worker_id": w.worker_id.hex(),
-                        "pid": w.process.pid,
+                        # os_pid = the register hello's real pid (process.pid
+                        # is -1 for daemon-managed workers).
+                        "pid": w.os_pid or w.process.pid,
                         "state": w.state,
                         "health": w.health,
                         "actor_id": w.actor_id.hex() if w.actor_id else None,
@@ -1709,6 +1764,19 @@ class Scheduler:
     @loop_thread_only
     def _on_worker_death(self, wh: WorkerHandle):
         self._drop_outbound(wh)
+        # os_pid comes from the worker's register hello; process.pid is the
+        # fallback for workers that died before registering (local spawns
+        # only — _RemoteProc reports -1, which the helper ignores).
+        pid = wh.os_pid or getattr(wh.process, "pid", None)
+        self._prune_dead_process(pid)
+        self._emit_event(
+            "worker_dead",
+            f"worker {wh.worker_id.hex()[:8]} (pid {pid}) died"
+            + (f" while running actor {wh.actor_id.hex()[:8]}"
+               if wh.actor_id else ""),
+            severity="warning", worker_id=wh.worker_id.hex(), pid=pid,
+            node_id=wh.node_id.hex(),
+        )
         node = self.nodes.get(wh.node_id)
         if node is not None:
             node.workers.pop(wh.worker_id, None)
@@ -1994,10 +2062,24 @@ class Scheduler:
                         ),
                     },
                 )
+                self._emit_event(
+                    "node_dead",
+                    f"node {node.node_id.hex()[:8]} declared DEAD: no "
+                    f"heartbeat for {stale:.1f}s (grace {grace:.1f}s)",
+                    severity="error", node_id=node.node_id.hex(),
+                    stale_s=round(stale, 3),
+                )
                 self._on_daemon_death(node.daemon)
             elif stale > suspect_after and node.health == "ALIVE":
                 node.health = "SUSPECT"
                 tel.hb_suspect_daemon += 1
+                self._emit_event(
+                    "node_suspect",
+                    f"node {node.node_id.hex()[:8]} marked SUSPECT: no "
+                    f"heartbeat for {stale:.1f}s",
+                    severity="warning", node_id=node.node_id.hex(),
+                    stale_s=round(stale, 3),
+                )
                 # Flight recorder: grab a stack dump the MOMENT the process
                 # goes quiet — by DEAD time there may be nothing left to ask.
                 self._capture_flight_recorder(
@@ -2012,6 +2094,13 @@ class Scheduler:
             if now - wh.last_heartbeat > suspect_after and wh.health == "ALIVE":
                 wh.health = "SUSPECT"
                 tel.hb_suspect_worker += 1
+                self._emit_event(
+                    "worker_suspect",
+                    f"worker {wh.worker_id.hex()[:8]} (pid "
+                    f"{getattr(wh.process, 'pid', None)}) marked SUSPECT "
+                    "(observational: EOF/liveness stay the kill signals)",
+                    severity="warning", worker_id=wh.worker_id.hex(),
+                )
                 self._capture_flight_recorder(
                     f"worker:{wh.worker_id.hex()}",
                     wh,
@@ -2078,6 +2167,10 @@ class Scheduler:
             # SPAWN, and a slow cold start (interpreter + imports) must not
             # count as silence — the first beat is one period away from HERE.
             wh.last_heartbeat = time.time()
+            # Real OS pid (process.pid is -1 for daemon-managed workers):
+            # death-time metrics/series pruning keys on it.
+            if len(msg) > 2:
+                wh.os_pid = msg[2]
             return
         if kind == "heartbeat":
             wh.last_heartbeat = time.time()
@@ -2287,6 +2380,30 @@ class Scheduler:
                 self._store_error_results(rec, err)
             except Exception:
                 traceback.print_exc()
+
+    # ----------------------------------------------------------- cluster events
+    def _prune_dead_process(self, pid) -> None:
+        """Observability teardown for a departed process (worker, daemon, or
+        client driver): delete its frozen `metrics::<pid>`/`spans::<pid>` KV
+        snapshots — they would ride every future /metrics exposition forever
+        — and drop its series from the time-series store (a frozen gauge
+        would otherwise keep carrying forward into alert evaluation)."""
+        if not pid or pid < 0:  # unknown / _RemoteProc's -1 placeholder
+            return
+        self.gcs.kv_del(f"metrics::{pid}".encode())
+        self.gcs.kv_del(f"spans::{pid}".encode())
+        if self.obs is not None:
+            self.obs.prune_process(str(pid))
+
+    def _emit_event(self, kind: str, message: str, severity: str = "info",
+                    **data) -> None:
+        """Head-side cluster-event append (events.py kinds; the scheduler's
+        seams call this directly — no command hop, no traffic). Gated with
+        the rest of the over-time layer (enable_metrics + enable_obs)."""
+        if self.obs is None:
+            return
+        self.gcs.append_cluster_event(kind, message, severity=severity,
+                                      source="head", data=data)
 
     # ------------------------------------------------------------------ pubsub
     def _publish(self, channel: str, payload: dict) -> None:
@@ -2954,6 +3071,12 @@ class Scheduler:
         meta.spilled = True
         self.telemetry.spill_ops += 1
         self.telemetry.spilled_bytes += meta.size
+        self._emit_event(
+            "object_spilled",
+            f"object {meta.object_id.hex()[:8]} ({meta.size} B) spilled to "
+            "disk (store at capacity)",
+            object_id=meta.object_id.hex(), bytes=meta.size,
+        )
         return True
 
     def _alias_error_meta(self, oid: ObjectID, err: ObjectMeta) -> ObjectMeta:
@@ -3300,6 +3423,16 @@ class Scheduler:
 
     def _cmd_kv(self, payload):
         op, args = payload
+        if (
+            self.obs is not None
+            and op == "put"
+            and args
+            and args[0][:9] == b"metrics::"
+        ):
+            # Every per-process registry flush already lands here — folding
+            # it into the time-series store makes history free of extra
+            # protocol traffic (the ingestion cadence IS the flush cadence).
+            self.obs.ingest_kv(args[0], args[1])
         return getattr(self.gcs, "kv_" + op)(*args)
 
     def _cmd_create_pg(self, payload):
@@ -3730,7 +3863,8 @@ class Scheduler:
             "task_latency", "list_actors", "list_tasks", "list_objects",
             "get_nodes", "add_node", "remove_node", "autoscaler_state",
             "memory_summary", "transfer_stats", "serve_directory",
-            "serve_actor_inflight",
+            "serve_actor_inflight", "query_series", "cluster_events",
+            "list_alerts", "obs_stats",
         }
     )
 
@@ -3844,6 +3978,36 @@ class Scheduler:
             s.discard(node_id)
             if not s:
                 del self.object_replicas[key]
+
+    # --------------------------------------------------- observability queries
+    def _cmd_query_series(self, payload):
+        """Time-series readout (state.query_series / /api/series / CLI).
+        Raises when the obs layer is off — a silent empty answer would read
+        as "no traffic", which is the opposite of the truth."""
+        if self.obs is None:
+            raise RuntimeError(
+                "time-series store disabled "
+                "(enable_metrics=False or enable_obs=False)"
+            )
+        return self.obs.query(payload)
+
+    def _cmd_cluster_events(self, payload):
+        """Cluster event log (state.list_cluster_events / /api/events / CLI).
+        Served from the GCS ring regardless of the metrics knob: restored
+        history from --persist stays readable even in a metrics-off boot."""
+        return self.gcs.cluster_event_list(**(payload or {}))
+
+    def _cmd_list_alerts(self, _):
+        if self.obs is None:
+            return []
+        return self.obs.engine.payload()
+
+    def _cmd_obs_stats(self, _):
+        if self.obs is None:
+            return {"enabled": False}
+        out = self.obs.stats()
+        out["enabled"] = True
+        return out
 
     def _cmd_transfer_stats(self, _):
         """Data-plane introspection: cumulative relay/locality counters (the

@@ -24,6 +24,15 @@ def metrics_enabled() -> bool:
     return bool(get_config().enable_metrics)
 
 
+def obs_enabled() -> bool:
+    """The over-time layer (time-series store / cluster events / alerts):
+    enable_metrics is the master switch, enable_obs the sub-knob."""
+    from ray_tpu._private.config import get_config
+
+    cfg = get_config()
+    return bool(cfg.enable_metrics and cfg.enable_obs)
+
+
 # Bucket boundaries for control-plane latency histograms: sub-ms to tens of
 # seconds (queue waits under load can be long).
 _LATENCY_BUCKETS = (
@@ -93,6 +102,12 @@ class SchedulerTelemetry:
         m["objects"].set(len(sched.object_table))
         m["object_bytes"].set(float(sum(sched.node_usage.values())))
         m["tasks"].set(len(sched.tasks))
+        # Live SUSPECT count (not the cumulative transition counter): the
+        # suspect_nodes alert rule needs a level, not an edge count.
+        m["suspect_nodes"].set(float(sum(
+            1 for n in sched.nodes.values()
+            if n.alive and n.health == "SUSPECT"
+        )))
         self._drain_counter(m["submitted"], "submitted")
         self._drain_counter(m["dispatched"], "dispatched")
         self._drain_counter(m["retried"], "retried")
@@ -185,6 +200,9 @@ class SchedulerTelemetry:
                                 "control messages coalesced by the scheduler loop"),
             "out_frames": Counter("ray_tpu_scheduler_outbound_frames_total",
                                   "frames the scheduler loop actually wrote"),
+            "suspect_nodes": Gauge(
+                "ray_tpu_cluster_suspect_nodes",
+                "nodes currently heartbeat-SUSPECT (level, not edge count)"),
             "hb_suspect": Counter("ray_tpu_heartbeat_suspect_total",
                                   "peers marked SUSPECT by the heartbeat "
                                   "staleness detector", ("kind",)),

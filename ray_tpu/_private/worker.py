@@ -554,6 +554,18 @@ class DriverContext:
     def task_latency(self):
         return self.scheduler.call("task_latency", None).result()
 
+    def query_series(self, payload):
+        return self.scheduler.call("query_series", payload).result()
+
+    def cluster_events(self, payload=None):
+        return self.scheduler.call("cluster_events", payload).result()
+
+    def list_alerts(self):
+        return self.scheduler.call("list_alerts", None).result()
+
+    def obs_stats(self):
+        return self.scheduler.call("obs_stats", None).result()
+
     def list_actors(self):
         return self.scheduler.call("list_actors", None).result()
 
@@ -812,6 +824,18 @@ class RemoteDriverContext:
     def task_latency(self):
         return self.wc.request("driver_cmd", ("task_latency", None))
 
+    def query_series(self, payload):
+        return self.wc.request("driver_cmd", ("query_series", payload))
+
+    def cluster_events(self, payload=None):
+        return self.wc.request("driver_cmd", ("cluster_events", payload))
+
+    def list_alerts(self):
+        return self.wc.request("driver_cmd", ("list_alerts", None))
+
+    def obs_stats(self):
+        return self.wc.request("driver_cmd", ("obs_stats", None))
+
     def list_actors(self):
         return self.wc.request("driver_cmd", ("list_actors", None))
 
@@ -1004,6 +1028,18 @@ class WorkerProcContext:
 
     def task_latency(self):
         return self.rt.wc.request("driver_cmd", ("task_latency", None))
+
+    def query_series(self, payload):
+        return self.rt.wc.request("driver_cmd", ("query_series", payload))
+
+    def cluster_events(self, payload=None):
+        return self.rt.wc.request("driver_cmd", ("cluster_events", payload))
+
+    def list_alerts(self):
+        return self.rt.wc.request("driver_cmd", ("list_alerts", None))
+
+    def obs_stats(self):
+        return self.rt.wc.request("driver_cmd", ("obs_stats", None))
 
     def list_actors(self):
         return self.rt.wc.request("driver_cmd", ("list_actors", None))
@@ -1242,7 +1278,12 @@ def _init_client_mode(address: str, namespace: Optional[str],
     authkey = bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY_HEX", ""))
     conn = dial(address, authkey)
     pull_node_id = NodeID.from_random()
-    conn.send_bytes(serialization.dumps(("driver", {"pull_node_id": pull_node_id.hex()})))
+    conn.send_bytes(serialization.dumps(("driver", {
+        "pull_node_id": pull_node_id.hex(),
+        # The head prunes this process's metrics::/spans:: KV snapshots (and
+        # its stored series) when the driver disconnects.
+        "pid": os.getpid(),
+    })))
     reply = serialization.loads(conn.recv_bytes())
     if reply[0] != "ok":
         raise ConnectionError(f"head rejected driver connection: {reply!r}")

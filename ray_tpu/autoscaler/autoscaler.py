@@ -130,6 +130,27 @@ class StandardAutoscaler:
             counts[ntype] -= 1
             terminated.append(nid)
 
+        # Scale decisions land in the cluster event log (no-op with metrics
+        # off; never raises — a full event ring must not stall scaling).
+        if launched:
+            from ray_tpu._private.events import emit_event
+
+            emit_event(
+                "autoscaler_scale_up",
+                f"autoscaler launched {len(launched)} node(s): "
+                + ", ".join(f"{t}:{nid[:8]}" for t, nid in launched),
+                source="autoscaler", launched=[t for t, _ in launched],
+                unmet_demands=len(unmet),
+            )
+        if terminated:
+            from ray_tpu._private.events import emit_event
+
+            emit_event(
+                "autoscaler_scale_down",
+                f"autoscaler terminated {len(terminated)} idle node(s)",
+                source="autoscaler",
+                terminated=[nid[:8] for nid in terminated],
+            )
         return {"launched": launched, "terminated": terminated}
 
     def _count_by_type(self) -> Dict[str, int]:

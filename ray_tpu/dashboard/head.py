@@ -19,8 +19,9 @@ class DashboardServer:
 
     # Every kind `/api/{kind}` serves; the 404 for anything else lists them.
     VALID_KINDS = (
-        "actors", "cluster", "jobs", "memory", "nodes", "objects", "profile",
-        "serve", "stacks", "tasks", "timeline",
+        "actors", "alerts", "cluster", "events", "jobs", "memory", "nodes",
+        "objects", "profile", "serve", "series", "stacks", "tasks",
+        "timeline",
     )
     # Ceiling on `/api/profile?duration=` (the handler blocks an executor
     # thread for the duration).
@@ -58,11 +59,47 @@ class DashboardServer:
             out["apps"] = {app: out["apps"][app]}
         return out
 
-    def _payload(self, kind: str, limit: Optional[int] = None,
-                 duration: Optional[float] = None,
-                 app: Optional[str] = None):
+    def _obs_payload(self, kind: str, limit: Optional[int], query: dict):
+        """Time-series / event-log / alert views. Bad caller input raises
+        ValueError -> JSON 400 (the limit/duration convention)."""
         from ray_tpu.util import state as state_api
 
+        if kind == "alerts":
+            return state_api.list_alerts()
+        if kind == "events":
+            return state_api.list_cluster_events(
+                limit=limit,
+                kind=query.get("kind") or None,
+                severity=query.get("severity") or None,
+                since=float(query["since"]) if query.get("since") else None,
+            )
+        # kind == "series"
+        name = query.get("name")
+        if not name:
+            raise ValueError("series needs ?name=<metric>")
+        labels = None
+        if query.get("labels"):
+            labels = json.loads(query["labels"])
+            if not isinstance(labels, dict):
+                raise ValueError("labels must be a JSON object")
+        return state_api.query_series(
+            name,
+            labels=labels,
+            since=float(query["since"]) if query.get("since") else None,
+            until=float(query["until"]) if query.get("until") else None,
+            step=float(query["step"]) if query.get("step") else None,
+            agg=query.get("agg", "sum"),
+            q=float(query["q"]) if query.get("q") else None,
+        )
+
+    def _payload(self, kind: str, limit: Optional[int] = None,
+                 duration: Optional[float] = None,
+                 app: Optional[str] = None,
+                 query: Optional[dict] = None):
+        from ray_tpu.util import state as state_api
+
+        if kind in ("series", "events", "alerts"):
+            return self._obs_payload(kind, limit, query or {})
         if kind == "serve":
             return self._serve_payload(app)
         if kind == "cluster":
@@ -131,8 +168,13 @@ class DashboardServer:
         loop = asyncio.get_event_loop()
         try:
             payload = await loop.run_in_executor(
-                None, self._payload, kind, limit, duration, app
+                None, self._payload, kind, limit, duration, app,
+                dict(request.query),
             )
+        except ValueError as e:
+            # Caller-shaped input error on the obs endpoints (bad ?name=,
+            # non-numeric ?since=, malformed ?labels= JSON).
+            return web.json_response({"error": str(e)}, status=400)
         except KeyError as e:
             if kind == "serve" and app is not None:
                 # /api/serve?app=<unknown>: caller error, not service failure.

@@ -9,6 +9,16 @@
                      Metric methods (.inc/.observe) directly — hot paths bump
                      plain ints; materialization belongs in telemetry.py at
                      snapshot cadence
+  M4 alert-rule      a DEFAULT_ALERT_RULES entry (timeseries.py, parsed as a
+                     pure literal) whose rule name or referenced metric name
+                     is missing from the COMPONENTS.md Observability tables —
+                     a stale rule name fails the run (the failpoint-table
+                     discipline, applied to the alert pack)
+  M5 event-kind      a cluster-event kind that is either used at an emit
+                     site (emit_event / _emit_event / append_cluster_event
+                     with a literal kind) without being registered in
+                     events.EVENT_KINDS, or registered but missing from the
+                     COMPONENTS.md events table
 
 `.set()` is not policed: the name collides with threading.Event.set, and the
 import ban (M3) already keeps Metric objects out of hot modules entirely.
@@ -44,6 +54,27 @@ DEFAULT_HOT_MODULES = (
 
 _METRIC_METHODS = {"inc", "observe"}
 
+# Cluster-event emit sites whose first positional arg (the kind) is checked
+# against events.EVENT_KINDS. Variable-kind forwarding (GCS.kv_event, the
+# alert engine's sink) passes non-literals and is skipped by construction.
+_EVENT_EMIT_FUNCS = {"emit_event", "_emit_event", "append_cluster_event"}
+
+
+def _literal_assign(tree: ast.AST, var: str):
+    """The pure-literal value assigned to module-level `var`, or None. Same
+    contract as protocol.MESSAGE_GRAMMAR: parsed with ast.literal_eval so
+    the linter never imports the runtime."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name) and target.id == var:
+                try:
+                    return ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return None
+    return None
+
 
 def _doc_text(doc_path: Optional[str]) -> Optional[str]:
     if doc_path and os.path.exists(doc_path):
@@ -58,6 +89,47 @@ def run(pkg: Package, hot_modules=DEFAULT_HOT_MODULES,
     violations: List[Violation] = []
     if doc_text is None:
         doc_text = _doc_text(doc_path)
+
+    # Registries for M4/M5, parsed as literals from their home modules
+    # (absent in fixture packages: the checks simply don't arm).
+    alert_rules = None
+    event_kinds = None
+    for module, tree in pkg.modules.items():
+        if module.endswith("_private.timeseries"):
+            alert_rules = _literal_assign(tree, "DEFAULT_ALERT_RULES")
+            _ts_path = pkg.paths[module]
+        if module.endswith("_private.events"):
+            event_kinds = _literal_assign(tree, "EVENT_KINDS")
+            _ev_path = pkg.paths[module]
+    if alert_rules and doc_text is not None:
+        for rule in alert_rules:
+            if not isinstance(rule, dict):
+                continue
+            rname = rule.get("name", "?")
+            if rname not in doc_text:
+                violations.append(Violation(
+                    "metrics", _ts_path, 1,
+                    make_key("metrics", _ts_path, f"alert-rule.{rname}"),
+                    f"default alert rule {rname!r} is not listed in the "
+                    f"COMPONENTS.md alert-pack table",
+                ))
+            metric = rule.get("metric", "")
+            if metric and metric not in doc_text:
+                violations.append(Violation(
+                    "metrics", _ts_path, 1,
+                    make_key("metrics", _ts_path, f"alert-metric.{metric}"),
+                    f"alert rule {rname!r} references metric {metric!r}, "
+                    f"which is not in the COMPONENTS.md Observability table",
+                ))
+    if event_kinds and doc_text is not None:
+        for kind in event_kinds:
+            if kind not in doc_text:
+                violations.append(Violation(
+                    "metrics", _ev_path, 1,
+                    make_key("metrics", _ev_path, f"event-kind.{kind}"),
+                    f"event kind {kind!r} is registered in EVENT_KINDS but "
+                    f"missing from the COMPONENTS.md events table",
+                ))
 
     reported: Set[str] = set()
     for module, tree in pkg.modules.items():
@@ -88,6 +160,24 @@ def run(pkg: Package, hot_modules=DEFAULT_HOT_MODULES,
             if not isinstance(node, ast.Call):
                 continue
             recv, meth = call_name(node)
+            if (
+                event_kinds is not None
+                and meth in _EVENT_EMIT_FUNCS
+                and node.args
+                and not module.endswith("_private.events")
+            ):
+                kind = const_str(node.args[0])
+                if kind is not None and kind not in event_kinds:
+                    key = make_key("metrics", path,
+                                   f"event-unregistered.{kind}")
+                    if key not in reported:
+                        reported.add(key)
+                        violations.append(Violation(
+                            "metrics", path, node.lineno, key,
+                            f"event kind {kind!r} is not registered in "
+                            f"events.EVENT_KINDS (register it there AND in "
+                            f"the COMPONENTS.md events table)",
+                        ))
             if hot and meth in _METRIC_METHODS and recv is not None:
                 key = make_key("metrics", path, f"hot-call.{recv}.{meth}")
                 if key not in reported:

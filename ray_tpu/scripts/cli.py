@@ -232,6 +232,146 @@ def cmd_profile(ns):
         print(text)
 
 
+# ------------------------------------------------------------ observability
+def cmd_events(ns):
+    """Cluster event log: node lifecycle, worker crashes, scale decisions,
+    Serve changes, alert fire/resolve (newest last)."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    events = state_api.list_cluster_events(
+        limit=ns.limit, kind=ns.kind, severity=ns.severity
+    )
+    if ns.json:
+        print(json.dumps(events, indent=2, default=str))
+        return
+    for e in events:
+        stamp = time.strftime("%H:%M:%S", time.localtime(e["ts"]))
+        extra = f"  {e['data']}" if e.get("data") else ""
+        print(f"{stamp}  {e['severity']:<8} {e['kind']:<24} "
+              f"[{e['source']}] {e['message']}{extra}")
+    if not events:
+        print("(no events)")
+
+
+def cmd_series(ns):
+    """Query the head's time-series store: counter rates, gauge levels, or
+    histogram quantiles over time."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    res = state_api.query_series(
+        ns.name,
+        labels=json.loads(ns.labels) if ns.labels else None,
+        since=time.time() - ns.window if ns.window else None,
+        step=ns.step,
+        agg=ns.agg,
+        q=ns.q,
+    )
+    if ns.json:
+        print(json.dumps(res, indent=2, default=str))
+        return
+    print(f"{res['name']} ({res['kind']}, step={res['step']:g}s)")
+    for s in res["series"]:
+        label = ",".join(f"{k}={v}" for k, v in sorted(s["labels"].items()))
+        print(f"  {{{label}}}")
+        for ts, v in s["points"]:
+            stamp = time.strftime("%H:%M:%S", time.localtime(ts))
+            print(f"    {stamp}  {v if v is None else round(v, 6)}")
+    if not res["series"]:
+        print("  (no samples)")
+
+
+def _render_top(state_api, iteration: int) -> str:
+    """One frame of `ray_tpu top`, built entirely on the query/state APIs.
+    Degrades gracefully when the obs layer is off (shows a notice instead
+    of rates)."""
+    now = time.time()
+    lines = [f"ray_tpu top — {time.strftime('%H:%M:%S')} "
+             f"(refresh #{iteration})", ""]
+    summary = state_api.summarize()
+
+    def last_rate(metric, labels=None, agg="sum"):
+        try:
+            res = state_api.query_series(
+                metric, labels=labels, since=now - 15, step=5.0, agg=agg
+            )
+        except Exception:  # noqa: BLE001 — metrics off / head gone
+            return None
+        pts = [p for s in res["series"] for p in s["points"]
+               if p[1] is not None]
+        return pts[-1][1] if pts else None
+
+    tasks_s = last_rate("ray_tpu_scheduler_tasks_dispatched_total")
+    queue = last_rate("ray_tpu_scheduler_pending_tasks")
+    lines.append(
+        f"tasks/s: {tasks_s if tasks_s is None else round(tasks_s, 1)}    "
+        f"queue depth: {queue if queue is None else int(queue)}    "
+        f"tasks by state: {summary['tasks_by_state']}"
+    )
+    lines.append(
+        f"resources: {summary['available_resources']} free of "
+        f"{summary['cluster_resources']}    objects: {summary['objects']}"
+    )
+    lines.append("")
+    lines.append("nodes:")
+    for n in state_api.list_nodes():
+        lines.append(
+            f"  {n['node_id'][:8]}  health={n['health']:<8} "
+            f"workers={n['num_workers']:<3} alive={n['alive']}"
+        )
+    rps = last_rate("ray_tpu_serve_proxy_requests_total")
+    shed = last_rate("ray_tpu_serve_shed_total")
+    p95 = last_rate("ray_tpu_serve_route_wait_p95_s", agg="max")
+    if any(v is not None for v in (rps, shed, p95)):
+        lines.append("")
+        lines.append(
+            f"serve: rps={rps if rps is None else round(rps, 1)}  "
+            f"route-wait p95="
+            f"{p95 if p95 is None else round(p95 * 1000, 1)}ms  "
+            f"shed/s={shed if shed is None else round(shed, 1)}"
+        )
+    try:
+        alerts = state_api.list_alerts()
+    except Exception:  # noqa: BLE001
+        alerts = []
+    firing = [a for a in alerts if a["state"] == "firing"]
+    lines.append("")
+    if firing:
+        lines.append("ALERTS FIRING:")
+        for a in firing:
+            lines.append(
+                f"  !! {a['name']} ({a['severity']}): {a['summary']} "
+                f"[value={a['value']}, threshold {a['op']} "
+                f"{a['threshold']:g}]"
+            )
+    elif alerts:
+        lines.append(f"alerts: {len(alerts)} rule(s), none firing")
+    else:
+        lines.append("alerts: (metrics disabled)")
+    return "\n".join(lines)
+
+
+def cmd_top(ns):
+    """Live refreshing cluster view (htop analogue) on the query API."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    i = 0
+    try:
+        while True:
+            i += 1
+            frame = _render_top(state_api, i)
+            if not ns.no_clear:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(frame, flush=True)
+            if ns.iterations and i >= ns.iterations:
+                break
+            time.sleep(ns.interval)
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_microbenchmark(_ns):
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     sys.path.insert(0, repo_root)
@@ -320,6 +460,37 @@ def main(argv=None) -> None:
     sp.add_argument("--output", help="write folded stacks to this file")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser("events", help="cluster event log (node/worker/serve/"
+                                       "autoscaler/alert transitions)")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--kind", help="filter by event kind")
+    sp.add_argument("--severity", help="filter by severity")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_events)
+
+    sp = sub.add_parser("series", help="query the head time-series store")
+    sp.add_argument("name", help="metric name (e.g. ray_tpu_serve_shed_total)")
+    sp.add_argument("--labels", help="JSON tag filter, e.g. '{\"app\":\"f\"}'")
+    sp.add_argument("--window", type=float, default=60.0,
+                    help="lookback seconds (0 = full retention)")
+    sp.add_argument("--step", type=float, default=None)
+    sp.add_argument("--agg", default="sum", choices=["sum", "max", "avg"])
+    sp.add_argument("--q", type=float, default=None,
+                    help="histogram quantile (e.g. 0.95)")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_series)
+
+    sp = sub.add_parser("top", help="live refreshing cluster view")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--iterations", type=int, default=0,
+                    help="stop after N frames (0 = until Ctrl-C)")
+    sp.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of clearing the screen")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("microbenchmark", help="run the core microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)

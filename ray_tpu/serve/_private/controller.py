@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private.events import emit_event
 from ray_tpu.serve._private.common import (
     PROXY_NAME,
     DeploymentInfo,
@@ -185,6 +186,16 @@ class ServeController:
                 # new set comes up — routers already stopped sending to it.
                 self._scale_to(info.name, 0)
             self._scale_to(info.name, target)
+            version = info.version
+        # Emit OUTSIDE the lock: the event append is a blocking control-plane
+        # round trip and long-poll listeners share self._lock.
+        emit_event(
+            "serve_deploy",
+            f"app {info.name} v{version} deployed "
+            f"({target} replica(s), route {info.route_prefix or '-'})",
+            source="serve-controller", app=info.name, version=version,
+            replicas=target,
+        )
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
@@ -201,6 +212,8 @@ class ServeController:
             self._bump(ROUTES_KEY)
             self._bump(CAPS_KEY)
             self._bump(f"replicas::{name}")
+        emit_event("serve_delete", f"app {name} deleted",
+                   source="serve-controller", app=name)
 
     def _scale_to(self, name: str, target: int, drain: bool = True) -> None:
         import ray_tpu
@@ -372,6 +385,7 @@ class ServeController:
                 if nid in self._proxy_cordoned:
                     continue
             info = existing.get(nid)
+            respawn = False
             if info is not None:
                 # Liveness/port probe: a crash-restarted proxy comes back
                 # with no listener (EveryNode binds ephemeral ports in
@@ -387,6 +401,7 @@ class ServeController:
                         info.port = bound
                     continue
                 except Exception:  # noqa: BLE001 — actor gone: respawn below
+                    respawn = True
                     with self._lock:
                         self._proxies.pop(nid, None)
             name = f"{PROXY_NAME}::{nid[:8]}"
@@ -440,6 +455,14 @@ class ServeController:
                     ray_tpu.kill(ActorHandle(handle._actor_id, "HTTPProxy"))
                 except Exception:
                     pass
+            elif respawn:
+                emit_event(
+                    "serve_proxy_failover",
+                    f"proxy on node {nid[:8]} was dead; respawned on port "
+                    f"{bound}",
+                    severity="warning", source="serve-controller",
+                    node_id=nid, port=bound,
+                )
 
     def get_proxies(self) -> Dict[str, Dict[str, Any]]:
         """node_id -> {actor_id, port, name, proxy_id} for managed proxies."""
@@ -482,6 +505,13 @@ class ServeController:
             ray_tpu.kill(ActorHandle(info.actor_id, "HTTPProxy"))
         except Exception:
             pass
+        emit_event(
+            "serve_proxy_drain",
+            f"proxy on node {node_id[:8]} drained and removed "
+            f"(inflight at finish: {result.get('inflight')})",
+            source="serve-controller", node_id=node_id,
+            ok=bool(result.get("ok")),
+        )
         return result
 
     # ---------------------------------------------------------------- routing
@@ -587,6 +617,7 @@ class ServeController:
     def report_failure(self, name: str, replica_id: str) -> None:
         """Router saw a dead replica: replace it (reference: replica recovery
         in DeploymentState reconciliation)."""
+        replaced = False
         with self._lock:
             replicas = self._replicas.get(name, [])
             before = len(replicas)
@@ -595,6 +626,15 @@ class ServeController:
                 self._bump(f"replicas::{name}")
                 if name in self._deployments:
                     self._scale_to(name, before)
+                    replaced = True
+        if replaced:
+            emit_event(
+                "serve_replica_failover",
+                f"replica {replica_id} of app {name} died; replacement "
+                "started",
+                severity="warning", source="serve-controller", app=name,
+                replica_id=replica_id,
+            )
 
     # ------------------------------------------------------------ autoscaling
     def report_load(self, name: str, router_id: str, inflight: int,
@@ -620,6 +660,7 @@ class ServeController:
 
     def _autoscale_once(self):
         now = time.time()
+        scaled: List[tuple] = []
         with self._lock:
             for name, info in list(self._deployments.items()):
                 cfg = info.autoscaling_config
@@ -668,6 +709,7 @@ class ServeController:
                 if desired > cur:
                     self._downscale_since[name] = None
                     self._scale_to(name, desired)
+                    scaled.append((name, cur, desired, p95))
                 elif desired < cur:
                     since = self._downscale_since.get(name)
                     if since is None:
@@ -675,8 +717,20 @@ class ServeController:
                     elif now - since >= cfg.downscale_delay_s:
                         self._scale_to(name, desired)
                         self._downscale_since[name] = None
+                        scaled.append((name, cur, desired, p95))
                 else:
                     self._downscale_since[name] = None
+        # Events emitted after the lock drops (the append is a blocking
+        # control-plane round trip; long-poll listeners share self._lock).
+        for name, cur, desired, p95 in scaled:
+            emit_event(
+                "serve_scale",
+                f"app {name} autoscaled {cur} -> {desired} replica(s)"
+                + (f" (route-wait p95 {p95 * 1000:.0f}ms)"
+                   if p95 is not None else ""),
+                source="serve-controller", app=name,
+                replicas_before=cur, replicas_after=desired,
+            )
 
     def shutdown(self) -> None:
         import ray_tpu

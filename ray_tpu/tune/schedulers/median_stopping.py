@@ -49,11 +49,16 @@ class MedianStoppingRule(TrialScheduler):
         step = len(hist)
         if step <= self.grace_period:
             return CONTINUE
-        # Running averages of OTHER trials at this step (those that got here).
+        # Running averages of OTHER trials up to this step. Peers count with
+        # WHATEVER history they have so far (truncated to `step`), matching
+        # the reference rule's running-average-at-time-t: requiring peers to
+        # have reached the same step let a trial that ran ahead of the pack
+        # (uncontended worker while the rest were still spawning) escape
+        # stopping entirely — every check saw too few same-step peers.
         peers = [
             float(np.mean(h[:step]))
             for tid, h in self._history.items()
-            if tid != trial.trial_id and len(h) >= step
+            if tid != trial.trial_id and len(h) > 0
         ]
         if len(peers) < self.min_samples:
             return CONTINUE

@@ -76,16 +76,34 @@ def register_collector(fn: Callable[[], None]) -> None:
     _registry.collectors.append(fn)
 
 
+# Head-process flush seam: a standalone head server has no driver context
+# (global_worker.context is None there), so its scheduler metrics would never
+# reach the KV — the observability layer registers a direct GCS+store sink
+# (timeseries.ObsState) instead. Processes with a context never use it.
+_local_sink: Optional[Callable[[bytes, bytes], None]] = None
+
+
+def set_local_sink(fn: Optional[Callable[[bytes, bytes], None]]) -> None:
+    global _local_sink
+    _local_sink = fn
+
+
 def flush_metrics() -> None:
     """Push this process's snapshot into the control plane KV."""
     from ray_tpu._private.worker import global_worker
 
     ctx = global_worker.context
-    if ctx is None or not _registry.metrics:
+    if not _registry.metrics:
+        return
+    if ctx is None and _local_sink is None:
         return
     try:
         key = f"metrics::{os.getpid()}".encode()
-        ctx.kv("put", key, json.dumps(_registry.snapshot()).encode())
+        payload = json.dumps(_registry.snapshot()).encode()
+        if ctx is not None:
+            ctx.kv("put", key, payload)
+        else:
+            _local_sink(key, payload)
     except Exception:
         pass  # control plane not up / shutting down
 

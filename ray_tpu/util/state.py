@@ -65,6 +65,85 @@ def transfer_stats() -> Dict[str, Any]:
     return global_worker.context.transfer_stats()
 
 
+# ------------------------------------------------------------ observability
+def query_series(name: str, labels: Optional[Dict[str, str]] = None,
+                 since: Optional[float] = None, until: Optional[float] = None,
+                 step: Optional[float] = None, agg: str = "sum",
+                 q: Optional[float] = None,
+                 group_by_pid: bool = False) -> Dict[str, Any]:
+    """Windowed history from the head's time-series store (fed by the
+    per-process metric flushes at `internal_metrics_interval_s`/flush
+    cadence). Counters come back as per-second RATES per step window, gauges
+    as sampled levels (agg across processes: "sum"|"max"|"avg"), histograms
+    with `q` as the q-quantile of the observations that landed in each
+    window (p95-over-time = `q=0.95`). Raises when `enable_metrics` is off.
+
+    Returns ``{"name", "kind", "step", "series": [{"labels", "points"}]}``
+    with points as ``[window_end_ts, value]`` pairs."""
+    _auto_init()
+    payload: Dict[str, Any] = {"name": name}
+    if labels:
+        payload["labels"] = dict(labels)
+    if since is not None:
+        payload["since"] = float(since)
+    if until is not None:
+        payload["until"] = float(until)
+    if step is not None:
+        payload["step"] = float(step)
+    if agg != "sum":
+        payload["agg"] = agg
+    if q is not None:
+        payload["q"] = float(q)
+    if group_by_pid:
+        payload["group_by_pid"] = True
+    return global_worker.context.query_series(payload)
+
+
+def list_cluster_events(limit: Optional[int] = None, kind: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        since: Optional[float] = None) -> List[Dict[str, Any]]:
+    """The cluster event log (newest last): severity-tagged runtime
+    transitions — node ALIVE->SUSPECT->DEAD edges, worker crash/respawn,
+    autoscaler decisions, Serve deploy/drain/failover, object spills, alert
+    fire/resolve — from the bounded GCS ring (survives head restart under
+    --persist). Each entry: {ts, severity, kind, source, message, data}."""
+    _auto_init()
+    payload: Dict[str, Any] = {}
+    if limit is not None:
+        payload["limit"] = int(limit)
+    if kind is not None:
+        payload["kind"] = kind
+    if severity is not None:
+        payload["severity"] = severity
+    if since is not None:
+        payload["since"] = float(since)
+    return global_worker.context.cluster_events(payload or None)
+
+
+def list_alerts() -> List[Dict[str, Any]]:
+    """Every alert rule with its live state (ok|pending|firing), last
+    evaluated value, and thresholds. Empty when `enable_metrics` is off."""
+    _auto_init()
+    return global_worker.context.list_alerts()
+
+
+def on_alert(callback) -> None:
+    """Register `callback(rule_payload, transition)` for alert transitions
+    ("firing"|"resolved"). Head-side only: the engine lives in the scheduler
+    process, so this works from an in-process driver (plain `init()`), not a
+    client-mode one. Callbacks run on the scheduler loop — keep them cheap
+    and never block."""
+    _auto_init()
+    sched = getattr(global_worker, "node", None)
+    obs = getattr(sched, "obs", None)
+    if obs is None:
+        raise RuntimeError(
+            "alert callbacks need the in-process head with enable_metrics on "
+            "(client-mode drivers poll state.list_alerts() instead)"
+        )
+    obs.engine.add_callback(callback)
+
+
 def memory_summary() -> Dict[str, Any]:
     """`ray memory` analogue: per-object owner/refcount/location/size from
     the scheduler's ownership tables joined with the on-disk store state,

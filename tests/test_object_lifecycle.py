@@ -128,7 +128,10 @@ def test_worker_borrowed_ref_outlives_driver_ref(ray_start_regular):
 
 def test_put_loop_stays_under_capacity(small_store):
     shm = global_worker.store.shm_dir
-    for _ in range(30):
+    # 16 x 8MB through a 40MB cap: release-per-iteration must reclaim (3x
+    # the cap total — enough to prove eviction without paying 30 full GC
+    # passes of tier-1 wall-clock).
+    for _ in range(16):
         ref = ray_tpu.put(np.zeros(1_000_000))  # 8MB each
         del ref
         gc.collect()

@@ -1,0 +1,730 @@
+"""The watch-it-over-time layer: time-series store (counter rates, histogram
+quantiles, retention/caps, dead-process pruning), the cluster event log
+(persistence across head restarts), and the alert rule engine (for_duration
+hysteresis, live fire->resolve on real overload/failure signals).
+
+Reference surfaces: the OpenCensus stats pipeline's over-time half
+(`src/ray/stats/` -> node agent -> dashboard charts) and the GCS task/health
+event stream — rebuilt here on `_private/timeseries.py` + the GCS cluster
+event ring.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.timeseries import (
+    DEFAULT_ALERT_RULES,
+    AlertEngine,
+    TimeSeriesStore,
+)
+from ray_tpu.util import state as state_api
+
+
+# ---------------------------------------------------------------------------
+# Store unit tests: ingestion math against KNOWN synthetic traffic
+# ---------------------------------------------------------------------------
+def _counter_snap(name, cum, tags=()):
+    return [{"name": name, "type": "counter", "help": "",
+             "series": [[list(tags), float(cum)]]}]
+
+
+def _gauge_snap(name, value, tags=()):
+    return [{"name": name, "type": "gauge", "help": "",
+             "series": [[list(tags), float(value)]]}]
+
+
+def _hist_snap(name, boundaries, bucket_counts, total, count, tags=()):
+    return [{"name": name, "type": "histogram", "help": "",
+             "buckets": list(boundaries),
+             "series": [[list(tags), {"bucket_counts": list(bucket_counts),
+                                      "sum": float(total),
+                                      "count": int(count)}]]}]
+
+
+def test_counter_rate_exact_under_known_traffic():
+    store = TimeSeriesStore(step_s=1.0, retention_s=60.0)
+    t0 = 1000.0
+    # First sample only sets the cursor: the process's lifetime total must
+    # not appear as a rate spike when it joins.
+    store.ingest("7", _counter_snap("ray_tpu_x_total", 5), now=t0)
+    store.ingest("7", _counter_snap("ray_tpu_x_total", 15), now=t0 + 1)
+    store.ingest("7", _counter_snap("ray_tpu_x_total", 35), now=t0 + 2)
+    res = store.query("ray_tpu_x_total", since=t0, until=t0 + 2, step=1.0)
+    assert res["kind"] == "counter"
+    assert len(res["series"]) == 1
+    pts = res["series"][0]["points"]
+    assert [v for _, v in pts] == [10.0, 20.0]  # exact rates, ops/s
+
+    # A second process's deltas merge into the same label set.
+    store.ingest("8", _counter_snap("ray_tpu_x_total", 0), now=t0 + 1)
+    store.ingest("8", _counter_snap("ray_tpu_x_total", 40), now=t0 + 2)
+    res = store.query("ray_tpu_x_total", since=t0 + 1, until=t0 + 2, step=1.0)
+    assert [v for _, v in res["series"][0]["points"]] == [60.0]
+    # ...unless the caller asks for per-process series.
+    res = store.query("ray_tpu_x_total", since=t0 + 1, until=t0 + 2,
+                      step=1.0, group_by_pid=True)
+    assert sorted(p[1] for s in res["series"] for p in s["points"]) == [20.0, 40.0]
+
+    # Counter reset (restart under the same pid): the post-reset value is
+    # the delta, never a negative rate.
+    store.ingest("7", _counter_snap("ray_tpu_x_total", 3), now=t0 + 3)
+    res = store.query("ray_tpu_x_total", since=t0 + 2, until=t0 + 3, step=1.0)
+    assert all(v >= 0 for _, v in res["series"][0]["points"])
+
+
+def test_histogram_p95_over_time_exact():
+    store = TimeSeriesStore(step_s=1.0, retention_s=60.0)
+    bounds = (0.1, 1.0, 10.0)
+    t0 = 2000.0
+    store.ingest("1", _hist_snap("ray_tpu_lat_s", bounds, [0, 0, 0], 0, 0),
+                 now=t0)
+    # Window 1: 100 observations all in (0.1, 1.0].
+    store.ingest("1", _hist_snap("ray_tpu_lat_s", bounds, [0, 100, 0],
+                                 55.0, 100), now=t0 + 1)
+    # Window 2: 100 more, all in (1.0, 10.0].
+    store.ingest("1", _hist_snap("ray_tpu_lat_s", bounds, [0, 100, 100],
+                                 605.0, 200), now=t0 + 2)
+    res = store.query("ray_tpu_lat_s", since=t0, until=t0 + 2, step=1.0,
+                      q=0.95)
+    pts = res["series"][0]["points"]
+    assert len(pts) == 2
+    # p95 of a bucket-uniform (0.1, 1.0] window: 0.1 + 0.95 * 0.9 = 0.955.
+    assert pts[0][1] == pytest.approx(0.955, abs=1e-9)
+    # p95 of a (1.0, 10.0] window: 1.0 + 0.95 * 9.0 = 9.55.
+    assert pts[1][1] == pytest.approx(9.55, abs=1e-9)
+    # p50 over both windows at step=2: 200 obs, half in each bucket ->
+    # the median sits exactly at the 1.0 boundary.
+    res = store.query("ray_tpu_lat_s", since=t0, until=t0 + 2, step=2.0,
+                      q=0.5)
+    assert res["series"][0]["points"][0][1] == pytest.approx(1.0, abs=1e-9)
+
+
+def test_gauge_carry_forward_and_aggregation():
+    store = TimeSeriesStore(step_s=1.0, retention_s=60.0)
+    t0 = 3000.0
+    store.ingest("1", _gauge_snap("ray_tpu_depth", 4), now=t0 + 0.5)
+    store.ingest("2", _gauge_snap("ray_tpu_depth", 6), now=t0 + 0.6)
+    # pid 2 goes quiet; its last value carries forward.
+    store.ingest("1", _gauge_snap("ray_tpu_depth", 10), now=t0 + 2.5)
+    res = store.query("ray_tpu_depth", since=t0, until=t0 + 3, step=1.0)
+    assert [v for _, v in res["series"][0]["points"]] == [10.0, 10.0, 16.0]
+    res = store.query("ray_tpu_depth", since=t0, until=t0 + 3, step=1.0,
+                      agg="max")
+    assert [v for _, v in res["series"][0]["points"]][-1] == 10.0
+
+
+def test_retention_ring_and_label_cap_eviction():
+    store = TimeSeriesStore(step_s=1.0, retention_s=10.0, max_series=2)
+    t0 = 4000.0
+    for i in range(40):
+        store.ingest("1", _gauge_snap("ray_tpu_g", i), now=t0 + i)
+    s = store._series[("ray_tpu_g", (("pid", "1"),))]
+    assert len(s.points) == 10  # ring bounded at retention/step
+    assert s.points[-1][1] == 39.0  # newest survives, oldest evicted
+
+    # Label-set cap: a third distinct series is dropped and counted.
+    store.ingest("1", _gauge_snap("ray_tpu_g2", 1), now=t0)
+    store.ingest("1", _gauge_snap("ray_tpu_g3", 1), now=t0)
+    assert store.series_count() == 2
+    assert store.dropped_series >= 1
+    assert store.query("ray_tpu_g3")["series"] == []
+
+    # Sub-step samples merge into the newest point instead of appending.
+    before = len(s.points)
+    store.ingest("1", _gauge_snap("ray_tpu_g", 100), now=t0 + 39.2)
+    assert len(s.points) == before
+    assert s.points[-1][1] == 100.0
+
+    # Pruning removes every series of the dead process.
+    assert store.prune_process("1") == 2
+    assert store.series_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# Alert engine unit tests: for_duration hysteresis with a fake clock
+# ---------------------------------------------------------------------------
+def test_alert_lifecycle_hysteresis_fake_clock():
+    store = TimeSeriesStore(step_s=1.0, retention_s=120.0)
+    events = []
+    transitions = []
+    engine = AlertEngine(
+        store,
+        [{"name": "depth", "metric": "ray_tpu_depth", "kind": "gauge",
+          "agg": "sum", "window_s": 30.0, "op": ">", "threshold": 5.0,
+          "for_s": 2.0, "severity": "warning", "summary": "deep"}],
+        event_sink=lambda kind, msg, severity="info", **d:
+            events.append((kind, d.get("rule"))),
+    )
+    engine.add_callback(lambda payload, tr: transitions.append((payload["name"], tr)))
+    rule = engine.rules[0]
+    t = 5000.0
+
+    store.ingest("1", _gauge_snap("ray_tpu_depth", 10), now=t)
+    engine.evaluate(t)
+    assert rule.state == "pending"  # breached, but not for for_s yet
+    engine.evaluate(t + 1)
+    assert rule.state == "pending" and events == []
+    engine.evaluate(t + 2.1)
+    assert rule.state == "firing"
+    assert events == [("alert_firing", "depth")]
+    assert transitions == [("depth", "firing")]
+
+    # Clearing must also hold for for_s: a one-sample dip does not resolve.
+    store.ingest("1", _gauge_snap("ray_tpu_depth", 0), now=t + 3)
+    engine.evaluate(t + 3.1)
+    assert rule.state == "firing"
+    store.ingest("1", _gauge_snap("ray_tpu_depth", 10), now=t + 4)
+    engine.evaluate(t + 4.1)
+    assert rule.state == "firing" and rule.clear_since is None
+    # Now clear and STAY clear past for_s -> resolved exactly once.
+    store.ingest("1", _gauge_snap("ray_tpu_depth", 0), now=t + 5)
+    engine.evaluate(t + 5.1)
+    engine.evaluate(t + 7.2)
+    assert rule.state == "ok"
+    assert events == [("alert_firing", "depth"), ("alert_resolved", "depth")]
+    assert transitions[-1] == ("depth", "resolved")
+
+    # A flap shorter than for_s never fires at all.
+    store.ingest("1", _gauge_snap("ray_tpu_depth", 10), now=t + 8)
+    engine.evaluate(t + 8.1)
+    store.ingest("1", _gauge_snap("ray_tpu_depth", 0), now=t + 9)
+    engine.evaluate(t + 9.1)
+    assert rule.state == "ok" and len(events) == 2
+
+
+def test_default_pack_thresholds_resolve_from_config():
+    from ray_tpu._private.config import Config
+
+    cfg = Config()
+    engine = AlertEngine(TimeSeriesStore(), DEFAULT_ALERT_RULES, config=cfg)
+    by_name = {r.name: r for r in engine.rules}
+    assert by_name["object_store_near_cap"].threshold == pytest.approx(
+        0.9 * cfg.object_store_memory
+    )
+    assert by_name["suspect_nodes"].for_s == 0.0
+    assert len(engine.rules) == len(DEFAULT_ALERT_RULES)
+
+
+# ---------------------------------------------------------------------------
+# Live: query API over a real cluster
+# ---------------------------------------------------------------------------
+def test_live_counter_rate_and_exec_p95():
+    ray_tpu.init(num_cpus=2, _system_config={
+        "obs_series_step_s": 0.25, "alert_eval_interval_s": 0.25,
+    })
+    try:
+        @ray_tpu.remote
+        def work():
+            time.sleep(0.03)
+            return 1
+
+        # Warm up + let the first flush set the counter cursors.
+        ray_tpu.get([work.remote() for _ in range(5)], timeout=60)
+        time.sleep(1.5)
+        t_mark = time.time()
+        assert sum(ray_tpu.get([work.remote() for _ in range(30)],
+                               timeout=60)) == 30
+
+        # The integral of the dispatched-rate series over the burst window
+        # must recover the task count (counters stored as deltas -> rates).
+        deadline = time.time() + 20
+        seen = 0.0
+        while time.time() < deadline:
+            res = state_api.query_series(
+                "ray_tpu_scheduler_tasks_dispatched_total",
+                since=t_mark - 0.5, step=0.5,
+            )
+            seen = sum(
+                p[1] * res["step"] for s in res["series"] for p in s["points"]
+            )
+            if seen >= 30:
+                break
+            time.sleep(0.3)
+        assert seen >= 30, f"rate integral recovered only {seen} of 30 tasks"
+
+        # p95-over-time of the exec-time histogram brackets the 30ms sleep.
+        deadline = time.time() + 15
+        p95s = []
+        while time.time() < deadline:
+            res = state_api.query_series(
+                "ray_tpu_task_exec_time_s", since=t_mark - 0.5, step=30.0,
+                q=0.95,
+            )
+            p95s = [p[1] for s in res["series"] for p in s["points"]
+                    if p[1] is not None]
+            if p95s:
+                break
+            time.sleep(0.3)
+        assert p95s, "no histogram windows with observations"
+        assert 0.02 <= p95s[-1] <= 0.5, p95s
+
+        # The store's own gauges are exported (and therefore self-ingested).
+        stats = state_api.list_alerts()
+        assert {r["name"] for r in stats} == {
+            r["name"] for r in DEFAULT_ALERT_RULES
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_dead_worker_prunes_kv_and_series_and_emits_event():
+    ray_tpu.init(num_cpus=2, _system_config={"obs_series_step_s": 0.25})
+    try:
+        @ray_tpu.remote
+        class Holder:
+            def pid(self):
+                return os.getpid()
+
+            def flush(self):
+                from ray_tpu.util import metrics as m
+
+                m.Counter("ray_tpu_obs_test_total", "t").inc(3)
+                m.flush_metrics()
+                return True
+
+        a = Holder.remote()
+        pid = ray_tpu.get(a.pid.remote(), timeout=60)
+        assert ray_tpu.get(a.flush.remote(), timeout=60)
+        from ray_tpu._private.worker import global_worker
+
+        ctx = global_worker.context
+        key = f"metrics::{pid}".encode()
+        assert ctx.kv("get", key) is not None
+        sched = global_worker.node
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sched.obs.store.query("ray_tpu_obs_test_total",
+                                     group_by_pid=True)["series"]:
+                break
+            time.sleep(0.2)
+
+        t_kill = time.time()
+        ray_tpu.kill(a)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if ctx.kv("get", key) is None:
+                break
+            time.sleep(0.2)
+        # Satellite contract: the dead process's KV snapshot is gone (no
+        # frozen series in future expositions), its store series are pruned,
+        # and the same hook emitted a worker_dead cluster event.
+        assert ctx.kv("get", key) is None, "metrics:: snapshot not pruned"
+        assert not [
+            s for s in sched.obs.store.query(
+                "ray_tpu_obs_test_total", group_by_pid=True)["series"]
+            if s["labels"].get("pid") == str(pid)
+        ], "dead process series not pruned"
+        evs = state_api.list_cluster_events(kind="worker_dead",
+                                            since=t_kill - 1)
+        assert any(e["data"].get("pid") == pid for e in evs), evs
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Live: the default pack fires and resolves on real signals
+# ---------------------------------------------------------------------------
+def test_serve_shed_alert_fires_and_resolves_live():
+    """Acceptance: 2x-saturating a Serve app (router inflight cap) drives
+    the shed rate; the default serve_shed_rate alert fires, emits events,
+    raises the firing gauge, and resolves once the burst stops."""
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, _system_config={
+        "serve_replica_inflight_cap_factor": 2.0,
+        "obs_series_step_s": 0.25,
+        "alert_eval_interval_s": 0.25,
+    })
+    try:
+        @serve.deployment(max_concurrent_queries=1)
+        class Sleepy:
+            def __call__(self, x):
+                time.sleep(0.2)
+                return x
+
+        handle = serve.run(Sleepy.bind(), _blocking_http=False)
+        from ray_tpu.serve._private.common import RequestShedded
+
+        fired = []
+        state_api.on_alert(
+            lambda payload, tr: fired.append((payload["name"], tr))
+        )
+
+        t_start = time.time()
+        responses = []
+
+        def alert_state(name):
+            for a in state_api.list_alerts():
+                if a["name"] == name:
+                    return a["state"]
+            return None
+
+        # Saturation burst: keep the offered load far past the inflight cap
+        # until the alert fires (sheds are near-instant, so this loop
+        # produces hundreds of shed/s against the 1/s threshold).
+        sheds = 0
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            try:
+                responses.append(handle.remote(1))
+            except RequestShedded:
+                sheds += 1
+            if sheds and sheds % 50 == 0 and alert_state("serve_shed_rate") == "firing":
+                break
+            time.sleep(0.002)
+        assert sheds > 0, "saturation burst produced no sheds"
+        assert alert_state("serve_shed_rate") == "firing", (
+            f"shed alert never fired ({sheds} sheds)"
+        )
+        assert ("serve_shed_rate", "firing") in fired
+        evs = state_api.list_cluster_events(kind="alert_firing",
+                                            since=t_start - 1)
+        assert any(e["data"].get("rule") == "serve_shed_rate" for e in evs)
+
+        # The firing gauge reaches the exposition (gauges carry a pid tag).
+        from ray_tpu.util.metrics import prometheus_text
+
+        def gauge_up():
+            return any(
+                line.startswith("ray_tpu_alerts_firing")
+                and 'rule="serve_shed_rate"' in line
+                and line.rstrip().endswith(" 1.0")
+                for line in prometheus_text().splitlines()
+            )
+
+        deadline = time.time() + 10
+        while time.time() < deadline and not gauge_up():
+            time.sleep(0.3)
+        assert gauge_up()
+
+        # Drain the admitted window, stop the load: the shed rate ages out
+        # of the rule's 10s window, then the clear must HOLD for for_s
+        # before the resolve lands (hysteresis).
+        for r in responses:
+            r.result(timeout=60)
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            if alert_state("serve_shed_rate") == "ok":
+                break
+            time.sleep(0.5)
+        assert alert_state("serve_shed_rate") == "ok", "alert never resolved"
+        assert ("serve_shed_rate", "resolved") in fired
+        evs = state_api.list_cluster_events(kind="alert_resolved",
+                                            since=t_start - 1)
+        assert any(e["data"].get("rule") == "serve_shed_rate" for e in evs)
+    finally:
+        try:
+            from ray_tpu import serve as _s
+
+            _s.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
+def test_suspect_node_alert_on_sigstopped_daemon():
+    """Acceptance: a SIGSTOP'd daemon goes heartbeat-SUSPECT; the
+    suspect_nodes alert fires off the level gauge and resolves when the
+    daemon wakes and beats again. (Same failure shape as
+    test_failpoints.test_heartbeat_detects_hung_daemon_sigstop, watched
+    through the alerting layer instead of the node table.)"""
+    from ray_tpu.cluster_utils import Cluster
+
+    os.environ["RAY_TPU_health_check_period_ms"] = "500"
+    os.environ["RAY_TPU_health_check_failure_threshold"] = "60"  # DEAD at 30s
+    os.environ["RAY_TPU_obs_series_step_s"] = "0.25"
+    os.environ["RAY_TPU_alert_eval_interval_s"] = "0.25"
+    cluster = None
+    proc = None
+    try:
+        cluster = Cluster(head_node_args={"num_cpus": 1}, real=True)
+        n2 = cluster.add_node(num_cpus=1)
+        proc = cluster._daemons[n2]
+        t_start = time.time()
+
+        def alert_state():
+            for a in state_api.list_alerts():
+                if a["name"] == "suspect_nodes":
+                    return a["state"]
+            return None
+
+        assert alert_state() == "ok"
+        os.kill(proc.pid, signal.SIGSTOP)
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            if alert_state() == "firing":
+                break
+            time.sleep(0.2)
+        state_when_stopped = alert_state()
+        os.kill(proc.pid, signal.SIGCONT)
+        assert state_when_stopped == "firing", "suspect alert never fired"
+        evs = state_api.list_cluster_events(since=t_start - 1)
+        kinds = {e["kind"] for e in evs}
+        assert "node_suspect" in kinds, kinds
+        assert any(e["kind"] == "alert_firing"
+                   and e["data"].get("rule") == "suspect_nodes"
+                   for e in evs)
+
+        # Woken daemon beats again -> gauge drops -> alert resolves.
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            if alert_state() == "ok":
+                break
+            time.sleep(0.2)
+        assert alert_state() == "ok", "suspect alert never resolved"
+        assert any(e["kind"] == "alert_resolved"
+                   and e["data"].get("rule") == "suspect_nodes"
+                   for e in state_api.list_cluster_events(since=t_start - 1))
+    finally:
+        for key in ("RAY_TPU_health_check_period_ms",
+                    "RAY_TPU_health_check_failure_threshold",
+                    "RAY_TPU_obs_series_step_s",
+                    "RAY_TPU_alert_eval_interval_s"):
+            os.environ.pop(key, None)
+        if proc is not None:
+            try:
+                os.kill(proc.pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+        if cluster is not None:
+            cluster.shutdown()
+
+
+def test_departed_client_driver_prunes_kv_snapshots():
+    """A client-mode driver that disconnects must not leave frozen
+    metrics::/spans:: snapshots behind (a dead driver's router p95 gauge
+    would otherwise keep a gauge-based alert latched forever)."""
+    import sys
+
+    from tests.conftest import head_process_runtime
+
+    with head_process_runtime(num_cpus=2):
+        from ray_tpu._private.worker import global_worker
+
+        ctx = global_worker.context
+        script = (
+            "import os, sys, time, ray_tpu\n"
+            "ray_tpu.init(address=sys.argv[1])\n"
+            "from ray_tpu.util import metrics as m\n"
+            "m.Counter('ray_tpu_obs_driver_probe_total', 't').inc(1)\n"
+            "m.flush_metrics()\n"
+            "print('PID', os.getpid())\n"
+            "ray_tpu.shutdown()\n"
+        )
+        address = global_worker.context.head_address
+        proc = subprocess.run(
+            [sys.executable, "-c", script, address],
+            capture_output=True, text=True, timeout=120, env=dict(os.environ),
+        )
+        pid = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("PID "):
+                pid = int(line.split()[1])
+        assert pid is not None, proc.stderr
+        deadline = time.time() + 15
+        key = f"metrics::{pid}".encode()
+        while time.time() < deadline:
+            if ctx.kv("get", key) is None:
+                break
+            time.sleep(0.2)
+        assert ctx.kv("get", key) is None, (
+            "departed driver's metrics:: snapshot was not pruned"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Event log: persistence across a head restart
+# ---------------------------------------------------------------------------
+def test_event_log_survives_head_restart(tmp_path):
+    from ray_tpu._private.launch import spawn_head
+
+    persist = str(tmp_path / "gcs.bin")
+
+    def run_head():
+        proc, info = spawn_head(
+            num_cpus=2, num_tpus=0, timeout_s=60,
+            extra_args=("--persist", persist),
+        )
+        os.environ["RAY_TPU_AUTHKEY_HEX"] = info["authkey_hex"]
+        ray_tpu.init(address=info["address"])
+        return proc
+
+    proc = run_head()
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        # A remote emit rides the kv command (the controller/autoscaler
+        # path) and lands in the head's ring.
+        global_worker.context.kv("event", (
+            "serve_deploy", "app demo v1 deployed", "info", "test", {}, time.time(),
+        ))
+        evs = state_api.list_cluster_events(kind="serve_deploy")
+        assert any(e["message"] == "app demo v1 deployed" for e in evs)
+        # Plant dead-process metric snapshots: the restarted head must drop
+        # them at restore (frozen series must not outlive their process).
+        global_worker.context.kv("put", b"metrics::999999", b"[]")
+        global_worker.context.kv("put", b"spans::999998", b"[]")
+        time.sleep(0.2)
+        ray_tpu.shutdown()
+        proc.terminate()  # SIGTERM -> final gcs.save_to
+        proc.wait(timeout=15)
+
+        proc = run_head()
+        evs = state_api.list_cluster_events(kind="serve_deploy")
+        assert any(e["message"] == "app demo v1 deployed" for e in evs), (
+            "event ring did not survive the head restart"
+        )
+        # The previous incarnation's per-process metric snapshots are NOT
+        # resurrected (frozen series would ride every exposition forever).
+        from ray_tpu._private.worker import global_worker as gw
+
+        assert gw.context.kv("get", b"metrics::999999") is None
+        assert gw.context.kv("get", b"spans::999998") is None
+    finally:
+        ray_tpu.shutdown()
+        try:
+            proc.terminate()
+            proc.wait(timeout=15)
+        except Exception:
+            pass
+        os.environ.pop("RAY_TPU_AUTHKEY_HEX", None)
+
+
+# ---------------------------------------------------------------------------
+# Knob-off parity + CLI surface
+# ---------------------------------------------------------------------------
+def test_enable_metrics_off_parity():
+    """enable_metrics=False: no store object, no evaluator, query_series
+    raises, emits are no-ops (nothing recorded, no traffic), events list is
+    empty."""
+    ray_tpu.init(num_cpus=2, _system_config={"enable_metrics": False})
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(5)], timeout=60) == [
+            1, 2, 3, 4, 5
+        ]
+        from ray_tpu._private.events import emit_event
+        from ray_tpu._private.worker import global_worker
+
+        sched = global_worker.node
+        assert sched.obs is None  # the knob-off contract: nothing exists
+        with pytest.raises(RuntimeError):
+            state_api.query_series("ray_tpu_scheduler_pending_tasks")
+        assert state_api.list_alerts() == []
+        with pytest.raises(RuntimeError):
+            state_api.on_alert(lambda p, t: None)
+        before = sched.gcs.cluster_events_total
+        emit_event("serve_deploy", "should be dropped", source="test")
+        assert sched.gcs.cluster_events_total == before
+        assert state_api.list_cluster_events() == []
+        # The scheduler seams' direct emits are gated the same way (node
+        # add/worker start happened during init: nothing was recorded).
+        assert sched.gcs.cluster_events_total == 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_enable_obs_subknob_off_keeps_metrics_but_no_history():
+    """enable_obs=False under enable_metrics=True: instantaneous metrics
+    still work (telemetry materializes, /metrics serves), but no store, no
+    events, no alert engine — the seam the obs-overhead bench prices."""
+    ray_tpu.init(num_cpus=2, _system_config={"enable_obs": False})
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x
+
+        assert ray_tpu.get([f.remote(i) for i in range(5)], timeout=60) == list(range(5))
+        from ray_tpu._private.events import emit_event
+        from ray_tpu._private.worker import global_worker
+
+        sched = global_worker.node
+        assert sched.obs is None
+        assert sched.telemetry.enabled  # metrics half still live
+        with pytest.raises(RuntimeError):
+            state_api.query_series("ray_tpu_scheduler_pending_tasks")
+        emit_event("serve_deploy", "dropped", source="test")
+        assert sched.gcs.cluster_events_total == 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_dashboard_series_events_alerts_endpoints():
+    import urllib.error
+    import urllib.request
+
+    ray_tpu.init(num_cpus=2, _system_config={"obs_series_step_s": 0.25})
+    try:
+        from ray_tpu.dashboard import start_dashboard
+
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        ray_tpu.get([nop.remote() for _ in range(20)], timeout=60)
+        server = start_dashboard(port=0)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            deadline = time.time() + 15
+            payload = {"series": []}
+            while time.time() < deadline and not payload["series"]:
+                payload = json.loads(urllib.request.urlopen(
+                    f"{base}/api/series?name="
+                    "ray_tpu_scheduler_tasks_dispatched_total&step=0.5",
+                    timeout=15,
+                ).read())
+                time.sleep(0.3)
+            assert payload["kind"] == "counter" and payload["series"]
+
+            evs = json.loads(urllib.request.urlopen(
+                f"{base}/api/events?kind=worker_started&limit=3", timeout=15
+            ).read())
+            assert evs and all(e["kind"] == "worker_started" for e in evs)
+
+            alerts = json.loads(urllib.request.urlopen(
+                f"{base}/api/alerts", timeout=15
+            ).read())
+            assert {a["name"] for a in alerts} >= {"serve_shed_rate",
+                                                   "suspect_nodes"}
+
+            # Caller errors are JSON 400s: missing ?name=, bad ?labels=.
+            for url in (f"{base}/api/series",
+                        f"{base}/api/series?name=x&labels=notjson"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(url, timeout=15)
+                assert ei.value.code == 400
+        finally:
+            server.stop()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_top_renderer_and_events_cli_shapes():
+    ray_tpu.init(num_cpus=2, _system_config={"obs_series_step_s": 0.25})
+    try:
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        ray_tpu.get([nop.remote() for _ in range(20)], timeout=60)
+        time.sleep(1.2)  # one flush so rates exist
+        from ray_tpu.scripts.cli import _render_top
+
+        frame = _render_top(state_api, 1)
+        assert "tasks/s:" in frame and "nodes:" in frame
+        assert "alerts" in frame.lower()
+        # Events render through the same state API the CLI uses.
+        evs = state_api.list_cluster_events(limit=5)
+        assert all({"ts", "severity", "kind", "source", "message", "data"}
+                   <= set(e) for e in evs)
+    finally:
+        ray_tpu.shutdown()

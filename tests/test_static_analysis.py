@@ -454,6 +454,88 @@ def test_metrics_plain_int_bumps_are_fine_in_hot_modules():
     assert violations == []
 
 
+def _obs_pkg(rules: str, kinds: str, emitter: str = "") -> Package:
+    """Fixture package carrying the two obs registries (alert pack + event
+    kinds) plus an optional extra module with emit sites."""
+    mods = {
+        "fixpkg._private.timeseries": f"DEFAULT_ALERT_RULES = {rules}\n",
+        "fixpkg._private.events": f"EVENT_KINDS = {kinds}\n",
+    }
+    if emitter:
+        mods["fixpkg.emitter"] = emitter
+    return make_pkg(**mods)
+
+
+def test_metrics_alert_rules_and_event_kinds_cross_checked():
+    """M4/M5: a rule whose metric or name is missing from the doc fails, as
+    does an EVENT_KINDS entry the doc doesn't list."""
+    pkg = _obs_pkg(
+        rules="""[
+            {"name": "good_rule", "metric": "ray_tpu_documented_total"},
+            {"name": "stale_rule", "metric": "ray_tpu_ghost_total"},
+        ]""",
+        kinds='("documented_kind", "ghost_kind")',
+    )
+    doc = ("| `good_rule` | ray_tpu_documented_total |\n"
+           "| `documented_kind` | head |\n")
+    violations = pass_metrics.run(pkg, hot_modules=(), doc_text=doc)
+    keys = sorted(v.key for v in violations)
+    assert any("alert-rule.stale_rule" in k for k in keys)
+    assert any("alert-metric.ray_tpu_ghost_total" in k for k in keys)
+    assert any("event-kind.ghost_kind" in k for k in keys)
+    assert not any("good_rule" in k for k in keys)
+    assert not any("documented_kind" in k for k in keys)
+    assert len(violations) == 3
+
+
+def test_metrics_unregistered_emit_kind_flagged():
+    """M5: an emit site using a kind that EVENT_KINDS doesn't register fails
+    even if the doc happens to mention the string."""
+    pkg = _obs_pkg(
+        rules="[]",
+        kinds='("registered_kind",)',
+        emitter="""
+            from fixpkg._private.events import emit_event
+
+            def seams(self):
+                emit_event("registered_kind", "fine")
+                emit_event("rogue_kind", "not in the registry")
+                self._emit_event("rogue_method_kind", "also checked")
+            """,
+    )
+    doc = "| `registered_kind` | `rogue_kind` | `rogue_method_kind` |"
+    violations = pass_metrics.run(pkg, hot_modules=(), doc_text=doc)
+    keys = sorted(v.key for v in violations)
+    assert any("event-unregistered.rogue_kind" in k for k in keys)
+    assert any("event-unregistered.rogue_method_kind" in k for k in keys)
+    assert len(violations) == 2
+
+
+def test_metrics_live_alert_pack_parses_as_literal():
+    """The real DEFAULT_ALERT_RULES must stay a pure literal (the lint
+    contract) and reference only documented metrics — parse it exactly the
+    way the pass does and cross-check the live COMPONENTS.md."""
+    import ast as _ast
+
+    src = open(os.path.join(PACKAGE_DIR, "_private", "timeseries.py")).read()
+    rules = None
+    for node in _ast.walk(_ast.parse(src)):
+        if isinstance(node, _ast.Assign) and any(
+            isinstance(t, _ast.Name) and t.id == "DEFAULT_ALERT_RULES"
+            for t in node.targets
+        ):
+            rules = _ast.literal_eval(node.value)
+    assert rules, "DEFAULT_ALERT_RULES must be a module-level pure literal"
+    doc = open(os.path.join(REPO_ROOT, "COMPONENTS.md")).read()
+    from ray_tpu._private.events import EVENT_KINDS
+
+    for rule in rules:
+        assert rule["name"] in doc
+        assert rule["metric"] in doc
+    for kind in EVENT_KINDS:
+        assert kind in doc
+
+
 # -------------------------------------------------------------- failpoints
 def run_failpoints(src: str, doc="`conn.send` | `sched.cmd.<method>` |"):
     pkg = make_pkg(fix=src)

@@ -44,6 +44,10 @@ echo "== serve ingress smoke (2-proxy fleet, burst->shed->recover, drain-on-stop
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
 echo
+echo "== observability smoke (series history, event log, shed alert fire->resolve) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
+echo
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
