@@ -246,6 +246,29 @@ class Config:
     # report to the controller (the SLO-aware autoscaling signal).
     serve_slo_window_s: float = 30.0
 
+    # --- distributed tracing (util/tracing.py; reference: tracing_helper.py) ---
+    # Head-sampling rate for ROOT spans minted while tracing rides the
+    # RAY_TPU_TRACING env knob (the always-on mode): each new trace keeps or
+    # drops ALL its spans at the root, so sampled traces stay connected and
+    # unsampled ones cost one RNG draw. Programmatic tracing.enable() defaults
+    # to full fidelity (rate 1.0) unless told otherwise — debug mode records
+    # everything.
+    trace_sample_rate: float = 0.1
+    # Deterministic sampling: a non-zero seed makes every process's
+    # keep/drop sequence replayable (seeded RNG per process, same order of
+    # root spans -> same decisions). 0 = seed from urandom.
+    trace_sample_seed: int = 0
+    # Tail-keep: a span created with tail-keep eligibility (Serve request
+    # roots, object-transfer pulls) whose wall time reaches this threshold
+    # is flushed even when its trace lost the head-sampling draw (marked
+    # keep="tail"), so the SLOW outliers survive any sample rate. 0 disables.
+    trace_keep_latency_s: float = 1.0
+    # Bound on the head-side trace-span ring AND each process's local span
+    # buffer: a process that can't flush (enable-before-init) drops the
+    # overflow (counted in ray_tpu_trace_spans_dropped_total) instead of
+    # growing without bound.
+    trace_spans_cap: int = 20000
+
     # --- task events / tracing (reference: task_event_buffer.h, gcs_task_manager.h) ---
     # Ring-buffer capacity of the GCS task-event store; oldest events drop
     # first. Doubles as state.summarize()'s listing budget (its task/object

@@ -112,6 +112,15 @@ class GCS:
         # Monotonic append count (never decremented by ring eviction): the
         # head's telemetry exports it as ray_tpu_obs_events_total.
         self.cluster_events_total = 0
+        # Trace-span ring (util/tracing.py): every process's flusher APPENDS
+        # its new-span batches here (`spans_push` cmd), replacing the old
+        # per-pid `spans::<pid>` KV blobs whose flush re-read and re-wrote
+        # the process's whole history each second. Bounded; spans are plain
+        # dicts; eviction is the retention policy (dead processes' spans
+        # stay — a trace outlives its workers).
+        self._trace_span_cap = 20000
+        self.trace_spans: "deque[dict]" = deque(maxlen=self._trace_span_cap)
+        self.trace_spans_total = 0
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
 
     # --- internal KV (reference: GcsKvManager / experimental.internal_kv) ---
@@ -173,6 +182,36 @@ class GCS:
             TaskEvent(task_id=t, name=n, state=s, timestamp=ts, stages=st or {})
             for (t, n, s, ts, st) in self.task_events
         ]
+
+    # --- trace spans (util/tracing.py; reference: the GCS task-event ring) ---
+    def set_trace_span_cap(self, cap: int) -> None:
+        cap = max(1, int(cap))
+        if cap != self._trace_span_cap:
+            self._trace_span_cap = cap
+            self.trace_spans = deque(self.trace_spans, maxlen=cap)
+
+    def append_trace_spans(self, spans) -> int:
+        """O(new-spans) append of one process's flush batch."""
+        n = 0
+        for s in spans:
+            if isinstance(s, dict) and "trace_id" in s:
+                self.trace_spans.append(s)
+                n += 1
+        self.trace_spans_total += n
+        return n
+
+    def trace_span_list(self, trace_id: Optional[str] = None,
+                        since: Optional[float] = None,
+                        limit: Optional[int] = None) -> List[dict]:
+        out = [
+            dict(s) for s in self.trace_spans
+            if (trace_id is None or s.get("trace_id") == trace_id)
+            and (since is None or (s.get("start") or 0.0) >= since)
+        ]
+        if limit is not None and limit >= 0:
+            # [-0:] would be the WHOLE list; limit=0 means none.
+            out = out[-int(limit):] if int(limit) > 0 else []
+        return out
 
     # --- cluster events (events.py; reference: the GCS error/event tables) ---
     def set_cluster_event_cap(self, cap: int) -> None:

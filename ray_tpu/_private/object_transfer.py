@@ -57,10 +57,19 @@ import os
 import queue
 import socket as _socket
 import threading
+import time as _time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import failpoints, serialization, session_monitor
 from ray_tpu._private.concurrency import any_thread, lock_guarded
+
+
+def _tracing_mod():
+    # Lazy: the data plane must import without dragging the tracing layer
+    # (and its config reads) into worker startup.
+    from ray_tpu.util import tracing
+
+    return tracing
 
 # Pull priorities: smaller drains first (reference: pull_manager.h queues
 # task-argument pulls ahead of ray.get ahead of wait/prefetch).
@@ -432,24 +441,58 @@ class PullManager:
              timeout: Optional[float] = None) -> Optional[str]:
         """Pull `meta`'s bytes into this node's store cache; returns the local
         segment path. None = no location is peer-servable (caller falls back
-        to the head relay); PullFailed = every servable location failed."""
+        to the head relay); PullFailed = every servable location failed.
+
+        When tracing is on, the blocking wait emits a "transfer" span
+        parented on the calling thread's context (a task's arg fetch parents
+        onto its execute span; a traced get() onto the caller's span), so a
+        slow get shows WHICH transfer stalled. Tail-keep eligible: a pull
+        breaching trace_keep_latency_s survives head sampling."""
         final_path = os.path.join(self.shm_dir, meta.object_id.hex())
         if os.path.exists(final_path):
             return final_path
-        req, start = self._submit(meta, locations, priority, final_path,
-                                  waiters=1)
-        if req is None:
-            return None
-        if start:
-            self._start_transfer(req)
-        if not req.event.wait(self.timeout_s if timeout is None else timeout):
-            self._drop_waiter(req)
-            raise PullFailed(
-                f"pull of {meta.object_id.hex()} timed out"
+        trace_ctx = t0 = None
+        if _tracing_mod().is_enabled():
+            trace_ctx = _tracing_mod().current_trace_context()
+            t0 = _time.time()
+        try:
+            req, start = self._submit(meta, locations, priority, final_path,
+                                      waiters=1)
+            if req is None:
+                return None
+            if start:
+                self._start_transfer(req)
+            if not req.event.wait(self.timeout_s if timeout is None else timeout):
+                self._drop_waiter(req)
+                raise PullFailed(
+                    f"pull of {meta.object_id.hex()} timed out"
+                )
+            if req.state == "done":
+                self._record_pull_span(meta, priority, trace_ctx, t0, "OK")
+                return req.final_path
+            raise req.error or PullFailed("pull failed")
+        except BaseException:
+            self._record_pull_span(meta, priority, trace_ctx, t0, "ERROR")
+            raise
+
+    @staticmethod
+    def _record_pull_span(meta, priority, trace_ctx, t0, status: str) -> None:
+        if t0 is None:
+            return
+        try:
+            _tracing_mod().record_span(
+                f"transfer::{meta.object_id.hex()[:8]}", "transfer",
+                t0, _time.time(), trace_context=trace_ctx,
+                attributes={
+                    "object_id": meta.object_id.hex(),
+                    "bytes": meta.size,
+                    "priority": priority,
+                    "source_node": meta.node_id.hex() if meta.node_id else None,
+                },
+                status=status, tail_keep=True,
             )
-        if req.state == "done":
-            return req.final_path
-        raise req.error or PullFailed("pull failed")
+        except Exception:  # noqa: BLE001 — a span must never break a pull
+            pass
 
     @any_thread
     def pull_nowait(self, meta, locations,

@@ -602,6 +602,8 @@ class Scheduler:
         self.session_dir = session_dir
         # Task-event ring capacity comes from config, not the GCS default.
         gcs.set_task_event_cap(config.task_events_max_num_task_in_gcs)
+        # Trace-span ring bound (util/tracing.py flushers append here).
+        gcs.set_trace_span_cap(config.trace_spans_cap)
         # Internal runtime metrics: hot paths bump plain ints on this object;
         # gauges/histograms materialize once per loop tick (telemetry.py).
         from ray_tpu._private.telemetry import SchedulerTelemetry
@@ -3864,7 +3866,7 @@ class Scheduler:
             "get_nodes", "add_node", "remove_node", "autoscaler_state",
             "memory_summary", "transfer_stats", "serve_directory",
             "serve_actor_inflight", "query_series", "cluster_events",
-            "list_alerts", "obs_stats",
+            "list_alerts", "obs_stats", "spans_list",
         }
     )
 
@@ -3980,6 +3982,27 @@ class Scheduler:
                 del self.object_replicas[key]
 
     # --------------------------------------------------- observability queries
+    def _cmd_spans_push(self, payload):
+        """Append one process's trace-span flush batch to the GCS ring —
+        O(new spans) per flush; the ring bound (`trace_spans_cap`) is the
+        retention policy. Always accepted: the SENDER is gated by the
+        tracing knob (a disabled runtime never flushes), so an empty-ring
+        head costs nothing."""
+        return self.gcs.append_trace_spans(payload or ())
+
+    def _req_spans_push(self, wh, req_id: Optional[int], payload):
+        # Rides the one-way "cmd" path from workers/client drivers.
+        self._respond(wh, req_id, True, self._cmd_spans_push(payload))
+
+    def _cmd_spans_list(self, payload):
+        """Trace-span readout (tracing.collect_spans / state.list_traces /
+        /api/traces / CLI). payload: optional {trace_id, since, limit}."""
+        p = dict(payload or {})
+        return self.gcs.trace_span_list(
+            trace_id=p.get("trace_id"), since=p.get("since"),
+            limit=p.get("limit"),
+        )
+
     def _cmd_query_series(self, payload):
         """Time-series readout (state.query_series / /api/series / CLI).
         Raises when the obs layer is off — a silent empty answer would read

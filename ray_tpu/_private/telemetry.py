@@ -335,6 +335,41 @@ def ensure_logshipper_metrics() -> None:
     register_collector(collect)
 
 
+# -------------------------------------------------------------------- tracing
+_tracing_installed = False
+
+
+def ensure_tracing_metrics() -> None:
+    """Expose the span-buffer overflow counter (util/tracing._DROPPED) as
+    ray_tpu_trace_spans_dropped_total. Installed once per process when
+    tracing turns on in a metrics-enabled runtime — the bounded buffer
+    (enable-before-init, flush failures) must drop VISIBLY."""
+    global _tracing_installed
+    if _tracing_installed:
+        return
+    _tracing_installed = True
+    from ray_tpu.util import tracing
+    from ray_tpu.util.metrics import Counter, register_collector
+
+    dropped = Counter(
+        "ray_tpu_trace_spans_dropped_total",
+        "trace spans dropped by the bounded per-process buffer "
+        "(no runtime to flush into, or flush failures past the cap)",
+    )
+    last = {"spans": 0}
+
+    def collect():
+        # Snapshot once; diff and advance the cursor from the snapshot (see
+        # the batching collector for why).
+        s = tracing._DROPPED["spans"]
+        d = s - last["spans"]
+        if d:
+            dropped.inc(d)
+        last["spans"] = s
+
+    register_collector(collect)
+
+
 # --------------------------------------------------------------- object store
 _objectstore_installed = False
 

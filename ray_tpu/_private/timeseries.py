@@ -127,7 +127,7 @@ class _Series:
     """
 
     __slots__ = ("name", "kind", "tags", "points", "boundaries",
-                 "last_cum", "last_ts")
+                 "last_cum", "last_ts", "exemplars", "last_exemplar_ts")
 
     def __init__(self, name: str, kind: str, tags: TagsKey, maxlen: int,
                  boundaries: Optional[tuple] = None):
@@ -138,6 +138,11 @@ class _Series:
         self.boundaries = boundaries
         self.last_cum: Any = None  # counter/hist cursor (cumulative)
         self.last_ts = 0.0
+        # Trace exemplars: (ts, value, trace_id) observations that carried a
+        # trace id (util/metrics exemplar support). Bounded; the flusher
+        # re-sends its rolling window, so ingestion dedups by timestamp.
+        self.exemplars: deque = deque(maxlen=8)
+        self.last_exemplar_ts = 0.0
 
 
 class TimeSeriesStore:
@@ -195,6 +200,19 @@ class TimeSeriesStore:
                 self._ingest_gauge(s, float(value), now)
             else:
                 self._ingest_hist(s, value, now)
+        for tags, samples in m.get("exemplars") or ():
+            tkey = tuple(sorted(
+                [(str(k), str(v)) for k, v in tags] + [("pid", pid)]
+            ))
+            s = self._series.get((name, tkey))
+            if s is None:
+                continue
+            # The per-process flusher re-sends its rolling exemplar window
+            # every second: dedup by timestamp cursor.
+            for ts, val, trace_id in samples:
+                if ts > s.last_exemplar_ts:
+                    s.exemplars.append((float(ts), float(val), str(trace_id)))
+                    s.last_exemplar_ts = float(ts)
 
     def _ingest_counter(self, s: _Series, cum: float, now: float) -> None:
         if s.last_cum is None:
@@ -305,7 +323,20 @@ class TimeSeriesStore:
                 else:
                     pts = self._query_hist(members, edges,
                                            0.95 if q is None else float(q))
-                out.append({"labels": dict(gtags), "points": pts})
+                entry = {"labels": dict(gtags), "points": pts}
+                ex = sorted(
+                    (e for s in members for e in s.exemplars
+                     if since <= e[0] <= until),
+                    key=lambda e: e[1], reverse=True,
+                )[:8]
+                if ex:
+                    # Largest-value traced observations in the window: the
+                    # "which trace paid this" link for dashboards/alerts.
+                    entry["exemplars"] = [
+                        {"ts": ts, "value": val, "trace_id": tid}
+                        for ts, val, tid in ex
+                    ]
+                out.append(entry)
             return {"name": name, "kind": kind, "step": step, "series": out}
 
     @staticmethod
@@ -420,6 +451,22 @@ class TimeSeriesStore:
                                              count_delta, q)])
         return pts
 
+    def exemplars_for(self, name: str, labels: Optional[Dict[str, str]] = None,
+                      since: Optional[float] = None) -> List[dict]:
+        """The window's traced observations for `name` (largest first):
+        the alert engine attaches these to firing transitions so an alert
+        links to concrete slow traces."""
+        now = time.time()
+        since = (now - self.retention_s) if since is None else float(since)
+        with self._lock:
+            ex = sorted(
+                (e for s in self._matching(name, labels) for e in s.exemplars
+                 if e[0] >= since),
+                key=lambda e: e[1], reverse=True,
+            )[:8]
+        return [{"ts": ts, "value": val, "trace_id": tid}
+                for ts, val, tid in ex]
+
     # ------------------------------------------------------------------ intro
     def series_count(self) -> int:
         with self._lock:
@@ -467,7 +514,7 @@ class AlertRule:
     __slots__ = ("name", "metric", "kind", "labels", "agg", "window_s", "q",
                  "op", "threshold", "for_s", "severity", "summary",
                  "state", "pending_since", "clear_since", "last_value",
-                 "fired_at")
+                 "fired_at", "exemplars")
 
     def __init__(self, spec: dict, config=None):
         self.name = spec["name"]
@@ -493,6 +540,9 @@ class AlertRule:
         self.clear_since: Optional[float] = None
         self.last_value: Optional[float] = None
         self.fired_at: Optional[float] = None
+        # Trace exemplars captured at the last FIRING transition: concrete
+        # slow traces behind the alert (state.get_trace them).
+        self.exemplars: List[dict] = []
 
     def payload(self) -> Dict[str, Any]:
         return {
@@ -502,6 +552,7 @@ class AlertRule:
             "severity": self.severity, "summary": self.summary,
             "state": self.state, "value": self.last_value,
             "fired_at": self.fired_at,
+            "exemplars": list(self.exemplars),
         }
 
 
@@ -600,6 +651,17 @@ class AlertEngine:
 
     def _transition(self, rule: AlertRule, transition: str,
                     value: Optional[float]) -> None:
+        if transition == "firing":
+            # Link the alert to concrete traces: the window's traced
+            # observations of the rule's metric (exemplars ride the metric
+            # flushes into the store; empty when nothing was traced).
+            try:
+                rule.exemplars = self.store.exemplars_for(
+                    rule.metric, rule.labels or None,
+                    since=time.time() - max(rule.window_s, 60.0),
+                )
+            except Exception:  # noqa: BLE001 — linkage is best-effort
+                rule.exemplars = []
         if self._event_sink is not None:
             kind = "alert_firing" if transition == "firing" else "alert_resolved"
             sev = rule.severity if transition == "firing" else "info"
@@ -609,6 +671,7 @@ class AlertEngine:
                 f"(value={value!r}, threshold {rule.op} {rule.threshold:g})",
                 severity=sev, rule=rule.name, value=value,
                 threshold=rule.threshold,
+                exemplar_trace_ids=[e["trace_id"] for e in rule.exemplars],
             )
         payload = rule.payload()
         for cb in list(self._callbacks):

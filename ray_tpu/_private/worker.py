@@ -554,6 +554,14 @@ class DriverContext:
     def task_latency(self):
         return self.scheduler.call("task_latency", None).result()
 
+    def push_spans(self, batch):
+        # Fire-and-forget append into the head's trace-span ring: the 1 Hz
+        # span flusher must never block on the loop.
+        self.scheduler.call_nowait("spans_push", batch)
+
+    def list_spans(self, payload=None):
+        return self.scheduler.call("spans_list", payload).result()
+
     def query_series(self, payload):
         return self.scheduler.call("query_series", payload).result()
 
@@ -824,6 +832,12 @@ class RemoteDriverContext:
     def task_latency(self):
         return self.wc.request("driver_cmd", ("task_latency", None))
 
+    def push_spans(self, batch):
+        self.wc.send_async(("cmd", "spans_push", batch))
+
+    def list_spans(self, payload=None):
+        return self.wc.request("driver_cmd", ("spans_list", payload))
+
     def query_series(self, payload):
         return self.wc.request("driver_cmd", ("query_series", payload))
 
@@ -1028,6 +1042,12 @@ class WorkerProcContext:
 
     def task_latency(self):
         return self.rt.wc.request("driver_cmd", ("task_latency", None))
+
+    def push_spans(self, batch):
+        self.rt.wc.send_async(("cmd", "spans_push", batch))
+
+    def list_spans(self, payload=None):
+        return self.rt.wc.request("driver_cmd", ("spans_list", payload))
 
     def query_series(self, payload):
         return self.rt.wc.request("driver_cmd", ("query_series", payload))
@@ -1289,6 +1309,12 @@ def _init_client_mode(address: str, namespace: Optional[str],
         raise ConnectionError(f"head rejected driver connection: {reply!r}")
     info = reply[1]
     set_config(info["config"])
+    from ray_tpu.util import tracing
+
+    # Same contract as in-proc init: honor RAY_TPU_TRACING set after import
+    # and re-read the (now head-owned) tracing knobs — the cluster samples
+    # at the HEAD's trace_sample_rate, not this client's env.
+    tracing.refresh_env()
 
     wc = WorkerConnection(conn)
     ctx = RemoteDriverContext(wc, address)

@@ -564,7 +564,13 @@ class WorkerRuntime:
 
     def run_coroutine(self, coro):
         """Drive an async actor method to completion on this actor's event
-        loop. Coroutines from concurrent calls interleave on the one loop."""
+        loop. Coroutines from concurrent calls interleave on the one loop.
+
+        The CALLING thread's trace context (the task's execute span) rides
+        along as the coroutine's ambient context: the loop thread's
+        thread-local slot can't carry it, and each wrapped coroutine is its
+        own asyncio task with its own contextvar copy, so concurrent calls
+        never see each other's context."""
         import asyncio
 
         with self._aio_lock:
@@ -573,6 +579,15 @@ class WorkerRuntime:
                 t = threading.Thread(target=loop.run_forever, daemon=True, name="actor-aio")
                 t.start()
                 self._aio_loop = loop
+        from ray_tpu.util import tracing
+
+        ctx = tracing.current_trace_context() if tracing.is_enabled() else None
+        if ctx is not None:
+            async def _with_ctx(c=coro, ctx=ctx):
+                with tracing.context_scope(ctx):
+                    return await c
+
+            coro = _with_ctx()
         return asyncio.run_coroutine_threadsafe(coro, self._aio_loop).result()
 
     def locate_many(self, keys) -> dict:

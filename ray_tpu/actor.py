@@ -143,14 +143,13 @@ class ActorHandle:
 
         submit_span = None
         if tracing.is_enabled():
+            # None = unsampled root: no context rides the spec.
             submit_span = tracing.start_span(
                 f"actor::{spec.name}", "submit", attributes={"task_id": task_id.hex()}
             )
-            spec.trace_context = {
-                "trace_id": submit_span["trace_id"],
-                "parent_id": submit_span["span_id"],
-            }
-            spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
+            if submit_span is not None:
+                spec.trace_context = tracing.context_of(submit_span)
+                spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
         try:
             entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
             return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]
@@ -257,11 +256,9 @@ class ActorClass:
                 f"actor_create::{self._cls.__name__}", "submit",
                 attributes={"actor_id": actor_id.hex(), "task_id": task_id.hex()},
             )
-            spec.trace_context = {
-                "trace_id": submit_span["trace_id"],
-                "parent_id": submit_span["span_id"],
-            }
-            spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
+            if submit_span is not None:
+                spec.trace_context = tracing.context_of(submit_span)
+                spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
         try:
             entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
             req = ExecRequest(

@@ -19,9 +19,9 @@ class DashboardServer:
 
     # Every kind `/api/{kind}` serves; the 404 for anything else lists them.
     VALID_KINDS = (
-        "actors", "alerts", "cluster", "events", "jobs", "memory", "nodes",
-        "objects", "profile", "serve", "series", "stacks", "tasks",
-        "timeline",
+        "actors", "alerts", "cluster", "events", "jobs", "latency", "memory",
+        "nodes", "objects", "profile", "serve", "series", "stacks", "tasks",
+        "timeline", "traces",
     )
     # Ceiling on `/api/profile?duration=` (the handler blocks an executor
     # thread for the duration).
@@ -116,6 +116,19 @@ class DashboardServer:
             # Unified chrome trace (task stages + spans + collectives):
             # save the JSON and load it at chrome://tracing / Perfetto.
             return state_api.timeline()
+        if kind == "traces":
+            # End-to-end request traces: ?trace_id= for one trace with its
+            # critical-path attribution, else newest-last summaries.
+            trace_id = (query or {}).get("trace_id")
+            if trace_id:
+                return state_api.get_trace(trace_id)
+            return state_api.list_traces(limit if limit is not None else 50)
+        if kind == "latency":
+            # "Where does p95 actually go": per-component attribution over
+            # recent traces (state.latency_report).
+            return state_api.latency_report(
+                limit if limit is not None else 200
+            )
         if kind == "stacks":
             # Live all-thread stacks from every process (`ray stack`).
             return state_api.stacks()
@@ -181,6 +194,9 @@ class DashboardServer:
                 return web.json_response(
                     {"error": f"unknown app {app!r}"}, status=400
                 )
+            if kind == "traces":
+                # /api/traces?trace_id=<unknown>: caller error.
+                return web.json_response({"error": str(e)}, status=400)
             return web.json_response({"error": str(e)}, status=503)
         except Exception as e:  # noqa: BLE001 — e.g. profiler disabled
             return web.json_response({"error": str(e)}, status=503)

@@ -209,16 +209,23 @@ class RemoteFunction:
         d["submitted_ts"] = _time()
         spec.__dict__ = d
 
+        # ONE sampling decision per root, made up front: the fast-path gate
+        # and the general path's span share it (a second draw in start_span
+        # would square the effective rate for no-arg tasks and desync the
+        # seeded keep/drop sequence).
+        traced = tracing._enabled or tracing._env_enabled
+        sampled = traced and not tracing.root_unsampled()
         if (
-            not tracing._enabled
-            and not tracing._env_enabled
-            and num_returns == 1
+            num_returns == 1
             and not args
             and not kwargs
+            and not sampled
+            # Always-on tracing: an unsampled ROOT submit stays on the
+            # fast path — its whole tracing cost is the sampling draw.
         ):
             # Straight-line fast path for the dominant shape (one return, no
-            # args, no tracing): everything below is the general path run in
-            # a specific order — this just skips its branches.
+            # args, untraced submit): everything below is the general path
+            # run in a specific order — this just skips its branches.
             rid = _oid_trusted(task_id._binary + _RETURN_IDX1)
             return_ids = [rid]
             gw.ownership.expect_one(rid._binary)
@@ -238,19 +245,19 @@ class RemoteFunction:
             return ObjectRef(rid)
 
         submit_span = None
-        if tracing.is_enabled():
+        if sampled:
+            # presampled: the decision above already covered this root.
             submit_span = tracing.start_span(
-                f"task::{spec.name}", "submit", attributes={"task_id": task_id.hex()}
+                f"task::{spec.name}", "submit",
+                attributes={"task_id": task_id.hex()}, presampled=True,
             )
-            spec.trace_context = {
-                "trace_id": submit_span["trace_id"],
-                "parent_id": submit_span["span_id"],
-            }
-            # Workers inherit tracing through the task env, so nested
-            # submissions from inside tasks are traced too. The template's
-            # env_vars dict is shared: copy before mutating.
-            spec.env_vars = dict(spec.env_vars)
-            spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
+            if submit_span is not None:
+                spec.trace_context = tracing.context_of(submit_span)
+                # Workers inherit tracing through the task env, so nested
+                # submissions from inside tasks are traced too. The template's
+                # env_vars dict is shared: copy before mutating.
+                spec.env_vars = dict(spec.env_vars)
+                spec.env_vars.setdefault("RAY_TPU_TRACING", "1")
         try:
             entries, kwentries = worker_mod._serialize_arg_entries(args, kwargs)
             return_ids = [ObjectID.for_return(task_id, i + 1) for i in range(num_returns)]

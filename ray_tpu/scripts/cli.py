@@ -282,6 +282,80 @@ def cmd_series(ns):
         print("  (no samples)")
 
 
+def cmd_trace(ns):
+    """End-to-end request traces: list recent ones, or show one trace's
+    spans + critical-path attribution (`--trace-id`)."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    if ns.trace_id:
+        t = state_api.get_trace(ns.trace_id)
+        if ns.json:
+            print(json.dumps(t, indent=2, default=str))
+            return
+        print(f"trace {t['trace_id']}  root={t['root']!r} "
+              f"({t['root_kind']})  {t['duration_s'] * 1e3:.2f}ms  "
+              f"status={t['status']}")
+        by_id = {s["span_id"]: s for s in t["spans"]}
+
+        def depth_of(s):
+            d, p = 0, s.get("parent_id")
+            while p in by_id and d < 32:
+                d, p = d + 1, by_id[p].get("parent_id")
+            return d
+
+        t0 = min(s["start"] for s in t["spans"])
+        for s in t["spans"]:
+            pad = "  " * depth_of(s)
+            dur = ((s.get("end") or s["start"]) - s["start"]) * 1e3
+            print(f"  {pad}{s['name']} [{s['kind']}] "
+                  f"+{(s['start'] - t0) * 1e3:.2f}ms {dur:.2f}ms "
+                  f"{s['status']}")
+        attr = t["attribution"]
+        print(f"\nattribution ({attr['coverage'] * 100:.1f}% of "
+              f"{attr['total_s'] * 1e3:.2f}ms wall):")
+        for comp, secs in attr["components"].items():
+            print(f"  {comp:<14} {secs * 1e3:>10.3f}ms")
+        return
+    traces = state_api.list_traces(ns.limit)
+    if ns.json:
+        print(json.dumps(traces, indent=2, default=str))
+        return
+    for t in traces:
+        stamp = time.strftime("%H:%M:%S", time.localtime(t["start"]))
+        tail = "  [tail-kept]" if t.get("tail_kept") else ""
+        print(f"{stamp}  {t['trace_id']}  {t['duration_s'] * 1e3:>9.2f}ms  "
+              f"{t['spans']:>3} spans  {t['status']:<5} "
+              f"{t['root'] or '?'}{tail}")
+    if not traces:
+        print("(no traces recorded — is tracing enabled? "
+              "RAY_TPU_TRACING=1 or tracing.enable())")
+
+
+def cmd_latency(ns):
+    """'Where does p95 actually go': per-component latency attribution over
+    recent traces (state.latency_report)."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    rep = state_api.latency_report(ns.limit)
+    if ns.json:
+        print(json.dumps(rep, indent=2, default=str))
+        return
+    if not rep["traces"]:
+        print("(no complete traces to attribute)")
+        return
+    p50 = rep["trace_p50_s"] or 0.0
+    p95 = rep["trace_p95_s"] or 0.0
+    print(f"latency report over {rep['traces']} trace(s): "
+          f"p50={p50 * 1e3:.2f}ms p95={p95 * 1e3:.2f}ms "
+          f"coverage={rep['coverage'] * 100:.1f}%")
+    print(f"{'component':<14} {'total':>12} {'share':>7}")
+    for comp, row in rep["components"].items():
+        print(f"{comp:<14} {row['total_s'] * 1e3:>10.3f}ms "
+              f"{row['share'] * 100:>6.1f}%")
+
+
 def _render_top(state_api, iteration: int) -> str:
     """One frame of `ray_tpu top`, built entirely on the query/state APIs.
     Degrades gracefully when the obs layer is off (shows a notice instead
@@ -482,6 +556,21 @@ def main(argv=None) -> None:
     sp.add_argument("--json", action="store_true")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_series)
+
+    sp = sub.add_parser("trace", help="end-to-end request traces "
+                                      "(list, or one trace's critical path)")
+    sp.add_argument("--trace-id", help="show one trace's spans + attribution")
+    sp.add_argument("--limit", type=int, default=50)
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("latency", help="per-component latency attribution "
+                                        "over recent traces")
+    sp.add_argument("--limit", type=int, default=200)
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_latency)
 
     sp = sub.add_parser("top", help="live refreshing cluster view")
     sp.add_argument("--interval", type=float, default=2.0)

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu.serve._private.common import RequestShedded
+from ray_tpu.util import tracing
 
 
 @dataclass
@@ -447,9 +448,27 @@ class HTTPProxy:
                 {"error": f"no route for {request.path}"}, status=404
             )
         dep, is_asgi, rest = match
+        # Root span of the end-to-end request trace: the proxy mints it and
+        # the context rides the request envelope (route() -> replica submit
+        # -> execute -> nested tasks join the SAME trace). Detached (many
+        # requests interleave on this event loop) and tail-keep eligible: a
+        # request breaching trace_keep_latency_s is flushed even when its
+        # trace lost the head-sampling draw.
+        root_span = None
+        if tracing.is_enabled():
+            root_span = tracing.start_span(
+                f"request::{dep}", "request",
+                attributes={"app": dep, "method": request.method,
+                            "path": request.path},
+                detached=True, tail_keep=True,
+            )
+        trace_ctx = tracing.context_of(root_span)
+        status = "OK"
         if self._draining:
+            tracing.end_span(root_span, "SHED")
             return self._shed_response(dep, "draining")
         if not self._admit(dep):
+            tracing.end_span(root_span, "SHED")
             return self._shed_response(dep, "app_queue")
         try:
             body = await request.read()
@@ -458,23 +477,32 @@ class HTTPProxy:
                 async with self._forward_slots:
                     if is_asgi:
                         return await self._handle_asgi(
-                            request, handle, rest, body
+                            request, handle, rest, body, trace_ctx
                         )
                     return await self._handle_plain(
-                        request, handle, rest, body
+                        request, handle, rest, body, trace_ctx
                     )
             except Exception as e:  # noqa: BLE001 — surface as a 500
                 shed = self._shed_of(e)
                 if shed is not None:
+                    status = "SHED"
                     return self._shed_response(
                         dep, shed.reason, shed.retry_after_s,
                         count=shed.reason != "replica_inflight",
                     )
+                status = "ERROR"
                 return web.json_response({"error": str(e)}, status=500)
+        except BaseException:
+            # Body-read failure or client disconnect (CancelledError): the
+            # request did NOT succeed — its trace must not say OK.
+            status = "ERROR"
+            raise
         finally:
             self._release(dep)
+            tracing.end_span(root_span, status)
 
-    async def _handle_plain(self, request, handle, rest: str, body: bytes):
+    async def _handle_plain(self, request, handle, rest: str, body: bytes,
+                            trace_ctx=None):
         """Non-ASGI deployment: one streaming call; a generator return
         streams as a chunked response, a plain return answers normally."""
         from aiohttp import web
@@ -492,7 +520,8 @@ class HTTPProxy:
         call_kwargs = _asgi_route_kwargs(request)
         loop = asyncio.get_event_loop()
         stream = _ReplicaStream(
-            handle._ensure_router(), "__call__", (preq,), call_kwargs
+            handle._ensure_router(), "__call__", (preq,), call_kwargs,
+            trace_ctx=trace_ctx,
         )
         resp = None
         try:
@@ -523,7 +552,8 @@ class HTTPProxy:
         finally:
             stream.close()  # releases unconsumed items + router load unit
 
-    async def _handle_asgi(self, request, handle, rest: str, body: bytes):
+    async def _handle_asgi(self, request, handle, rest: str, body: bytes,
+                           trace_ctx=None):
         """ASGI ingress: speak ASGI to the replica over a streaming call and
         relay response events as they arrive (SSE/chunked stream end-to-end)."""
         from aiohttp import web
@@ -547,7 +577,7 @@ class HTTPProxy:
         stream = _ReplicaStream(
             handle._ensure_router(), "handle_asgi", (scope, body),
             _asgi_route_kwargs(request),
-            raw_method=True,
+            raw_method=True, trace_ctx=trace_ctx,
         )
         resp = None
         try:

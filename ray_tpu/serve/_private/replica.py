@@ -202,8 +202,17 @@ class ServeReplica:
                 finally:
                     _reset_model_id(token)
 
+            import contextvars
+
             loop = asyncio.get_running_loop()
-            out = await loop.run_in_executor(self._sync_executor, _call_sync)
+            # copy_context: run_in_executor does NOT propagate contextvars,
+            # and the request's ambient trace context (tracing.context_scope
+            # set by the worker's coroutine driver) must reach the user call
+            # so nested .remote()s join the request's trace.
+            cctx = contextvars.copy_context()
+            out = await loop.run_in_executor(
+                self._sync_executor, functools.partial(cctx.run, _call_sync)
+            )
         if inspect.iscoroutine(out):
             if model_id:
                 # ensure_future: the user coroutine runs as ONE task whose
@@ -230,8 +239,16 @@ class ServeReplica:
                 finally:
                     _reset_model_id(token)
 
+            import contextvars
+
+            gctx = contextvars.copy_context()
             while True:
-                item = await loop.run_in_executor(self._sync_executor, _next)
+                # Same contextvar propagation as the sync call above: the
+                # generator body resumes on an executor thread and may make
+                # nested traced calls.
+                item = await loop.run_in_executor(
+                    self._sync_executor, functools.partial(gctx.run, _next)
+                )
                 if item is sentinel:
                     break
                 yield ("chunk", item)
@@ -349,12 +366,21 @@ class ServeReplica:
                 model_id = v.decode()
                 break
 
+        # The app coroutine runs on its own thread: hand it the request's
+        # ambient trace context so nested traced calls join the trace.
+        from ray_tpu.util import tracing
+
+        trace_ctx = (
+            tracing.current_trace_context() if tracing.is_enabled() else None
+        )
+
         def run():
             if model_id:
                 _set_model_id(model_id)
             loop = asyncio.new_event_loop()
             try:
-                loop.run_until_complete(app(scope, receive, send))
+                with tracing.context_scope(trace_ctx):
+                    loop.run_until_complete(app(scope, receive, send))
             except Exception as e:  # noqa: BLE001 — surfaced as a 500 event
                 events.put({"type": "asgi.error", "error": repr(e)})
             finally:

@@ -200,16 +200,22 @@ class Router:
             replica_id, 0
         )
 
-    def _route_wait_p95(self) -> Optional[float]:
-        """p95 of route-wait samples inside the SLO window (PR 2's histogram
-        signal, windowed locally so the controller sees CURRENT latency, not
-        all-time). None with no fresh samples."""
+    def _route_wait_p95(self) -> "Optional[tuple]":
+        """(p95_seconds, exemplar_trace_id) of route-wait samples inside the
+        SLO window (PR 2's histogram signal, windowed locally so the
+        controller sees CURRENT latency, not all-time). The exemplar is the
+        trace id of the p95 sample itself (None when that request was
+        untraced). None with no fresh samples."""
         from ray_tpu._private.config import get_config
 
         cutoff = time.time() - float(get_config().serve_slo_window_s)
         with self._samples_lock:
             snapshot = list(self._wait_samples)
-        recent = sorted(w for ts, w in snapshot if ts >= cutoff)
+        recent = sorted(
+            ((s[1], s[2] if len(s) > 2 else None)
+             for s in snapshot if s[0] >= cutoff),
+            key=lambda x: x[0],
+        )
         if not recent:
             return None
         return recent[min(len(recent) - 1, int(0.95 * len(recent)))]
@@ -222,7 +228,8 @@ class Router:
         total = sum(len(v) for v in self._inflight.values()) + sum(
             self._inflight_streams.values()
         )
-        p95 = self._route_wait_p95()
+        sample = self._route_wait_p95()
+        p95 = sample[0] if sample else None
         m = _metrics()
         if m is not None:
             # Replica saturation: this router's in-flight load over the
@@ -237,7 +244,10 @@ class Router:
             if capacity:
                 m["saturation"].set(total / capacity, tags)
             if p95 is not None:
-                m["slo_p95"].set(p95, tags)
+                # The p95 sample's own trace rides as the gauge exemplar, so
+                # a firing route-wait SLO alert links to a concrete slow
+                # trace (state.get_trace / /api/traces).
+                m["slo_p95"].set(p95, tags, exemplar=sample[1])
         try:
             self._controller.report_load.remote(
                 self._name, self._router_id, total, p95
@@ -283,7 +293,8 @@ class Router:
         )
 
     def route(self, method_name: str, args, kwargs, force_refresh: bool = False,
-              stream: bool = False, raw_method: bool = False):
+              stream: bool = False, raw_method: bool = False,
+              trace_ctx: Optional[Dict[str, str]] = None):
         """Pick a replica (power of two choices) and submit.
 
         Returns ``(ref, replica_id)`` so the response can report the replica
@@ -291,12 +302,47 @@ class Router:
         DeploymentResponse.result()). With ``stream=True`` the first element
         is an ObjectRefGenerator from a streaming call to
         `handle_request_stream` (or to `method_name` itself when
-        ``raw_method`` — the proxy's ASGI path)."""
+        ``raw_method`` — the proxy's ASGI path). ``trace_ctx`` is the
+        request's trace context handed down from the HTTP proxy (the root
+        span owner): route() opens a "router" child span covering the
+        route wait and scopes the replica submit under it, so the actor
+        call's submit/execute spans join the SAME trace."""
+        from ray_tpu.util import tracing
+
+        if trace_ctx is None:
+            # Direct handle calls inside a traced caller (a replica fanning
+            # out, a traced driver) still join the ambient trace.
+            trace_ctx = tracing.current_trace_context()
+        rspan = None
+        if trace_ctx is not None and tracing.is_enabled():
+            # Detached: route() may run on a shared event-loop thread; the
+            # span must not leak into unrelated requests' thread-local state.
+            rspan = tracing.start_span(
+                f"route::{self._name}", "router", trace_context=trace_ctx,
+                detached=True,
+            )
+        try:
+            return self._route_inner(
+                method_name, args, kwargs, force_refresh, stream, raw_method,
+                trace_ctx, rspan,
+            )
+        except BaseException:
+            # A shed/no-replica/submit failure must still close (and flush)
+            # the router span: these are exactly the requests a trace is
+            # supposed to explain.
+            tracing.end_span(rspan, "ERROR")
+            raise
+
+    def _route_inner(self, method_name: str, args, kwargs,
+                     force_refresh: bool, stream: bool, raw_method: bool,
+                     trace_ctx, rspan):
         from ray_tpu.actor import ActorHandle
 
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+        from ray_tpu.util import tracing
 
         t_route = time.perf_counter()
+        scope_ctx = tracing.context_of(rspan) or trace_ctx
         model_id = ""
         if kwargs and MODEL_ID_KWARG in kwargs:
             # raw_method calls go straight to the named replica method (ASGI
@@ -362,33 +408,43 @@ class Router:
                 while len(self._model_affinity) > self._model_affinity_cap:
                     self._model_affinity.popitem(last=False)
             handle = ActorHandle(chosen.actor_id, "ServeReplica")
-            if stream:
-                if raw_method:
-                    method = getattr(handle, method_name)
-                    ref = method.options(num_returns="streaming").remote(*args, **kwargs)
+            # The scope makes the router span (or the handed-down request
+            # context) the ambient parent for the actor-call submit span, so
+            # proxy -> router -> replica-execute form ONE trace.
+            with tracing.context_scope(scope_ctx):
+                if stream:
+                    if raw_method:
+                        method = getattr(handle, method_name)
+                        ref = method.options(num_returns="streaming").remote(*args, **kwargs)
+                    else:
+                        ref = handle.handle_request_stream.options(
+                            num_returns="streaming"
+                        ).remote(method_name, tuple(args), kwargs)
+                    self._inflight_streams[chosen.replica_id] = (
+                        self._inflight_streams.get(chosen.replica_id, 0) + 1
+                    )
                 else:
-                    ref = handle.handle_request_stream.options(
-                        num_returns="streaming"
-                    ).remote(method_name, tuple(args), kwargs)
-                self._inflight_streams[chosen.replica_id] = (
-                    self._inflight_streams.get(chosen.replica_id, 0) + 1
-                )
-            else:
-                ref = handle.handle_request.remote(method_name, tuple(args), kwargs)
-                self._inflight.setdefault(chosen.replica_id, []).append(ref)
+                    ref = handle.handle_request.remote(method_name, tuple(args), kwargs)
+                    self._inflight.setdefault(chosen.replica_id, []).append(ref)
             self._report_load()
         wait = time.perf_counter() - t_route
+        if rspan is not None:
+            rspan["attributes"]["replica_id"] = chosen.replica_id
+            tracing.end_span(rspan)
+        trace_id = trace_ctx.get("trace_id") if trace_ctx else None
         # Sampled regardless of enable_metrics: the SLO autoscaler needs the
         # p95 signal even on a metrics-off runtime (append is O(1), bounded).
         with self._samples_lock:
-            self._wait_samples.append((time.time(), wait))
+            self._wait_samples.append((time.time(), wait, trace_id))
         m = _metrics()
         if m is not None:
             tags = {"deployment": self._name}
             m["requests"].inc(1, tags)
             # Route wait: table fetch + lock + replica pick + submit — the
             # router-side queueing a request pays before reaching a replica.
-            m["route_wait"].observe(wait, tags)
+            # The trace id rides as an EXEMPLAR: a route-wait observation in
+            # the series store links back to the concrete trace that paid it.
+            m["route_wait"].observe(wait, tags, exemplar=trace_id)
         return ref, chosen.replica_id
 
     def report_failure(self, replica_id: str):
@@ -501,14 +557,16 @@ class _ReplicaStream:
     delivered) is never transparently retried."""
 
     def __init__(self, router: Router, method_name: str, args, kwargs,
-                 raw_method: bool = False):
+                 raw_method: bool = False, trace_ctx=None):
         from ray_tpu._private import retry
         from ray_tpu._private.config import get_config
 
         self._router = router
         self._call = (method_name, args, kwargs, raw_method)
+        self._trace_ctx = trace_ctx  # request envelope context (HTTP proxy)
         self._gen, self._rid = router.route(
-            method_name, args, kwargs, stream=True, raw_method=raw_method
+            method_name, args, kwargs, stream=True, raw_method=raw_method,
+            trace_ctx=trace_ctx,
         )
         self._got_first = False
         cfg = get_config()
@@ -559,7 +617,7 @@ class _ReplicaStream:
                 method, args, kwargs, raw = self._call
                 self._gen, self._rid = self._router.route(
                     method, args, kwargs, force_refresh=True,
-                    stream=True, raw_method=raw,
+                    stream=True, raw_method=raw, trace_ctx=self._trace_ctx,
                 )
             except BaseException:
                 # User exception from the deployment (or any other failure):

@@ -194,6 +194,12 @@ def _fmt_tags(tags: Dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
+# Per-series exemplar bound: the last few (ts, value, trace_id) samples ride
+# the snapshot so the series store can link an observation back to the
+# concrete trace that produced it (the Prometheus/OpenMetrics exemplar idea).
+_EXEMPLAR_CAP = 4
+
+
 class Metric:
     def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
         self.name = name
@@ -201,6 +207,9 @@ class Metric:
         self.tag_keys = tuple(tag_keys)
         self._lock = threading.Lock()
         self._default_tags: Dict[str, str] = {}
+        # series key -> [(ts, value, trace_id), ...] (bounded, newest last);
+        # only observations that CARRIED a trace id land here.
+        self._exemplars: Dict[Tuple, List[tuple]] = {}
         _registry.register(self)
 
     def set_default_tags(self, tags: Dict[str, str]) -> None:
@@ -209,6 +218,20 @@ class Metric:
     def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
         merged = {**self._default_tags, **(tags or {})}
         return tuple(sorted(merged.items()))
+
+    def _note_exemplar(self, k: Tuple, value: float, trace_id) -> None:
+        """Record one traced observation for series `k` (caller holds the
+        metric lock). None trace ids are ignored — untraced traffic never
+        grows this map."""
+        if not trace_id:
+            return
+        ex = self._exemplars.setdefault(k, [])
+        ex.append((time.time(), float(value), str(trace_id)))
+        if len(ex) > _EXEMPLAR_CAP:
+            del ex[: len(ex) - _EXEMPLAR_CAP]
+
+    def _exemplar_snapshot(self):
+        return [(list(k), list(v)) for k, v in self._exemplars.items() if v]
 
 
 class Counter(Metric):
@@ -236,16 +259,23 @@ class Gauge(Metric):
         super().__init__(name, description, tag_keys)
         self._values: Dict[Tuple, float] = {}
 
-    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None,
+            exemplar: Optional[str] = None) -> None:
         with self._lock:
-            self._values[self._key(tags)] = float(value)
+            k = self._key(tags)
+            self._values[k] = float(value)
+            self._note_exemplar(k, value, exemplar)
 
     def _snapshot(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "name": self.name, "type": "gauge", "help": self.help,
                 "series": [(list(k), v) for k, v in self._values.items()],
             }
+            ex = self._exemplar_snapshot()
+            if ex:
+                out["exemplars"] = ex
+            return out
 
 
 class Histogram(Metric):
@@ -255,7 +285,8 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys)
         self._data: Dict[Tuple, dict] = {}
 
-    def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None,
+                exemplar: Optional[str] = None) -> None:
         with self._lock:
             k = self._key(tags)
             d = self._data.setdefault(
@@ -267,6 +298,7 @@ class Histogram(Metric):
                     break
             d["sum"] += value
             d["count"] += 1
+            self._note_exemplar(k, value, exemplar)
 
     def _merge_counts(self, bucket_counts: Sequence[int], count: int, total: float,
                       tags: Optional[Dict[str, str]] = None) -> None:
@@ -286,8 +318,12 @@ class Histogram(Metric):
 
     def _snapshot(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "name": self.name, "type": "histogram", "help": self.help,
                 "buckets": list(self.boundaries),
                 "series": [(list(k), dict(v)) for k, v in self._data.items()],
             }
+            ex = self._exemplar_snapshot()
+            if ex:
+                out["exemplars"] = ex
+            return out

@@ -144,6 +144,77 @@ def on_alert(callback) -> None:
     obs.engine.add_callback(callback)
 
 
+# ---------------------------------------------------------------- tracing
+def _trace_inputs(trace_id: Optional[str] = None):
+    """(spans, {task_id_hex: stages}) joined from the head's trace-span ring
+    and the task-event ring — the two halves critical-path attribution
+    needs. Flushes this process's span buffer first."""
+    from ray_tpu.util import tracing
+
+    _auto_init()
+    tracing.flush_spans()
+    ctx = global_worker.context
+    payload = {"trace_id": trace_id} if trace_id else None
+    spans = ctx.list_spans(payload)
+    stages: Dict[str, Dict[str, float]] = {}
+    for ev in ctx.task_events():
+        if getattr(ev, "stages", None):
+            stages[ev.task_id] = ev.stages
+    return spans, stages
+
+
+def list_traces(limit: int = 50) -> List[Dict[str, Any]]:
+    """Newest-last trace summaries from the head's span ring: root span,
+    wall time, span count, status, and whether the trace survived sampling
+    by tail-keep (a slow outlier)."""
+    from ray_tpu._private import critical_path
+
+    spans, _stages = _trace_inputs()
+    traces = critical_path.group_traces(spans)
+    out = sorted(
+        (critical_path.trace_summary(tid, ss) for tid, ss in traces.items()),
+        key=lambda t: t["start"],
+    )
+    limit = max(0, int(limit))
+    return out[-limit:] if limit else []
+
+
+def get_trace(trace_id: str) -> Dict[str, Any]:
+    """One trace end-to-end: its spans (parent-linked), the joined per-task
+    stage stamps, and the critical-path attribution (which component owns
+    each slice of the trace's wall time)."""
+    from ray_tpu._private import critical_path
+
+    spans, stages = _trace_inputs(trace_id)
+    if not spans:
+        raise KeyError(f"no spans recorded for trace {trace_id!r}")
+    summary = critical_path.trace_summary(trace_id, spans)
+    attribution = critical_path.attribute(spans, stages)
+    task_ids = {
+        (s.get("attributes") or {}).get("task_id")
+        for s in spans
+    } - {None}
+    return {
+        **summary,
+        "spans": sorted(spans, key=lambda s: s["start"]),
+        "stages": {t: stages[t] for t in task_ids if t in stages},
+        "attribution": attribution,
+    }
+
+
+def latency_report(limit: int = 200) -> Dict[str, Any]:
+    """'Where does p95 actually go': critical-path attribution aggregated
+    over the newest `limit` traces — per-component totals and shares
+    (submit / head_loop / arg_transfer / exec / store_results /
+    done_delivery / proxy_queue / route), plus p50/p95 of per-trace wall
+    time. head_loop is the open-item-1 instrument: the time every dispatch
+    still spends transiting the head loop."""
+    from ray_tpu._private import critical_path
+
+    spans, stages = _trace_inputs()
+    return critical_path.latency_report(spans, stages, limit=limit)
+
+
 def memory_summary() -> Dict[str, Any]:
     """`ray memory` analogue: per-object owner/refcount/location/size from
     the scheduler's ownership tables joined with the on-disk store state,

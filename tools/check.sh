@@ -48,6 +48,10 @@ echo "== observability smoke (series history, event log, shed alert fire->resolv
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
 echo
+echo "== trace smoke (one Serve request traced proxy->router->replica->task, latency report) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/trace_smoke.py
+
+echo
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

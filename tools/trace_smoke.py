@@ -1,0 +1,87 @@
+"""Tracing smoke for tools/check.sh: on a mini-cluster, one Serve HTTP
+request must yield ONE connected trace spanning proxy -> router -> replica
+-> nested task, and state.latency_report() must attribute its wall time to
+named components (non-empty, >=95% coverage). Fast (<~60s) and
+assertion-fatal — a broken propagation seam fails the pre-merge gate
+before tier-1 runs."""
+
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.util import state, tracing
+
+    ray_tpu.init(num_cpus=4, _system_config={"trace_sample_rate": 1.0})
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        def nested(x):
+            return x * 2
+
+        @serve.deployment
+        class App:
+            def __call__(self, req):
+                return {"out": ray_tpu.get(nested.remote(21))}
+
+        serve.run(App.bind(), route_prefix="/app")
+        from ray_tpu._private.worker import global_worker
+
+        port = global_worker.context.serve_directory()[0]["port"]
+        resp = urllib.request.urlopen(f"http://127.0.0.1:{port}/app",
+                                      timeout=30)
+        assert resp.status == 200, resp.status
+        assert b"42" in resp.read()
+
+        deadline = time.time() + 20
+        trace = None
+        while time.time() < deadline:
+            req_traces = [t for t in state.list_traces()
+                          if t["root_kind"] == "request"]
+            if req_traces:
+                t = state.get_trace(req_traces[-1]["trace_id"])
+                kinds = {s["kind"] for s in t["spans"]}
+                if {"request", "router", "submit", "execute"} <= kinds and any(
+                    "nested" in s["name"] for s in t["spans"]
+                ):
+                    trace = t
+                    break
+            time.sleep(0.3)
+        assert trace is not None, "no connected request trace appeared"
+        assert len({s["trace_id"] for s in trace["spans"]}) == 1
+        span_ids = {s["span_id"] for s in trace["spans"]}
+        for s in trace["spans"]:
+            if s.get("parent_id"):
+                assert s["parent_id"] in span_ids, s
+        print(f"trace: {len(trace['spans'])} spans, one trace id, "
+              f"parents linked OK")
+
+        attr = trace["attribution"]
+        assert attr["coverage"] >= 0.95, attr
+        rep = state.latency_report()
+        assert rep["traces"] >= 1 and rep["components"], rep
+        assert rep["coverage"] >= 0.95, rep
+        top = ", ".join(
+            f"{k}={v['share'] * 100:.0f}%"
+            for k, v in list(rep["components"].items())[:4]
+        )
+        print(f"latency_report: {rep['traces']} trace(s), "
+              f"coverage {rep['coverage'] * 100:.1f}%, {top} OK")
+        print("trace smoke OK")
+        return 0
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
