@@ -60,6 +60,10 @@ REQUIRED_METRICS = (
     # (ISSUE 14 acceptance: ratio >= 0.95 — the hard floor below enforces
     # it; the trajectory gate guards drift on top).
     "task_throughput_tracing_ratio",
+    # Training step clock + goodput ledger vs enable_metrics off: the
+    # per-step observability costs <= 5% of a mini gang's steps/s (ISSUE 17
+    # acceptance: the hard floor below enforces it).
+    "train_step_obs_ratio",
 )
 
 # Data-plane suite (bench_dataplane.py -> BENCH_DATAPLANE.json): the
@@ -109,6 +113,9 @@ HARD_FLOORS = {
     # Always-on tracing at the default sample rate costs <= 5% task
     # throughput (ISSUE 14 acceptance criterion).
     "task_throughput_tracing_ratio": 0.95,
+    # Training-gang observability (step clock, skew fold, goodput ledger)
+    # costs <= 5% step throughput (ISSUE 17 acceptance criterion).
+    "train_step_obs_ratio": 0.95,
     # Shed-not-collapse: at 2x offered load, goodput must hold >= 80% of
     # single-proxy capacity (admission control converts overload into fast
     # 503s, never latency collapse).

@@ -516,6 +516,69 @@ def main():
         }
     )
 
+    # ------------------------------------------------- train-step obs overhead
+    # The training step clock + goodput ledger ride the existing report path
+    # (one perf_counter pair per phase seam, one driver-side fold per round)
+    # — steps/s of a mini 2-worker gang with the full stack on must stay
+    # within 5% of enable_metrics=False (ISSUE 17 acceptance: >= 0.95 hard
+    # floor in bench_check). Steps/s is measured INSIDE rank 0's loop
+    # (best-of-3 segments), so gang bring-up can't dilute the ratio toward
+    # 1.0. FRESH interpreter per measurement, same rationale as the obs
+    # probe above (process-global metric registry).
+    _train_probe = (
+        "import time, json, sys, ray_tpu\n"
+        "cfg = json.loads(sys.argv[1])\n"
+        "ray_tpu.init(num_cpus=4, _system_config=cfg)\n"
+        "from ray_tpu.train.data_parallel_trainer import DataParallelTrainer\n"
+        "from ray_tpu.air import ScalingConfig\n"
+        "def _loop(config):\n"
+        "    from ray_tpu.air import session\n"
+        "    best = 0.0\n"
+        "    for _ in range(20):\n"
+        "        session.report({})\n"
+        "    for _ in range(3):\n"
+        "        t0 = time.perf_counter()\n"
+        "        for _ in range(100):\n"
+        "            session.report({})\n"
+        "        best = max(best, 100 / (time.perf_counter() - t0))\n"
+        "    session.report({'steps_s': best})\n"
+        "r = DataParallelTrainer(\n"
+        "    _loop, scaling_config=ScalingConfig(num_workers=2)).fit()\n"
+        "assert r.error is None, r.error\n"
+        "print('OPS', r.metrics['steps_s'])\n"
+        "ray_tpu.shutdown()\n"
+    )
+
+    def train_steps_throughput(cfg: dict) -> float:
+        proc = _subprocess.run(
+            [_sys.executable, "-c", _train_probe, json.dumps(cfg)],
+            env=dict(_os.environ), capture_output=True, text=True,
+            timeout=600,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("OPS "):
+                return float(line.split()[1])
+        raise RuntimeError(
+            f"train obs probe (cfg={cfg!r}) produced no OPS line:\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+
+    train_on = train_off = 0.0
+    for _ in range(3):
+        train_on = max(train_on, train_steps_throughput({}))
+        train_off = max(
+            train_off, train_steps_throughput({"enable_metrics": False})
+        )
+    results.append(
+        {
+            "metric": "train_step_obs_ratio",
+            "value": round(train_on / train_off, 3),
+            "unit": "ratio",
+            "obs_on_steps_s": round(train_on, 1),
+            "obs_off_steps_s": round(train_off, 1),
+        }
+    )
+
     # ---------------------------------------------------- profiler off-path
     # The introspection layer must be free when idle: with enable_profiler
     # left at its default (enabled, no session running) there is no sampler

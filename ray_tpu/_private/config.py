@@ -340,6 +340,18 @@ class Config:
     # barrier in util/collective/rendezvous.py).
     collective_timeout_s: float = 120.0
 
+    # --- training-gang observability (train/_internal, gated by
+    # enable_metrics like everything else) ---
+    # Per-round step-time skew (slowest rank minus fastest rank) above which
+    # a gang is considered to have a straggler. Drives both the driver-side
+    # `train_straggler` cluster event and, via threshold_config_frac, the
+    # `train_straggler` alert rule on ray_tpu_train_step_skew_seconds.
+    train_straggler_skew_s: float = 1.0
+    # How long the skew must stay above the threshold before the driver
+    # emits the train_straggler event (hysteresis mirror of the alert
+    # rule's for_s, evaluated per result round on the BackendExecutor).
+    train_straggler_for_s: float = 2.0
+
     # --- worker process ---
     # Stream worker stdout/stderr to subscribed drivers (init(log_to_driver=)).
     log_to_driver: bool = True

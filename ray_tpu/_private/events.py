@@ -45,6 +45,8 @@ EVENT_KINDS = (
     "serve_proxy_failover",
     "serve_replica_failover",
     "serve_scale",
+    "train_gang_recover",
+    "train_straggler",
     "worker_dead",
     "worker_started",
     "worker_suspect",

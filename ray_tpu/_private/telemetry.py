@@ -469,7 +469,10 @@ _collective_hist = None
 
 
 def collective_histogram():
-    """Lazy per-op wall-time histogram (tags: op, group)."""
+    """Lazy per-op wall-time histogram (tags: op, group, rank, status).
+    `rank` names which gang member observed the time; `status` is "ok" or
+    "error" — a collective that raises records a sample too (a hung/failed
+    collective must not be invisible)."""
     global _collective_hist
     if _collective_hist is None:
         from ray_tpu.util.metrics import Histogram
@@ -477,9 +480,61 @@ def collective_histogram():
         _collective_hist = Histogram(
             "ray_tpu_collective_op_seconds",
             "collective op wall time", boundaries=_LATENCY_BUCKETS,
-            tag_keys=("op", "group"),
+            tag_keys=("op", "group", "rank", "status"),
         )
     return _collective_hist
+
+
+_rendezvous_hist = None
+
+
+def rendezvous_wait_histogram():
+    """Lazy rendezvous-wait histogram: how long a rank blocked in
+    rendezvous.wait_for before the key appeared (count = number of waits,
+    sum = wait-seconds — the gang-formation stall signal the goodput
+    ledger's rendezvous_wait bucket reads)."""
+    global _rendezvous_hist
+    if _rendezvous_hist is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _rendezvous_hist = Histogram(
+            "ray_tpu_collective_rendezvous_wait_seconds",
+            "time blocked waiting on a collective rendezvous key",
+            boundaries=_LATENCY_BUCKETS,
+        )
+    return _rendezvous_hist
+
+
+# ------------------------------------------------------------------ training
+_train_metrics: Optional[dict] = None
+
+
+def train_metrics() -> dict:
+    """Lazy training-gang metric set. The per-step phase histogram is
+    observed by each worker's _TrainSession step clock (tags: phase, gang,
+    rank); the skew gauge is set by the driver-side BackendExecutor per
+    result round (tag: gang) and is what the `train_straggler` alert rule
+    watches."""
+    global _train_metrics
+    if _train_metrics is None:
+        from ray_tpu.util.metrics import Gauge, Histogram
+
+        _train_metrics = {
+            "step_seconds": Histogram(
+                "ray_tpu_train_step_seconds",
+                "training-step phase wall time per rank "
+                "(data_wait/compile/step_exec/collective/report/checkpoint)",
+                boundaries=_LATENCY_BUCKETS,
+                tag_keys=("phase", "gang", "rank"),
+            ),
+            "step_skew": Gauge(
+                "ray_tpu_train_step_skew_seconds",
+                "per-round step-time skew across a training gang "
+                "(slowest rank minus fastest rank)",
+                ("gang",),
+            ),
+        }
+    return _train_metrics
 
 
 # --------------------------------------------------------------- serve router

@@ -109,6 +109,21 @@ DEFAULT_ALERT_RULES = [
         "severity": "critical",
         "summary": "At least one node is heartbeat-SUSPECT",
     },
+    {
+        # Training-gang straggler: the BackendExecutor publishes per-round
+        # step-time skew (slowest minus fastest rank) as a gauge per gang;
+        # sustained skew above the config knob means one rank is holding
+        # every collective hostage. The driver additionally emits a
+        # train_straggler event that NAMES the slow rank and its dominant
+        # phase (data the head-side engine does not have).
+        "name": "train_straggler",
+        "metric": "ray_tpu_train_step_skew_seconds",
+        "kind": "gauge", "agg": "max", "window_s": 15.0,
+        "op": ">", "threshold_config_frac": ["train_straggler_skew_s", 1.0],
+        "for_s": 2.0,
+        "severity": "warning",
+        "summary": "A training-gang rank is straggling its steps",
+    },
 ]
 
 

@@ -40,6 +40,18 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) 
     _require_session().report(metrics, checkpoint=checkpoint)
 
 
+def mark_phase(phase: str) -> None:
+    """Mark the step clock's phase seam from the training loop: one of
+    data_wait | compile | step_exec | collective | report | checkpoint.
+    Wall time accrues into the *current* phase until the next mark (steps are
+    closed by `report`). No-op outside a Train worker session or with
+    observability off, so loops can mark unconditionally."""
+    sess = _require_session()
+    marker = getattr(sess, "mark_phase", None)
+    if marker is not None:
+        marker(phase)
+
+
 def get_checkpoint() -> Optional[Checkpoint]:
     """The checkpoint to resume from (set on restart after failure), else None."""
     return _require_session().loaded_checkpoint

@@ -21,7 +21,7 @@ class DashboardServer:
     VALID_KINDS = (
         "actors", "alerts", "cluster", "events", "jobs", "latency", "memory",
         "nodes", "objects", "profile", "serve", "series", "stacks", "tasks",
-        "timeline", "traces",
+        "timeline", "traces", "train",
     )
     # Ceiling on `/api/profile?duration=` (the handler blocks an executor
     # thread for the duration).
@@ -139,6 +139,9 @@ class DashboardServer:
             # Cluster-wide sampling profile; blocks this executor thread
             # for ?duration= seconds (default 1).
             return state_api.profile(duration if duration is not None else 1.0)
+        if kind == "train":
+            # Training-gang goodput ledgers: ?gang= for one fit's report.
+            return state_api.training_report((query or {}).get("gang"))
         if kind == "jobs":
             from ray_tpu.job_submission import JobSubmissionClient
 

@@ -356,6 +356,38 @@ def cmd_latency(ns):
               f"{row['share'] * 100:>6.1f}%")
 
 
+def cmd_train(ns):
+    """Training-gang goodput ledgers: wall time split into productive vs
+    badput buckets, current skew, and the named straggler per gang."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    rep = state_api.training_report(ns.gang)
+    if ns.json:
+        print(json.dumps(rep, indent=2, default=str))
+        return
+    gangs = rep["gangs"]
+    if not gangs:
+        print("(no training gangs — is enable_metrics on?)")
+        return
+    for gang_id, g in sorted(gangs.items()):
+        wall = g.get("wall_s", 0.0) or 0.0
+        print(f"gang {gang_id}  [{g.get('status', '?')}]  "
+              f"world_size={g.get('world_size', '?')}  steps={g.get('steps', 0)}  "
+              f"failures={g.get('failures', 0)}")
+        print(f"  wall {wall:.2f}s  goodput {g.get('goodput_frac', 0.0) * 100:.1f}%  "
+              f"coverage {g.get('coverage', 0.0) * 100:.1f}%")
+        for bucket, secs in (g.get("buckets") or {}).items():
+            share = secs / wall * 100 if wall > 0 else 0.0
+            print(f"    {bucket:<16} {secs:>10.3f}s {share:>6.1f}%")
+        straggler = g.get("straggler")
+        if straggler:
+            print(f"  straggler: rank {straggler['rank']} "
+                  f"(dominant phase {straggler['phase']}, "
+                  f"skew {straggler['skew_s']:.3f}s; "
+                  f"current skew {g.get('skew_s', 0.0):.3f}s)")
+
+
 def _render_top(state_api, iteration: int) -> str:
     """One frame of `ray_tpu top`, built entirely on the query/state APIs.
     Degrades gracefully when the obs layer is off (shows a notice instead
@@ -571,6 +603,13 @@ def main(argv=None) -> None:
     sp.add_argument("--json", action="store_true")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_latency)
+
+    sp = sub.add_parser("train", help="training-gang goodput ledgers "
+                                      "(phase split, straggler, badput)")
+    sp.add_argument("--gang", help="one gang id (default: all gangs)")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_train)
 
     sp = sub.add_parser("top", help="live refreshing cluster view")
     sp.add_argument("--interval", type=float, default=2.0)

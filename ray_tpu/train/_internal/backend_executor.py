@@ -9,6 +9,7 @@ restarts the full gang from the last checkpoint.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
@@ -25,20 +26,37 @@ class TrainingWorkerError(Exception):
     """A worker of the gang failed; the gang must be restarted as a unit."""
 
 
+def _rendezvous_wait_total() -> float:
+    """Runs on a worker: process-lifetime seconds blocked in collective
+    rendezvous (includes jax.distributed.initialize gang-join)."""
+    from ray_tpu.util.collective import rendezvous
+
+    return float(rendezvous._WAIT_STATS["wait_s"])
+
+
 class BackendExecutor:
     def __init__(
         self,
         backend_config: BackendConfig,
         scaling_config: ScalingConfig,
         trial_info: Optional[Dict[str, str]] = None,
+        gang_id: str = "",
+        ledger=None,
     ):
         self._backend_config = backend_config
         self._backend = backend_config.backend_cls()
         self._scaling = scaling_config
         self._trial_info = trial_info or {}
+        self._gang_id = gang_id or self._trial_info.get("trial_id") or "default"
+        self._ledger = ledger  # GoodputLedger (driver-owned) or None
         self._pg = None
         self.worker_group: Optional[WorkerGroup] = None
         self._ranks: List[int] = []
+        # Straggler hysteresis: when the per-round skew first breached, and
+        # whether the sustained-breach event already fired for this episode.
+        self._skew_breach_since: Optional[float] = None
+        self._skew_event_sent = False
+        self._skew_gauge_touched = False
 
     # ------------------------------------------------------------------ start
     def start(self):
@@ -122,6 +140,7 @@ class BackendExecutor:
                     info["world_rank"]
                 ],
                 mesh_builder=mesh_builder,
+                gang_id=self._gang_id,
                 **self._trial_info,
             )
             refs.append(w.init_session.remote(args))
@@ -129,6 +148,20 @@ class BackendExecutor:
             ray_tpu.get(refs)
         except Exception as e:
             raise TrainingWorkerError(f"gang startup failed: {e}") from e
+
+    def gang_rendezvous_seconds(self) -> float:
+        """Gang-mean seconds the workers spent blocked in rendezvous so far
+        (the ledger's rendezvous_wait share of bring-up). Best-effort: 0.0
+        when observability is off or the gang is unreachable."""
+        from ray_tpu._private.telemetry import metrics_enabled
+
+        if not metrics_enabled() or self.worker_group is None:
+            return 0.0
+        try:
+            totals = self.worker_group.execute(_rendezvous_wait_total)
+        except Exception:  # noqa: BLE001 — dying gang; caller handles failure
+            return 0.0
+        return sum(totals) / len(totals) if totals else 0.0
 
     def get_next_results(self) -> Optional[List[TrainingResult]]:
         """One result per worker (ordered by world rank), or None when all DONE.
@@ -153,10 +186,111 @@ class BackendExecutor:
             raise TrainingWorkerError(
                 "workers out of sync: mixed DONE and REPORT results in one round"
             )
+        self._fold_results(by_rank)
         return by_rank
+
+    def _fold_results(self, by_rank: List[TrainingResult]) -> None:
+        """Per-round observability fold: gang skew gauge, straggler naming
+        (slowest rank + its dominant phase excess over the gang mean), the
+        sustained-breach train_straggler event, and the goodput ledger."""
+        pairs = [(r.world_rank, r.telemetry) for r in by_rank if r.telemetry]
+        straggler = None
+        skew = 0.0
+        per_rank: Dict[str, Dict[str, Any]] = {}
+        if len(pairs) == len(by_rank) and len(pairs) >= 2:
+            # Skew is computed on ACTIVE time, not raw step wall: the gang
+            # runs lockstep (bounded result queue + collectives), so every
+            # rank's wall converges to the slowest rank's. Waiting-for-others
+            # time — report-queue backpressure and collective arrival offset
+            # (how early this rank reached the rendezvous) — is subtracted;
+            # what's left is the rank's own work, where a straggler shows.
+            walls = {}
+            for rk, t in pairs:
+                wait = (t.get("phases") or {}).get("report", 0.0) + float(
+                    t.get("arrival_offset_s", 0.0)
+                )
+                walls[rk] = max(0.0, float(t.get("step_wall_s", 0.0)) - wait)
+            slow = max(walls, key=walls.get)
+            skew = walls[slow] - min(walls.values())
+            n = len(pairs)
+            means: Dict[str, float] = {}
+            for _, t in pairs:
+                for p, v in (t.get("phases") or {}).items():
+                    means[p] = means.get(p, 0.0) + v / n
+            slow_phases = dict(
+                next(t for rk, t in pairs if rk == slow).get("phases") or {}
+            )
+            excess = {
+                p: slow_phases.get(p, 0.0) - means.get(p, 0.0)
+                for p in set(slow_phases) | set(means)
+            }
+            dominant = max(excess, key=excess.get) if excess else "step_exec"
+            straggler = {
+                "rank": slow,
+                "phase": dominant,
+                "skew_s": round(skew, 6),
+                "active_s": round(walls[slow], 6),
+            }
+            per_rank = {
+                str(rk): {
+                    "step_wall_s": round(float(t.get("step_wall_s", 0.0)), 6),
+                    "phases": {
+                        p: round(v, 6)
+                        for p, v in (t.get("phases") or {}).items()
+                    },
+                }
+                for rk, t in pairs
+            }
+            from ray_tpu._private.telemetry import metrics_enabled, train_metrics
+
+            if metrics_enabled():
+                train_metrics()["step_skew"].set(skew, {"gang": self._gang_id})
+                self._skew_gauge_touched = True
+            from ray_tpu._private.config import get_config
+
+            cfg = get_config()
+            if skew > cfg.train_straggler_skew_s:
+                now = time.monotonic()
+                if self._skew_breach_since is None:
+                    self._skew_breach_since = now
+                elif (
+                    not self._skew_event_sent
+                    and now - self._skew_breach_since >= cfg.train_straggler_for_s
+                ):
+                    from ray_tpu._private.events import emit_event
+
+                    emit_event(
+                        "train_straggler",
+                        f"gang {self._gang_id}: rank {slow} is straggling "
+                        f"(skew {skew:.3f}s, dominant phase {dominant})",
+                        severity="warning",
+                        source="train-driver",
+                        gang=self._gang_id,
+                        rank=slow,
+                        phase=dominant,
+                        skew_s=round(skew, 6),
+                    )
+                    self._skew_event_sent = True
+            else:
+                self._skew_breach_since = None
+                self._skew_event_sent = False
+        if self._ledger is not None:
+            self._ledger.note_skew(skew, straggler, per_rank)
+            self._ledger.fold_round([t for _, t in pairs])
 
     # ---------------------------------------------------------------- shutdown
     def shutdown(self):
+        if self._skew_gauge_touched:
+            # The driver registry re-flushes a gauge's last value forever;
+            # left non-zero after the gang ends, the train_straggler alert
+            # would never resolve. Park it at 0 explicitly.
+            try:
+                from ray_tpu._private.telemetry import train_metrics
+
+                train_metrics()["step_skew"].set(0.0, {"gang": self._gang_id})
+            except Exception:  # noqa: BLE001
+                pass
+            self._skew_gauge_touched = False
         if self.worker_group is not None:
             try:
                 self._backend.on_shutdown(self, self._backend_config)

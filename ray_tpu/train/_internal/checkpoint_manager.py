@@ -30,14 +30,30 @@ class CheckpointManager:
         return Checkpoint.from_directory(self._kept[-1][0]) if self._kept else None
 
     def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
-        """Persist a reported checkpoint; returns the durable directory form."""
-        self._count += 1
-        path = os.path.join(self.run_dir, f"checkpoint_{self._count:06d}")
-        checkpoint.to_directory(path)
-        self._kept.append((path, dict(metrics or {})))
-        self._prune()
-        self._write_manifest()
-        return Checkpoint.from_directory(path)
+        """Persist a reported checkpoint; returns the durable directory form.
+        The persist is a "checkpoint_persist" span on the run's timeline (the
+        driver-side half of the checkpoint phase; the goodput ledger accounts
+        its wall time into the checkpoint bucket)."""
+        from ray_tpu._private.config import get_config
+        from ray_tpu.util import tracing
+
+        span = None
+        if get_config().enable_timeline or tracing.is_enabled():
+            span = tracing.start_span(
+                "checkpoint_persist", "train",
+                attributes={"index": str(self._count + 1)},
+            )
+        try:
+            self._count += 1
+            path = os.path.join(self.run_dir, f"checkpoint_{self._count:06d}")
+            checkpoint.to_directory(path)
+            self._kept.append((path, dict(metrics or {})))
+            self._prune()
+            self._write_manifest()
+            return Checkpoint.from_directory(path)
+        finally:
+            if span is not None:
+                tracing.end_span(span)
 
     def _manifest_path(self) -> str:
         # One hidden manifest for the whole run (never matches checkpoint_*

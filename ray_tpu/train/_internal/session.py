@@ -32,6 +32,9 @@ class TrainingResult:
     checkpoint: Optional[Checkpoint] = None
     error: Optional[str] = None
     world_rank: int = 0
+    # Step-clock payload (train/_internal/telemetry.py): per-step phase split
+    # on REPORT, cumulative totals on DONE. None when observability is off.
+    telemetry: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -50,6 +53,9 @@ class SessionArgs:
     checkpoint: Optional[Checkpoint] = None
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
     mesh_builder: Optional[Callable] = None  # () -> jax Mesh, run in-thread
+    # Stable id shared by every rank (and every restart) of one fit() — the
+    # `gang` tag on train metrics and the training_report KV key.
+    gang_id: str = ""
 
 
 class _TrainSession:
@@ -66,20 +72,46 @@ class _TrainSession:
         self.experiment_name = args.experiment_name
         self.loaded_checkpoint = args.checkpoint
         self.dataset_shards = args.dataset_shards
+        self.gang_id = args.gang_id or args.trial_id or "default"
         self.mesh = None
+        self._clock = None  # StepClock, built in-thread by _run
         self._q: "queue.Queue[TrainingResult]" = queue.Queue(maxsize=1)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._finished = threading.Event()
 
     # ----------------------------------------------------------- thread side
     def _run(self):
+        from ray_tpu.train._internal.telemetry import make_clock
+
         air_session._set_session(self)
         try:
+            # Built here, not in __init__: the train_step span must live in
+            # this thread so collective spans auto-parent under it.
+            self._clock = make_clock(self.gang_id, self.world_rank)
             if self.args.mesh_builder is not None:
+                if self._clock is not None:
+                    self._clock.mark("compile")
                 self.mesh = self.args.mesh_builder()
+                if self._clock is not None:
+                    self._clock.mark("step_exec")
             self.args.train_fn(self.args.config)
-            self._q.put(TrainingResult(DONE, world_rank=self.world_rank))
+            done = TrainingResult(DONE, world_rank=self.world_rank)
+            if self._clock is not None:
+                totals = self._clock.finalize()
+                if self._clock.metrics_on:
+                    done.telemetry = totals
+                    # The driver kills gang workers right after DONE — don't
+                    # let a short run's step samples die in the 1 Hz flusher.
+                    try:
+                        from ray_tpu.util.metrics import flush_metrics
+
+                        flush_metrics()
+                    except Exception:  # noqa: BLE001
+                        pass
+            self._q.put(done)
         except BaseException as e:  # noqa: BLE001 - forwarded to the driver
+            if self._clock is not None:
+                self._clock.finalize()
             self._q.put(
                 TrainingResult(
                     ERROR,
@@ -91,13 +123,38 @@ class _TrainSession:
             self._finished.set()
             air_session._set_session(None)
 
+    def mark_phase(self, phase: str) -> None:
+        """Explicit phase seam from the user loop (air.session.mark_phase).
+        No-op when observability is off — marking costs nothing then."""
+        if self._clock is not None:
+            self._clock.mark(phase)
+
     def report(self, metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
-        self._q.put(
-            TrainingResult(
-                REPORT, metrics=dict(metrics), checkpoint=checkpoint,
-                world_rank=self.world_rank,
-            )
+        from ray_tpu._private import failpoints
+
+        if failpoints.ENABLED:
+            # Injection point for straggler (delay) and mid-step crash
+            # (recover accounting) scenarios: fires on the session thread
+            # with the step still open, like a real slow/dying rank.
+            failpoints.maybe_crash("train.step")
+        result = TrainingResult(
+            REPORT, metrics=dict(metrics), checkpoint=checkpoint,
+            world_rank=self.world_rank,
         )
+        clock = self._clock
+        if clock is None:
+            self._q.put(result)
+            return
+        telem = clock.close_step(checkpoint=checkpoint is not None)
+        if clock.metrics_on:
+            result.telemetry = telem
+        # The bounded-queue put is driver backpressure: accrue it as the
+        # report (or checkpoint) phase of the step now opening.
+        clock.mark("checkpoint" if checkpoint is not None else "report")
+        try:
+            self._q.put(result)
+        finally:
+            clock.mark("step_exec")
 
     # ----------------------------------------------------------- driver side
     def start(self):
@@ -105,6 +162,14 @@ class _TrainSession:
 
     def next_result(self, timeout: Optional[float] = None) -> TrainingResult:
         return self._q.get(timeout=timeout)
+
+    def telemetry_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Cumulative phase totals so far (driver-pollable, no step close).
+        Benign cross-thread read of monotone floats; None with obs off."""
+        clock = self._clock
+        if clock is None or not clock.metrics_on:
+            return None
+        return clock.snapshot()
 
     def finished(self) -> bool:
         return self._finished.is_set()

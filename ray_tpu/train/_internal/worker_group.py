@@ -42,6 +42,10 @@ class RayTrainWorker:
     def session_finished(self) -> bool:
         return session_mod.get_session().finished()
 
+    def session_telemetry(self) -> Optional[Dict[str, Any]]:
+        """Cumulative step-clock totals for this worker (None with obs off)."""
+        return session_mod.get_session().telemetry_snapshot()
+
     def shutdown_session(self) -> None:
         session_mod.shutdown_session()
 

@@ -11,6 +11,8 @@ all-or-nothing because a jax multi-controller program cannot resize
 
 from __future__ import annotations
 
+import itertools
+import os
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.air.checkpoint import Checkpoint
@@ -19,8 +21,13 @@ from ray_tpu.air.result import Result
 from ray_tpu.air import session as air_session
 from ray_tpu.train._internal.backend_executor import BackendExecutor, TrainingWorkerError
 from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
+from ray_tpu.train._internal.ledger import GoodputLedger
 from ray_tpu.train.backend import BackendConfig
 from ray_tpu.train.base_trainer import BaseTrainer
+
+# Distinguishes concurrent/successive fits from one driver when there is no
+# Tune trial id to serve as the gang id.
+_GANG_SEQ = itertools.count()
 
 
 class DataParallelTrainer(BaseTrainer):
@@ -98,11 +105,26 @@ class DataParallelTrainer(BaseTrainer):
         if hasattr(self.backend_config, "mesh_builder"):
             mesh_builder = self.backend_config.mesh_builder(self.scaling_config)
 
+        # One gang id (and one goodput ledger) per fit: restarts keep both so
+        # recovery shows up as badput of the same run, not a fresh ledger.
+        gang_id = (trial_info or {}).get("trial_id") or (
+            f"train-{os.getpid()}-{next(_GANG_SEQ)}"
+        )
+        from ray_tpu._private.telemetry import metrics_enabled
+
+        ledger = (
+            GoodputLedger(gang_id, self.scaling_config.num_workers)
+            if metrics_enabled()
+            else None
+        )
+
         while True:
             executor = BackendExecutor(
-                self.backend_config, self.scaling_config, trial_info
+                self.backend_config, self.scaling_config, trial_info,
+                gang_id=gang_id, ledger=ledger,
             )
             try:
+                recovering = failures > 0
                 executor.start()
                 executor.start_training(
                     self._train_fn,
@@ -111,6 +133,24 @@ class DataParallelTrainer(BaseTrainer):
                     dataset_shards=self._dataset_shards(),
                     mesh_builder=mesh_builder,
                 )
+                if ledger is not None:
+                    if recovering:
+                        # Detection + full gang restart: all recover badput.
+                        recover_s = ledger.account("recover")
+                        from ray_tpu._private.events import emit_event
+
+                        emit_event(
+                            "train_gang_recover",
+                            f"gang {gang_id}: restarted after worker failure "
+                            f"#{failures} ({recover_s:.2f}s to recover)",
+                            severity="warning",
+                            source="train-driver",
+                            gang=gang_id,
+                            failures=failures,
+                            recover_s=round(recover_s, 6),
+                        )
+                    else:
+                        ledger.account_init(executor.gang_rendezvous_seconds())
                 while True:
                     results = executor.get_next_results()
                     if results is None:
@@ -123,6 +163,9 @@ class DataParallelTrainer(BaseTrainer):
                     )
                     if ckpt is not None:
                         latest_ckpt = ckpt_mgr.register(ckpt, rank0.metrics)
+                        if ledger is not None:
+                            # Driver-side persist rides the checkpoint bucket.
+                            ledger.account("checkpoint")
                     if tune_session is not None:
                         # Forward to Tune so schedulers/search see every report.
                         tune_session.report(
@@ -130,6 +173,8 @@ class DataParallelTrainer(BaseTrainer):
                             checkpoint=ckpt if ckpt is not None else None,
                         )
                 executor.shutdown()
+                if ledger is not None:
+                    ledger.finalize("done")
                 return Result(
                     metrics=last_metrics,
                     checkpoint=ckpt_mgr.best_checkpoint(),
@@ -140,7 +185,11 @@ class DataParallelTrainer(BaseTrainer):
             except TrainingWorkerError as e:
                 executor.shutdown()
                 failures += 1
+                if ledger is not None:
+                    ledger.failures = failures
                 if max_failures >= 0 and failures > max_failures:
+                    if ledger is not None:
+                        ledger.finalize("failed")
                     return Result(
                         metrics=last_metrics,
                         checkpoint=ckpt_mgr.best_checkpoint(),
@@ -151,6 +200,8 @@ class DataParallelTrainer(BaseTrainer):
                 latest_ckpt = ckpt_mgr.latest_checkpoint or latest_ckpt
             except BaseException as e:  # driver-side bug: no retry
                 executor.shutdown()
+                if ledger is not None:
+                    ledger.finalize("failed")
                 if not isinstance(e, Exception):
                     raise  # KeyboardInterrupt/SystemExit must propagate
                 return Result(

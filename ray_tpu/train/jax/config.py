@@ -29,11 +29,22 @@ def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int)
 
     if num_processes <= 1:
         return len(jax.devices())
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    # initialize() blocks until every process joins — a gang rendezvous.
+    # Account the blocked time so the goodput ledger's rendezvous_wait bucket
+    # covers jax bring-up, not just the collective KV waits.
+    import time
+
+    from ray_tpu.util.collective import rendezvous
+
+    t0 = time.perf_counter()
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    finally:
+        rendezvous.note_wait(time.perf_counter() - t0)
     return len(jax.devices())
 
 

@@ -22,11 +22,40 @@ _groups: Dict[str, object] = {}
 _lock = threading.Lock()
 _RESERVED = object()
 
+# Plain per-process accumulators for the train-session step clock: ops and
+# wall-seconds spent inside collective calls, plus per-rank arrival offsets
+# reported back by the TCP coordinator (how much earlier this rank reached
+# the rendezvous than the last arriver — a fast rank accumulates offset, the
+# straggler accumulates ~none). Hot-path discipline: plain int/float bumps
+# here; the step clock diffs them per step and materializes Metric samples.
+_STATS = {
+    "ops": 0,
+    "errors": 0,
+    "time_s": 0.0,
+    "arrival_offset_s": 0.0,
+    "arrival_offsets": 0,
+}
+
+
+def _note_arrival_offset(offset_s: float) -> None:
+    """Called by collective groups when a completed op learns this rank's
+    arrival offset (seconds it arrived before the gang's last arriver)."""
+    _STATS["arrival_offset_s"] += float(offset_s)
+    _STATS["arrival_offsets"] += 1
+
+
+def _rank_tag(group_name: str) -> str:
+    g = _groups.get(group_name)
+    rank = getattr(g, "rank", None)
+    return str(rank) if rank is not None else "-"
+
 
 def _timed(op: str, group_name: str, fn):
     """Record a collective op's wall time: a ray_tpu_collective_op_seconds
     histogram sample (enable_metrics) and a "collective" span for the unified
-    timeline (enable_timeline or explicit tracing). Both off -> plain call."""
+    timeline (enable_timeline or explicit tracing). Both off -> plain call.
+    Ops that raise record too (status="error"): a hung or failed collective
+    must show up in the same series the healthy ones feed."""
     from ray_tpu._private.config import get_config
 
     cfg = get_config()
@@ -45,14 +74,29 @@ def _timed(op: str, group_name: str, fn):
     try:
         out = fn()
     except BaseException:
+        dt = time.perf_counter() - t0
+        _STATS["ops"] += 1
+        _STATS["errors"] += 1
+        _STATS["time_s"] += dt
+        if want_metric:
+            from ray_tpu._private.telemetry import collective_histogram
+
+            collective_histogram().observe(
+                dt, {"op": op, "group": group_name,
+                     "rank": _rank_tag(group_name), "status": "error"}
+            )
         if span is not None:
             tracing.end_span(span, "ERROR")
         raise
+    dt = time.perf_counter() - t0
+    _STATS["ops"] += 1
+    _STATS["time_s"] += dt
     if want_metric:
         from ray_tpu._private.telemetry import collective_histogram
 
         collective_histogram().observe(
-            time.perf_counter() - t0, {"op": op, "group": group_name}
+            dt, {"op": op, "group": group_name,
+                 "rank": _rank_tag(group_name), "status": "ok"}
         )
     if span is not None:
         tracing.end_span(span)

@@ -118,6 +118,9 @@ class _Coordinator:
         kind = msg["kind"]
         if kind in ("allreduce", "reduce", "broadcast", "allgather", "reducescatter", "barrier"):
             key = (kind, msg["seq"])
+            # Stamp arrival so _complete can hand every rank its offset from
+            # the gang's last arriver (straggler attribution upstream).
+            msg["_arrived"] = time.perf_counter()
             with self._cv:
                 self._contribs.setdefault(key, {})[rank] = msg
                 if len(self._contribs[key]) == self.world_size:
@@ -161,9 +164,15 @@ class _Coordinator:
             replies = {r: shards[r] for r in contribs}
         else:
             replies = {r: None for r in contribs}
+        # Arrival offsets: seconds each rank beat the last arriver to this
+        # rendezvous. The straggler's offset is ~0; fast ranks accumulate the
+        # time they spent waiting on it. Piggybacked on the reply — no extra
+        # round trip, no extra message.
+        last = max(contribs[r].get("_arrived", 0.0) for r in contribs)
         for r, reply in replies.items():
+            off = last - contribs[r].get("_arrived", last)
             try:
-                _send_msg(self._conns[r], {"data": reply})
+                _send_msg(self._conns[r], {"data": reply, "off": off})
             except (KeyError, OSError):
                 pass
 
@@ -225,7 +234,13 @@ class TCPGroup(BaseGroup):
     def _round_trip(self, msg: Dict[str, Any]) -> Any:
         with self._sock_lock:
             _send_msg(self._sock, msg)
-            return _recv_msg(self._sock)["data"]
+            reply = _recv_msg(self._sock)
+        off = reply.get("off")
+        if off is not None and off > 0.0:
+            from ray_tpu.util.collective import collective as _collective
+
+            _collective._note_arrival_offset(off)
+        return reply["data"]
 
     def _next_seq(self) -> int:
         self._seq += 1

@@ -7,9 +7,33 @@ from __future__ import annotations
 
 import time
 
+# Plain per-process accumulators (waits + blocked seconds) so the train-side
+# goodput ledger can bucket rendezvous time without the metrics pipeline; the
+# histogram below is the cluster-visible view and stays behind enable_metrics.
+_WAIT_STATS = {"waits": 0, "wait_s": 0.0}
+
 
 def publish(kv, key: bytes, value: bytes) -> None:
     kv("put", key, value)
+
+
+def note_wait(seconds: float, emit_metric: bool = True) -> None:
+    """Account `seconds` of rendezvous blocking. Other gang-join seams that
+    block outside wait_for (e.g. jax.distributed.initialize) call this so the
+    ledger's rendezvous_wait bucket sees them too."""
+    _WAIT_STATS["waits"] += 1
+    _WAIT_STATS["wait_s"] += float(seconds)
+    if not emit_metric:
+        return
+    try:
+        from ray_tpu._private.config import get_config
+
+        if get_config().enable_metrics:
+            from ray_tpu._private.telemetry import rendezvous_wait_histogram
+
+            rendezvous_wait_histogram().observe(float(seconds))
+    except Exception:  # noqa: BLE001 — telemetry must not break rendezvous
+        pass
 
 
 def wait_for(kv, key: bytes, timeout: float = None) -> bytes:
@@ -34,17 +58,21 @@ def wait_for(kv, key: bytes, timeout: float = None) -> bytes:
     )
     last_err = None
     transient = (failpoints.FailpointInjected,)
-    for _ in retry.attempts(policy, seed=retry.seed_from(key)):
-        try:
-            value = kv("get", key)
-        except transient as e:
-            last_err = e
-            continue
-        if value:
-            return value
-    raise TimeoutError(
-        f"rendezvous on {key!r} timed out after {timeout}s"
-    ) from last_err
+    t0 = time.perf_counter()
+    try:
+        for _ in retry.attempts(policy, seed=retry.seed_from(key)):
+            try:
+                value = kv("get", key)
+            except transient as e:
+                last_err = e
+                continue
+            if value:
+                return value
+        raise TimeoutError(
+            f"rendezvous on {key!r} timed out after {timeout}s"
+        ) from last_err
+    finally:
+        note_wait(time.perf_counter() - t0)
 
 
 def clear(kv, key: bytes) -> None:

@@ -144,6 +144,37 @@ def on_alert(callback) -> None:
     obs.engine.add_callback(callback)
 
 
+def training_report(gang: Optional[str] = None) -> Dict[str, Any]:
+    """Goodput ledgers of training gangs (train/_internal/ledger.py),
+    published by each fit()'s driver under the `train::<gang_id>` KV keys.
+
+    Per gang: wall_s, buckets (productive|init|compile|rendezvous_wait|
+    checkpoint|recover|idle — they partition wall time, coverage ~1.0),
+    goodput_frac, steps, failures, the current skew and the named straggler
+    ({rank, phase, skew_s}), and the last round's per-rank phase split.
+
+    Returns ``{"gangs": {gang_id: report}}`` (one entry when `gang` given;
+    empty when `enable_metrics` is off — nothing is published then)."""
+    import json
+
+    _auto_init()
+    ctx = global_worker.context
+    gangs: Dict[str, Any] = {}
+    if gang is not None:
+        keys = [b"train::" + gang.encode()]
+    else:
+        keys = ctx.kv("keys", b"train::") or []
+    for key in keys:
+        raw = ctx.kv("get", key)
+        if not raw:
+            continue
+        try:
+            gangs[key[len(b"train::"):].decode()] = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+    return {"gangs": gangs}
+
+
 # ---------------------------------------------------------------- tracing
 def _trace_inputs(trace_id: Optional[str] = None):
     """(spans, {task_id_hex: stages}) joined from the head's trace-span ring

@@ -1,0 +1,245 @@
+"""Training-gang observability tests (ISSUE 17): the per-step phase clock,
+straggler attribution, the goodput ledger, the recover bucket on gang
+restart, the collective/rendezvous telemetry seams, and knob-off parity.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig, session
+from ray_tpu.train import DataParallelTrainer
+
+
+@pytest.fixture
+def ray_8cpu(tmp_path):
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_8cpu_fast_straggler(tmp_path):
+    # Short sustain window so an ~1s test run crosses the event threshold.
+    ctx = ray_tpu.init(num_cpus=8, _system_config={
+        "train_straggler_skew_s": 0.05, "train_straggler_for_s": 0.2,
+    })
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_8cpu_nometrics(tmp_path):
+    ctx = ray_tpu.init(num_cpus=8, _system_config={"enable_metrics": False})
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_phase_telemetry_and_goodput_ledger(ray_8cpu, tmp_path):
+    """A plain gang's fit() yields a training_report: phase splits per rank,
+    buckets accounting >=95% of wall time, and a done status."""
+    from ray_tpu.util import state
+
+    def loop(config):
+        for i in range(4):
+            session.mark_phase("data_wait")
+            time.sleep(0.005)
+            session.mark_phase("step_exec")
+            time.sleep(0.01)
+            session.report({"step": i})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="phases", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+
+    gangs = state.training_report()["gangs"]
+    assert len(gangs) == 1
+    rep = next(iter(gangs.values()))
+    assert rep["status"] == "done"
+    assert rep["world_size"] == 2
+    assert rep["steps"] == 4
+    # Interval-chained accounting: buckets must cover the observed wall.
+    assert rep["coverage"] >= 0.95
+    assert abs(sum(rep["buckets"].values()) - rep["wall_s"]) <= (
+        0.05 * rep["wall_s"]
+    )
+    assert rep["buckets"]["productive"] > 0
+    assert rep["buckets"]["init"] > 0
+    # Both ranks reported phase splits, with the explicit marks present.
+    assert set(rep["per_rank"]) == {"0", "1"}
+    for r in rep["per_rank"].values():
+        assert r["phases"].get("step_exec", 0.0) > 0
+        assert r["phases"].get("data_wait", 0.0) > 0
+
+    # ?gang= filter returns just this gang; unknown gang is empty.
+    gang_id = rep["gang"]
+    assert set(state.training_report(gang_id)["gangs"]) == {gang_id}
+    assert state.training_report("no-such-gang")["gangs"] == {}
+
+
+def test_straggler_named_with_dominant_phase(ray_8cpu_fast_straggler, tmp_path):
+    """One rank of a 4-worker gang seeded slow (train.step delay failpoint,
+    armed programmatically so only that rank gets it) must be named as the
+    straggler with its dominant phase, and the skew must register."""
+    from ray_tpu.util import state
+
+    def loop(config):
+        from ray_tpu._private import failpoints
+
+        if session.get_world_rank() == 2:
+            failpoints.arm("train.step", "delay", 0.1, trigger="always")
+        for i in range(8):
+            session.mark_phase("step_exec")
+            session.report({"step": i})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=4),
+        run_config=RunConfig(name="straggle", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+
+    rep = next(iter(state.training_report()["gangs"].values()))
+    straggler = rep["straggler"]
+    assert straggler is not None
+    assert straggler["rank"] == 2
+    assert straggler["phase"] == "step_exec"
+    # Modal naming: the seeded rank was slowest in (almost) every round.
+    assert straggler["slow_rounds"] >= straggler["rounds"] - 1
+    # Active-time skew ~= the injected delay, well clear of bring-up noise.
+    assert rep["max_skew_s"] >= 0.05
+    # The sustained breach produced the cluster event naming rank + phase.
+    events = state.list_cluster_events(kind="train_straggler")
+    assert events, "no train_straggler event"
+    assert events[-1]["data"]["rank"] == 2
+    assert events[-1]["data"]["phase"] == "step_exec"
+
+
+def test_worker_crash_lands_in_recover_bucket(ray_8cpu, tmp_path):
+    """A worker dying mid-step (train.step crash failpoint) restarts the
+    gang: the detection+restart wall time must land in the ledger's recover
+    bucket and emit a train_gang_recover event, on the SAME gang report."""
+    from ray_tpu.util import state
+
+    marker = tmp_path / "crashed_once"
+
+    def loop(config):
+        from ray_tpu._private import failpoints
+
+        if session.get_world_rank() == 1 and not marker.exists():
+            marker.write_text("armed")
+            failpoints.arm("train.step", "crash", trigger="once")
+        for i in range(3):
+            session.report({"step": i})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="recover",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    ).fit()
+    assert result.error is None
+    assert marker.exists()
+
+    gangs = state.training_report()["gangs"]
+    assert len(gangs) == 1  # the restart reuses the fit's gang id + ledger
+    rep = next(iter(gangs.values()))
+    assert rep["status"] == "done"
+    assert rep["failures"] == 1
+    assert rep["buckets"]["recover"] > 0
+    assert rep["coverage"] >= 0.95
+
+    events = state.list_cluster_events(kind="train_gang_recover")
+    assert events, "no train_gang_recover event"
+    assert events[-1]["data"]["gang"] == rep["gang"]
+    assert events[-1]["data"]["recover_s"] > 0
+
+
+def test_collective_timed_records_failed_ops():
+    """_timed must record ops that raise (status="error") into the same
+    histogram and the per-process accumulator — a failed collective must
+    not vanish from the series its healthy peers feed."""
+    from ray_tpu._private.telemetry import collective_histogram
+    from ray_tpu.util.collective import collective
+
+    before = dict(collective._STATS)
+    with pytest.raises(RuntimeError, match="not initialized"):
+        collective.allreduce([1.0], group_name="obs-test-missing")
+    assert collective._STATS["ops"] == before["ops"] + 1
+    assert collective._STATS["errors"] == before["errors"] + 1
+    assert collective._STATS["time_s"] >= before["time_s"]
+
+    snap = collective_histogram()._snapshot()
+    err = [
+        (dict(k), v)
+        for k, v in snap["series"]
+        if dict(k).get("group") == "obs-test-missing"
+    ]
+    assert err, f"no error sample in {snap['series']}"
+    tags, data = err[0]
+    assert tags["status"] == "error"
+    assert tags["op"] == "allreduce"
+    assert tags["rank"] == "-"  # no group -> no rank
+    assert data["count"] == 1
+
+    # Arrival offsets piggyback on the coordinator reply into this seam.
+    off_before = collective._STATS["arrival_offset_s"]
+    collective._note_arrival_offset(0.25)
+    assert collective._STATS["arrival_offset_s"] == pytest.approx(
+        off_before + 0.25
+    )
+
+
+def test_rendezvous_wait_telemetry():
+    """rendezvous.note_wait feeds both the per-process accumulator (the
+    ledger's rendezvous_wait signal) and the wait histogram."""
+    from ray_tpu._private.telemetry import rendezvous_wait_histogram
+    from ray_tpu.util.collective import rendezvous
+
+    before_waits = rendezvous._WAIT_STATS["waits"]
+    before_s = rendezvous._WAIT_STATS["wait_s"]
+    hist_before = sum(
+        v["count"] for _, v in rendezvous_wait_histogram()._snapshot()["series"]
+    )
+    rendezvous.note_wait(0.02)
+    assert rendezvous._WAIT_STATS["waits"] == before_waits + 1
+    assert rendezvous._WAIT_STATS["wait_s"] == pytest.approx(before_s + 0.02)
+    hist_after = sum(
+        v["count"] for _, v in rendezvous_wait_histogram()._snapshot()["series"]
+    )
+    assert hist_after == hist_before + 1
+
+    # wait_for itself goes through note_wait (timeout path included).
+    with pytest.raises(TimeoutError):
+        rendezvous.wait_for(lambda *a: None, b"obs-test-key", timeout=0.05)
+    assert rendezvous._WAIT_STATS["waits"] == before_waits + 2
+    # The retry loop may stop a beat before the full deadline; the blocked
+    # time must still be the bulk of it.
+    assert rendezvous._WAIT_STATS["wait_s"] >= before_s + 0.02 + 0.03
+
+
+def test_metrics_off_disables_train_observability(ray_8cpu_nometrics, tmp_path):
+    """enable_metrics=False: no step clock, no ledger, no published report —
+    and training still works."""
+    from ray_tpu.util import state
+
+    def loop(config):
+        for i in range(3):
+            session.mark_phase("step_exec")  # must be a no-op, not an error
+            session.report({"step": i})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="dark", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert state.training_report()["gangs"] == {}

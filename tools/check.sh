@@ -52,6 +52,10 @@ echo "== trace smoke (one Serve request traced proxy->router->replica->task, lat
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/trace_smoke.py
 
 echo
+echo "== train smoke (4-worker gang, seeded straggler named + alert fire->resolve, goodput ledger) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/train_smoke.py
+
+echo
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -1,0 +1,134 @@
+"""Training-observability smoke for tools/check.sh: a 4-worker gang with one
+rank seeded slow (`train.step` delay failpoint armed programmatically on rank
+1) must produce per-step phase series MID-RUN, fire the `train_straggler`
+alert while the skew is sustained and RESOLVE it after the gang ends, and the
+goodput ledger must name rank 1 + its dominant phase with >=95% wall-time
+coverage. Fast (<~60s) and assertion-fatal — a broken step clock, skew fold,
+or ledger fails the pre-merge gate before tier-1 runs."""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SLOW_RANK = 1
+DELAY_S = 0.2
+STEPS = 40
+
+
+def train_fn(config):
+    from ray_tpu._private import failpoints
+    from ray_tpu.air import session
+
+    if session.get_world_rank() == SLOW_RANK:
+        # Programmatic, not env: the env schedule would reach every worker.
+        failpoints.arm("train.step", "delay", DELAY_S, trigger="always")
+    for step in range(STEPS):
+        session.mark_phase("step_exec")
+        time.sleep(0.005)
+        session.report({"step": step})
+
+
+def main() -> int:
+    import ray_tpu
+    from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+    from ray_tpu.air import ScalingConfig
+    from ray_tpu.util import state
+
+    ray_tpu.init(num_cpus=8, _system_config={
+        "train_straggler_skew_s": 0.05,
+        "obs_series_step_s": 0.25,
+        "alert_eval_interval_s": 0.25,
+    })
+    t_start = time.time()
+    try:
+        trainer = DataParallelTrainer(
+            train_fn, scaling_config=ScalingConfig(num_workers=4)
+        )
+        box = {}
+
+        def run():
+            box["result"] = trainer.fit()
+
+        fit = threading.Thread(target=run, daemon=True)
+        fit.start()
+
+        def alert_state():
+            for a in state.list_alerts():
+                if a["name"] == "train_straggler":
+                    return a["state"]
+            return None
+
+        # --- mid-run: phase series exist (dead-worker series are pruned at
+        # gang teardown, so this HAS to be observed while the gang is alive)
+        # and the straggler alert fires on the sustained skew.
+        fired = False
+        phase_points = 0
+        deadline = time.time() + 60
+        while time.time() < deadline and fit.is_alive():
+            if not phase_points:
+                res = state.query_series(
+                    "ray_tpu_train_step_seconds", since=t_start, step=0.5,
+                )
+                phase_points = sum(len(s["points"]) for s in res["series"])
+            if not fired and alert_state() == "firing":
+                fired = True
+            if fired and phase_points:
+                break
+            time.sleep(0.25)
+        assert phase_points > 0, "no ray_tpu_train_step_seconds points mid-run"
+        print(f"series: {phase_points} train-step phase point(s) mid-run OK")
+        assert fired, "train_straggler alert never fired during the run"
+        assert any(
+            e["data"].get("rule") == "train_straggler"
+            for e in state.list_cluster_events(kind="alert_firing")
+        )
+        kinds = {e["kind"] for e in state.list_cluster_events()}
+        assert "train_straggler" in kinds, kinds
+        print("alerts: train_straggler FIRING on seeded skew OK")
+
+        fit.join(timeout=120)
+        assert not fit.is_alive(), "fit() did not finish"
+        result = box.get("result")
+        assert result is not None and result.error is None, result
+
+        # --- gang ended: the executor parks the skew gauge at 0, the stale
+        # window ages out, and the alert resolves.
+        deadline = time.time() + 45
+        while time.time() < deadline and alert_state() != "ok":
+            time.sleep(0.5)
+        assert alert_state() == "ok", "train_straggler alert never resolved"
+        print("alerts: train_straggler RESOLVED after the gang ended OK")
+
+        # --- goodput ledger: names the seeded rank + dominant phase, and the
+        # buckets account (>=95% of) the gang's wall time.
+        gangs = state.training_report()["gangs"]
+        assert gangs, "training_report has no gangs"
+        rep = next(iter(gangs.values()))
+        assert rep["status"] == "done", rep["status"]
+        straggler = rep["straggler"]
+        assert straggler and straggler["rank"] == SLOW_RANK, straggler
+        assert straggler.get("phase"), straggler
+        assert rep["coverage"] >= 0.95, rep["coverage"]
+        assert rep["steps"] >= STEPS - 1, rep["steps"]
+        assert rep["buckets"]["productive"] > 0, rep["buckets"]
+        shares = ", ".join(
+            f"{b}={v / rep['wall_s'] * 100:.0f}%"
+            for b, v in rep["buckets"].items() if v > 0
+        )
+        print(
+            f"ledger: straggler rank {straggler['rank']} "
+            f"({straggler['phase']}, slow in {straggler['slow_rounds']}/"
+            f"{straggler['rounds']} rounds), coverage "
+            f"{rep['coverage'] * 100:.1f}%, {shares} OK"
+        )
+    finally:
+        ray_tpu.shutdown()
+    print("TRAIN_SMOKE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
