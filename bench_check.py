@@ -41,6 +41,11 @@ REQUIRED_METRICS = (
     # the recorded value documents the real number).
     "task_throughput_obs_ratio",
     "task_throughput_invariants_ratio",
+    # Lifecycle-machine monitor isolated from the rest of the invariants
+    # bundle (lifecycle.ENABLED forced in-process, env flag off): off-mode
+    # step() is one branch, so the off/on ratio must stay near 1.0 and the
+    # probe can't silently vanish (ISSUE 18 acceptance).
+    "task_throughput_lifecycle_monitor_ratio",
     # Idle-profiler vs profiler-disabled throughput: the introspection layer
     # must stay free when no profile session is running.
     "task_throughput_profiler_ratio",

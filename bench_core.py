@@ -657,6 +657,60 @@ def main():
         }
     )
 
+    # ------------------------------------------------ lifecycle monitor cost
+    # The lifecycle-machine monitor (lifecycle.step at every annotated state
+    # write in the scheduler/transfer/serve control planes) normally arms
+    # with DEBUG_INVARIANTS, so the invariants ratio above prices it only as
+    # part of the whole guard bundle. This probe isolates it: env flag off
+    # everywhere, lifecycle.ENABLED forced in the driver process before
+    # init() — the scheduler runs in-process, so its step() sites see the
+    # toggle while every other guard stays off. Off-mode step() is a single
+    # if + return (the hot-path contract); the ratio off/on documents the
+    # armed spec-dict lookups and is REQUIRED in bench_check.
+    _lc_probe = (
+        "import time\n"
+        "from ray_tpu._private import lifecycle\n"
+        "lifecycle.ENABLED = bool(int('%s'))\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=4)\n"
+        "@ray_tpu.remote\n"
+        "def _nop():\n"
+        "    return None\n"
+        "ray_tpu.get([_nop.remote() for _ in range(200)])\n"
+        "t0 = time.perf_counter()\n"
+        "ray_tpu.get([_nop.remote() for _ in range(2000)])\n"
+        "print('OPS', 2000 / (time.perf_counter() - t0))\n"
+        "ray_tpu.shutdown()\n"
+    )
+
+    def lifecycle_throughput(flag: str) -> float:
+        env = dict(os.environ, RAY_TPU_DEBUG_INVARIANTS="0")
+        proc = subprocess.run(
+            [sys.executable, "-c", _lc_probe % flag], env=env,
+            capture_output=True, text=True, timeout=600,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("OPS "):
+                return float(line.split()[1])
+        raise RuntimeError(
+            f"lifecycle probe (flag={flag}) produced no OPS line:\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+
+    lc_off = lc_on = 0.0
+    for _ in range(2):
+        lc_off = max(lc_off, lifecycle_throughput("0"))
+        lc_on = max(lc_on, lifecycle_throughput("1"))
+    results.append(
+        {
+            "metric": "task_throughput_lifecycle_monitor_ratio",
+            "value": round(lc_off / lc_on, 3),
+            "unit": "ratio",
+            "monitor_off_ops_s": round(lc_off, 1),
+            "monitor_on_ops_s": round(lc_on, 1),
+        }
+    )
+
     # ---------------------------------------------------- failpoint hook cost
     # Hooks are compiled in permanently (batching sends, reader loops, exec
     # stages, scheduler drains, segment reads); when nothing is armed each

@@ -60,7 +60,7 @@ import threading
 import time as _time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import failpoints, serialization, session_monitor
+from ray_tpu._private import failpoints, lifecycle, serialization, session_monitor
 from ray_tpu._private.concurrency import any_thread, lock_guarded
 
 
@@ -616,7 +616,7 @@ class PullManager:
             if self._inflight < self.max_inflight:
                 self._inflight += 1
                 _STATS["inflight"] += 1
-                req.state = "inflight"
+                req.state = lifecycle.step("transfer", req.state, "inflight")
                 return req, True
             heapq.heappush(self._heap, (priority, req.seq, key))
             _STATS["queue_depth"] += 1
@@ -739,7 +739,7 @@ class PullManager:
         request-table removal, waiter wakeup."""
         was_inflight = req.state == "inflight"
         was_queued = req.state == "queued"
-        req.state = state
+        req.state = lifecycle.step("transfer", req.state, state)
         req.error = err
         self._reqs.pop(req.key, None)
         if req.conn is not None and req.req_id is not None:
@@ -842,7 +842,7 @@ class PullManager:
                                 break
                         if req is None:
                             break
-                        req.state = "inflight"
+                        req.state = lifecycle.step("transfer", req.state, "inflight")
                         self._inflight += 1
                         _STATS["inflight"] += 1
                         _STATS["queue_depth"] -= 1

@@ -33,7 +33,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private import failpoints, serialization, session_monitor
+from ray_tpu._private import failpoints, lifecycle, serialization, session_monitor
 from ray_tpu._private.batching import approx_msg_nbytes as _approx_msg_nbytes
 from ray_tpu._private.concurrency import any_thread, loop_thread_only
 from ray_tpu._private.config import Config
@@ -596,7 +596,14 @@ class Scheduler:
         tcp_port: int = 0,
         advertise_host: str = "127.0.0.1",
         bind_host: Optional[str] = None,
+        virtual: bool = False,
     ):
+        # virtual=True builds the full in-memory control plane but binds NO
+        # external resources (no unix/TCP listeners, no data-plane push
+        # server) and is never start()ed: rt-state's interleaving explorer
+        # (devtools/verify/explore.py) drives the real handlers
+        # single-threaded against fake connections instead.
+        self.virtual = virtual
         self.gcs = gcs
         self.config = config
         self.session_dir = session_dir
@@ -785,6 +792,13 @@ class Scheduler:
         env_key = os.environ.get("RAY_TPU_AUTHKEY_HEX")
         self._authkey = bytes.fromhex(env_key) if env_key else os.urandom(16)
         self._sock_path = os.path.join(session_dir, "worker.sock")
+        if virtual:
+            self._listener = None
+            self._tcp_listener = None
+            self.tcp_address = (advertise_host, 0)
+            self._transfer = None
+            self._data_address = None
+            return
         from multiprocessing.connection import Listener
 
         # backlog: multiprocessing's default is 1 — a gang of concurrently
@@ -1466,7 +1480,7 @@ class Scheduler:
             node = self.nodes.get(daemon.node_id)
             if node is not None:
                 node.last_heartbeat = time.time()
-                node.health = "ALIVE"
+                node.health = lifecycle.step("node_health", node.health, "ALIVE")
             return
         if kind == "worker_exit" or kind == "spawn_failed":
             wh = self._workers_by_id.get(msg[1])
@@ -1604,7 +1618,8 @@ class Scheduler:
             for b in pg.bundles:
                 if b.node == node_id:
                     b.node = None
-                    pg.state = "RESCHEDULING"
+                    pg.state = lifecycle.step("placement_group", pg.state,
+                                              "RESCHEDULING")
                     if pg not in self.pending_pgs:
                         self.pending_pgs.append(pg)
         return True
@@ -1815,7 +1830,7 @@ class Scheduler:
         self._release_task_resources(rec)
         if rec.retries_left > 0:
             rec.retries_left -= 1
-            rec.state = "PENDING"
+            rec.state = lifecycle.step("task", rec.state, "PENDING")
             rec.worker = None
             self._record_event(rec.spec, "RETRY")
             self.telemetry.retried += 1
@@ -1986,7 +2001,7 @@ class Scheduler:
         # exactly that window.
         self._remove_from_lease_index(wh)
         wh.lease_key = None
-        wh.state = "dying"
+        wh.state = lifecycle.step("worker", wh.state, "dying")
         node = self.nodes.get(wh.node_id)
         if node is not None and wh.worker_id in node.idle:
             node.idle.remove(wh.worker_id)
@@ -2026,7 +2041,7 @@ class Scheduler:
                 continue
             stale = now - node.last_heartbeat
             if stale > grace:
-                node.health = "DEAD"
+                node.health = lifecycle.step("node_health", node.health, "DEAD")
                 tel.hb_dead_daemon += 1
                 # Postmortem entry: the node is about to vanish from the
                 # table, but the flight recorder captured at SUSPECT time
@@ -2073,7 +2088,7 @@ class Scheduler:
                 )
                 self._on_daemon_death(node.daemon)
             elif stale > suspect_after and node.health == "ALIVE":
-                node.health = "SUSPECT"
+                node.health = lifecycle.step("node_health", node.health, "SUSPECT")
                 tel.hb_suspect_daemon += 1
                 self._emit_event(
                     "node_suspect",
@@ -2094,7 +2109,7 @@ class Scheduler:
             if wh.conn is None:
                 continue  # still connecting: spawn latency is not a hang
             if now - wh.last_heartbeat > suspect_after and wh.health == "ALIVE":
-                wh.health = "SUSPECT"
+                wh.health = lifecycle.step("worker_health", wh.health, "SUSPECT")
                 tel.hb_suspect_worker += 1
                 self._emit_event(
                     "worker_suspect",
@@ -2130,17 +2145,17 @@ class Scheduler:
             return
         if ar.num_restarts < ar.max_restarts:
             ar.num_restarts += 1
-            ar.state = "RESTARTING"
+            ar.state = lifecycle.step("actor", ar.state, "RESTARTING")
             if info:
-                info.state = "RESTARTING"
+                info.state = lifecycle.step("actor", info.state, "RESTARTING")
                 info.num_restarts = ar.num_restarts
             self._release_actor_resources(ar)
             self._try_start_actor(ar)
         else:
-            ar.state = "DEAD"
+            ar.state = lifecycle.step("actor", ar.state, "DEAD")
             ar.death_cause = "worker crashed"
             if info:
-                info.state = "DEAD"
+                info.state = lifecycle.step("actor", info.state, "DEAD")
                 info.death_cause = ar.death_cause
             self._release_actor_resources(ar)
             self._release_actor_creation_pins(ar)
@@ -2176,7 +2191,7 @@ class Scheduler:
             return
         if kind == "heartbeat":
             wh.last_heartbeat = time.time()
-            wh.health = "ALIVE"
+            wh.health = lifecycle.step("worker_health", wh.health, "ALIVE")
             return
         if kind == "done":
             # Lease-pipelined workers coalesce dones into "batch" frames
@@ -2465,7 +2480,8 @@ class Scheduler:
             return
         if stages:
             rec.stage_ts.update(stages)
-        rec.state = "FINISHED" if ok else "FAILED"
+        rec.state = lifecycle.step("task", rec.state,
+                                   "FINISHED" if ok else "FAILED")
         tel = self.telemetry
         if ok:
             tel.finished += 1
@@ -2515,14 +2531,14 @@ class Scheduler:
                 wh.current_task = successor.spec.task_id
                 if wh.state == "blocked":
                     # The blocked head finished; the successor runs unblocked.
-                    wh.state = "busy"
+                    wh.state = lifecycle.step("worker", wh.state, "busy")
             else:
                 self._release_task_resources(rec)
                 if wh.actor_id is None and wh.state != "dying":
                     # Never re-idle a worker the OOM killer already
                     # terminated — a late-buffered done must not put the
                     # corpse back into dispatch rotation.
-                    wh.state = "idle"
+                    wh.state = lifecycle.step("worker", wh.state, "idle")
                     wh.current_task = None
                     self._drop_lease(wh)
                     node = self.nodes.get(wh.node_id)
@@ -2543,9 +2559,9 @@ class Scheduler:
                 self._on_worker_death(wh)
             return
         if ok:
-            ar.state = "ALIVE"
+            ar.state = lifecycle.step("actor", ar.state, "ALIVE")
             if info:
-                info.state = "ALIVE"
+                info.state = lifecycle.step("actor", info.state, "ALIVE")
                 info.node_id = ar.node
             for req in ar.backlog:
                 self._dispatch_actor_call(ar, req)
@@ -2553,10 +2569,10 @@ class Scheduler:
         else:
             # Creation raised: actor is dead; error already sealed into the
             # creation "ready" object so waiters see the root cause.
-            ar.state = "DEAD"
+            ar.state = lifecycle.step("actor", ar.state, "DEAD")
             ar.death_cause = "creation task failed"
             if info:
-                info.state = "DEAD"
+                info.state = lifecycle.step("actor", info.state, "DEAD")
                 info.death_cause = ar.death_cause
             from ray_tpu.exceptions import RayActorError
 
@@ -3123,7 +3139,7 @@ class Scheduler:
         else:
             for oid in rec.return_ids:
                 self._seal_object(err_meta(oid))
-        rec.state = "FAILED"
+        rec.state = lifecycle.step("task", rec.state, "FAILED")
         self.telemetry.failed += 1
         self._release_task_pins(rec)
         self._record_event(rec.spec, "FAILED", rec=rec)
@@ -3332,7 +3348,7 @@ class Scheduler:
             if rec.state == "PENDING":
                 self.pending.remove(rec)
                 self._store_error_results(rec, err)
-                rec.state = "CANCELLED"
+                rec.state = lifecycle.step("task", rec.state, "CANCELLED")
                 continue
             node = self.nodes.get(rec.node)
             wh = node.workers.get(rec.worker) if node else None
@@ -3344,7 +3360,7 @@ class Scheduler:
                 wh.inflight_tasks.remove(rec.spec.task_id)
                 self._send_to(wh, ("cancel_queued", rec.spec.task_id.binary()))
                 self._store_error_results(rec, err)
-                rec.state = "CANCELLED"
+                rec.state = lifecycle.step("task", rec.state, "CANCELLED")
 
     def _kill_actors_owned_by(self, holder: str) -> None:
         """An owner (driver/worker) died: its owned actors die with it;
@@ -3383,11 +3399,11 @@ class Scheduler:
         was_pending = ar.state in ("PENDING", "RESTARTING")
         if no_restart:
             ar.max_restarts = ar.num_restarts  # no more restarts
-            ar.state = "DEAD"
+            ar.state = lifecycle.step("actor", ar.state, "DEAD")
             ar.death_cause = "ray_tpu.kill"
             info = self.gcs.actors.get(actor_id)
             if info:
-                info.state = "DEAD"
+                info.state = lifecycle.step("actor", info.state, "DEAD")
                 info.death_cause = "ray_tpu.kill"
             self._release_actor_creation_pins(ar)
         if was_pending and no_restart:
@@ -3395,7 +3411,7 @@ class Scheduler:
             # or _on_actor_created would resurrect a killed actor.
             crec = self.tasks.get(ar.creation_req.spec.task_id)
             if crec is not None and crec.state == "PENDING":
-                crec.state = "CANCELLED"
+                crec.state = lifecycle.step("task", crec.state, "CANCELLED")
             err = RayActorError("Actor was killed before creation completed.")
             for req in ar.backlog:
                 rec = self.tasks.get(req.spec.task_id)
@@ -3467,7 +3483,7 @@ class Scheduler:
                 if node is not None:
                     # Return only what the bundle still holds unused.
                     _release(node.available, b.available)
-        pg.state = "REMOVED"
+        pg.state = lifecycle.step("placement_group", pg.state, "REMOVED")
         return True
 
     def _cmd_cancel(self, payload):
@@ -3480,7 +3496,7 @@ class Scheduler:
         if rec.state == "PENDING":
             self.pending.remove(rec)
             self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
-            rec.state = "CANCELLED"
+            rec.state = lifecycle.step("task", rec.state, "CANCELLED")
             return True
         if rec.state == "RUNNING" and rec.spec.actor_id is None:
             # Pipelined-but-not-started (queued behind a leased worker's
@@ -3496,7 +3512,7 @@ class Scheduler:
                 wh.inflight_tasks.remove(task_id)
                 self._send_to(wh, ("cancel_queued", task_id.binary()))
                 self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
-                rec.state = "CANCELLED"
+                rec.state = lifecycle.step("task", rec.state, "CANCELLED")
                 return True
         if rec.state == "RUNNING" and force and rec.spec.actor_id is None:
             node = self.nodes.get(rec.node)
@@ -3509,7 +3525,7 @@ class Scheduler:
                     pass
                 self._release_task_resources(rec)
                 self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
-                rec.state = "CANCELLED"
+                rec.state = lifecycle.step("task", rec.state, "CANCELLED")
                 # Death handler will see FAILED results already sealed.
                 self.tasks.pop(task_id, None)
                 self._on_worker_death(wh)
@@ -4568,18 +4584,18 @@ class Scheduler:
                     self._send_to(wh, ("cancel_queued", tid.binary()))
                     qrec = self.tasks.get(tid)
                     if qrec is not None and qrec.state == "RUNNING":
-                        qrec.state = "PENDING"
+                        qrec.state = lifecycle.step("task", qrec.state, "PENDING")
                         qrec.worker = None
                         qrec.node = None
                         qrec.acquired = {}
                         self.pending.push(qrec)
         if wh.state == "busy":
-            wh.state = "blocked"
+            wh.state = lifecycle.step("worker", wh.state, "blocked")
             wh.blocked_kind = kind
 
     def _unmark_blocked(self, wh: WorkerHandle):
         if wh.state == "blocked":
-            wh.state = "busy"
+            wh.state = lifecycle.step("worker", wh.state, "busy")
 
     # ------------------------------------------------------------------ async get/wait
     def _async_get_metas(self, ids: List[bytes], fut: concurrent.futures.Future):
@@ -4741,7 +4757,7 @@ class Scheduler:
             return
         rec = self.tasks.get(req.spec.task_id)
         if rec is not None:
-            rec.state = "RUNNING"
+            rec.state = lifecycle.step("task", rec.state, "RUNNING")
             rec.worker = wh.worker_id
             rec.node = wh.node_id
             self._note_dispatch(rec, time.time())
@@ -4787,7 +4803,7 @@ class Scheduler:
             if err_meta is not None and rec is not None:
                 for oid in rec.return_ids:
                     self._seal_object(self._alias_error_meta(oid, err_meta))
-                rec.state = "FAILED"
+                rec.state = lifecycle.step("task", rec.state, "FAILED")
                 self._release_task_pins(rec)
                 return
             req.arg_metas = arg_metas
@@ -4814,7 +4830,7 @@ class Scheduler:
         for pg in list(self.pending_pgs):
             if self._try_reserve_pg(pg):
                 self.pending_pgs.remove(pg)
-                pg.state = "CREATED"
+                pg.state = lifecycle.step("placement_group", pg.state, "CREATED")
                 for fut in pg.ready_futures:
                     if not fut.done():
                         fut.set_result(True)
@@ -5112,7 +5128,7 @@ class Scheduler:
             else:
                 for oid in rec.return_ids:
                     self._seal_object(self._alias_error_meta(oid, err))
-            rec.state = "FAILED"
+            rec.state = lifecycle.step("task", rec.state, "FAILED")
             self._release_task_pins(rec)
             if rec.spec.returns_mode is not None:
                 self._finalize_stream(rec)
@@ -5194,12 +5210,12 @@ class Scheduler:
         else:
             _acquire(node.available, rec.spec.resources)
         rec.acquired = dict(rec.spec.resources)
-        rec.state = "RUNNING"
+        rec.state = lifecycle.step("task", rec.state, "RUNNING")
         rec.running_since = time.time()
         rec.worker = wh.worker_id
         rec.node = node.node_id
         node.last_active = time.time()
-        wh.state = "busy"
+        wh.state = lifecycle.step("worker", wh.state, "busy")
         wh.current_task = rec.spec.task_id
         wh.lease_key = _PendingQueue.key_of(rec)
         wh.inflight_tasks = [rec.spec.task_id]
@@ -5284,7 +5300,7 @@ class Scheduler:
             # transfers on its completion (_on_task_done).
             rec.acquired = {}
             rec.acquired_pg = None
-            rec.state = "RUNNING"
+            rec.state = lifecycle.step("task", rec.state, "RUNNING")
             rec.running_since = time.time()
             rec.worker = wh.worker_id
             rec.node = wh.node_id
@@ -5327,7 +5343,7 @@ class Scheduler:
         )
         ar.worker = wh.worker_id
         ar.node = node.node_id
-        rec.state = "RUNNING"
+        rec.state = lifecycle.step("task", rec.state, "RUNNING")
         rec.worker = wh.worker_id
         rec.node = node.node_id
         ar.inflight[rec.spec.task_id] = None

@@ -36,6 +36,8 @@ from __future__ import annotations
 import json
 import threading
 import time
+
+from ray_tpu._private import lifecycle
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -643,14 +645,14 @@ class AlertEngine:
             if breach:
                 if rule.pending_since is None:
                     rule.pending_since = now
-                    rule.state = "pending"
+                    rule.state = lifecycle.step("alert", rule.state, "pending")
                 if now - rule.pending_since >= rule.for_s:
-                    rule.state = "firing"
+                    rule.state = lifecycle.step("alert", rule.state, "firing")
                     rule.fired_at = now
                     rule.clear_since = None
                     self._transition(rule, "firing", value)
             else:
-                rule.state = "ok"
+                rule.state = lifecycle.step("alert", rule.state, "ok")
                 rule.pending_since = None
         else:  # firing
             if breach:
@@ -659,7 +661,7 @@ class AlertEngine:
                 if rule.clear_since is None:
                     rule.clear_since = now
                 if now - rule.clear_since >= rule.for_s:
-                    rule.state = "ok"
+                    rule.state = lifecycle.step("alert", rule.state, "ok")
                     rule.pending_since = None
                     rule.clear_since = None
                     self._transition(rule, "resolved", value)

@@ -1,9 +1,10 @@
-"""rt-lint CLI: run the seven invariant passes over the ray_tpu tree.
+"""rt-lint CLI: run the eight invariant passes over the ray_tpu tree.
 
 Usage::
 
     python -m ray_tpu.devtools.lint [package_dir] [--allowlist FILE]
-        [--passes protocol,blocking,affinity,config,metrics,failpoints,ownership] [-q]
+        [--passes protocol,blocking,affinity,config,metrics,failpoints,ownership,lifecycle]
+        [-q] [--json]
 
 Exit status: 0 = clean (after allowlist), 1 = violations / allowlist format
 errors / unused allowlist entries. Designed for CI (tools/check.sh) and for
@@ -26,12 +27,10 @@ import sys
 from typing import Callable, Dict, List
 
 from ray_tpu.devtools import (
-    pass_affinity, pass_blocking, pass_config, pass_failpoints, pass_metrics,
-    pass_ownership, pass_protocol,
+    pass_affinity, pass_blocking, pass_config, pass_failpoints,
+    pass_lifecycle, pass_metrics, pass_ownership, pass_protocol, report,
 )
-from ray_tpu.devtools.astutil import (
-    Package, Violation, apply_allowlist, load_allowlist, load_package,
-)
+from ray_tpu.devtools.astutil import Package, Violation, load_package
 
 PASSES: Dict[str, Callable[[Package], List[Violation]]] = {
     "protocol": pass_protocol.run,
@@ -41,6 +40,7 @@ PASSES: Dict[str, Callable[[Package], List[Violation]]] = {
     "metrics": pass_metrics.run,
     "failpoints": pass_failpoints.run,
     "ownership": pass_ownership.run,
+    "lifecycle": pass_lifecycle.run,
 }
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -67,14 +67,7 @@ def run_all(package_dir: str, passes=None, doc_path: str = None,
             violations.extend(fn(pkg))
     errors: List[str] = []
     if allowlist_path:
-        entries, fmt_errors = load_allowlist(allowlist_path)
-        errors.extend(fmt_errors)
-        violations, unused = apply_allowlist(violations, entries)
-        for e in unused:
-            errors.append(
-                f"{allowlist_path}:{e.line_no}: allowlist entry no longer "
-                f"matches any violation (stale — delete it): {e.key}"
-            )
+        violations, errors = report.apply_allowlist_file(violations, allowlist_path)
     violations.sort(key=lambda v: (v.pass_id, v.path, v.line))
     return violations, errors
 
@@ -93,6 +86,9 @@ def main(argv=None) -> int:
                         help="comma-separated subset of: " + ",".join(PASSES))
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="print only the summary line")
+    parser.add_argument("--json", action="store_true", dest="json_out",
+                        help="emit machine-readable findings (per-pass "
+                             "counts + violations + exit code) on stdout")
     ns = parser.parse_args(argv)
 
     package_dir = ns.package or os.path.dirname(_HERE)
@@ -105,21 +101,8 @@ def main(argv=None) -> int:
 
     violations, errors = run_all(package_dir, passes=passes,
                                  allowlist_path=ns.allowlist)
-    if not ns.quiet:
-        for v in violations:
-            print(v.render())
-        for e in errors:
-            print(f"ALLOWLIST ERROR: {e}")
-    n = len(violations)
-    by_pass: Dict[str, int] = {}
-    for v in violations:
-        by_pass[v.pass_id] = by_pass.get(v.pass_id, 0) + 1
-    detail = ", ".join(f"{k}={c}" for k, c in sorted(by_pass.items()))
-    status = "FAILED" if (violations or errors) else "OK"
-    print(f"rt-lint {status}: {n} violation(s)"
-          + (f" ({detail})" if detail else "")
-          + (f", {len(errors)} allowlist error(s)" if errors else ""))
-    return 1 if (violations or errors) else 0
+    return report.emit("rt-lint", violations, errors, quiet=ns.quiet,
+                       json_out=ns.json_out)
 
 
 if __name__ == "__main__":

@@ -71,9 +71,8 @@ def run_all(package_dir: str, passes: Optional[List[str]] = None,
     """Run the static verify passes; returns (violations, errors) with the
     allowlist applied — the same contract as lint.run_all, over the same
     shared parsed-AST cache."""
-    from ray_tpu.devtools.astutil import (
-        apply_allowlist, load_allowlist, load_package,
-    )
+    from ray_tpu.devtools import report
+    from ray_tpu.devtools.astutil import load_package
     from ray_tpu.devtools.verify import (
         pass_lockorder, pass_native, pass_session, stale,
     )
@@ -96,14 +95,7 @@ def run_all(package_dir: str, passes: Optional[List[str]] = None,
         violations.extend(table[name](pkg))
     errors: List[str] = []
     if allowlist_path:
-        entries, fmt_errors = load_allowlist(allowlist_path)
-        errors.extend(fmt_errors)
-        violations, unused = apply_allowlist(violations, entries)
-        for e in unused:
-            errors.append(
-                f"{allowlist_path}:{e.line_no}: allowlist entry no longer "
-                f"matches any violation (stale — delete it): {e.key}"
-            )
+        violations, errors = report.apply_allowlist_file(violations, allowlist_path)
     violations.sort(key=lambda v: (v.pass_id, v.path, v.line))
     return violations, errors
 

@@ -4,15 +4,19 @@ Usage::
 
     python -m ray_tpu.devtools.verify [package_dir]
         [--passes session,lockorder,native,stale] [--allowlist FILE] [-q]
-        [--fuzz N] [--fuzz-seed S] [--corpus DIR]
+        [--json] [--fuzz N] [--fuzz-seed S] [--corpus DIR]
+        [--explore SCENARIOS] [--explore-budget N] [--explore-seed S]
 
 Default: the four static passes over the shipped package (allowlisted).
 ``--fuzz N`` additionally runs N structure-aware mutation cases per codec
 against both wire decoders (corpus replay first; crashers persisted under
-<corpus>/crashers/ and named in the failure).
+<corpus>/crashers/ and named in the failure). ``--explore`` additionally
+runs rt-state's interleaving exploration over the named scenarios (or
+``all``): real scheduler handlers, virtual transport, systematic delivery /
+crash orderings — corpus replay first, then bounded DFS.
 
-Exit status: 0 clean, 1 violations / allowlist errors / fuzz failure,
-2 usage error.
+Exit status: 0 clean, 1 violations / allowlist errors / fuzz failure /
+exploration failure, 2 usage error.
 """
 
 from __future__ import annotations
@@ -20,8 +24,8 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from typing import Dict
 
+from ray_tpu.devtools import report
 from ray_tpu.devtools.verify import DEFAULT_ALLOWLIST, PASS_NAMES, run_all
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -43,6 +47,14 @@ def main(argv=None) -> int:
     parser.add_argument("--corpus", default=None,
                         help="fuzz corpus dir (default tools/fuzz_corpus)")
     parser.add_argument("-q", "--quiet", action="store_true")
+    parser.add_argument("--json", action="store_true", dest="json_out",
+                        help="emit machine-readable findings on stdout")
+    parser.add_argument("--explore", default=None, metavar="SCENARIOS",
+                        help="run interleaving exploration over a "
+                             "comma-separated scenario list (or 'all')")
+    parser.add_argument("--explore-budget", type=int, default=400,
+                        help="max schedules explored per scenario")
+    parser.add_argument("--explore-seed", type=int, default=20260807)
     ns = parser.parse_args(argv)
 
     package_dir = ns.package or os.path.dirname(os.path.dirname(_HERE))
@@ -64,20 +76,8 @@ def main(argv=None) -> int:
     else:
         violations, errors = run_all(package_dir, passes=passes,
                                      allowlist_path=ns.allowlist)
-    if not ns.quiet:
-        for v in violations:
-            print(v.render())
-        for e in errors:
-            print(f"ALLOWLIST ERROR: {e}")
-    by_pass: Dict[str, int] = {}
-    for v in violations:
-        by_pass[v.pass_id] = by_pass.get(v.pass_id, 0) + 1
-    detail = ", ".join(f"{k}={c}" for k, c in sorted(by_pass.items()))
-    status = "FAILED" if (violations or errors) else "OK"
-    print(f"rt-verify {status}: {len(violations)} violation(s)"
-          + (f" ({detail})" if detail else "")
-          + (f", {len(errors)} allowlist error(s)" if errors else ""))
-    rc = 1 if (violations or errors) else 0
+    rc = report.emit("rt-verify", violations, errors, quiet=ns.quiet,
+                     json_out=ns.json_out)
 
     if ns.fuzz > 0:
         from ray_tpu.devtools.verify import fuzz_wire
@@ -90,6 +90,22 @@ def main(argv=None) -> int:
             )
         except fuzz_wire.FuzzFailure as e:
             print(f"rt-verify FUZZ FAILED: {e}")
+            return 1
+
+    if ns.explore is not None:
+        from ray_tpu.devtools.verify import explore
+
+        names = (list(explore.SCENARIOS) if ns.explore == "all"
+                 else ns.explore.split(","))
+        unknown = [s for s in names if s not in explore.SCENARIOS]
+        if unknown:
+            print(f"rt-verify: unknown scenario(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        ok = explore.run_sweep(names, budget=ns.explore_budget,
+                               seed=ns.explore_seed, quiet=ns.quiet)
+        if not ok:
+            print("rt-verify EXPLORE FAILED")
             return 1
     return rc
 

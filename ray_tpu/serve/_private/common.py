@@ -95,6 +95,9 @@ class ReplicaInfo:
     # Copied from the deployment so the ROUTER can cap per-replica load
     # decisions (affinity escape) without a controller round trip.
     max_concurrent_queries: int = 1
+    # Controller-driven lifecycle (lifecycle.LIFECYCLE_SPEC "serve_replica"):
+    # STARTING -> RUNNING -> DRAINING -> STOPPED.
+    state: str = "STARTING"
 
 
 @dataclass
@@ -106,3 +109,5 @@ class ProxyInfo:
     node_id: str
     port: Optional[int] = None
     actor_name: str = ""
+    # Controller-driven lifecycle (lifecycle.LIFECYCLE_SPEC "serve_proxy").
+    state: str = "STARTING"

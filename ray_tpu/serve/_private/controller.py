@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import lifecycle
 from ray_tpu._private.events import emit_event
 from ray_tpu.serve._private.common import (
     PROXY_NAME,
@@ -263,12 +264,14 @@ class ServeController:
                     max_delay_s=0.25,
                 ),
             )
-            replicas.append(
-                ReplicaInfo(
-                    rid, handle._actor_id, name,
-                    max_concurrent_queries=info.max_concurrent_queries,
-                )
+            rep = ReplicaInfo(
+                rid, handle._actor_id, name,
+                max_concurrent_queries=info.max_concurrent_queries,
             )
+            # The creation retry loop above succeeded: the actor exists and
+            # routers may target it as soon as the table bumps.
+            rep.state = lifecycle.step("serve_replica", rep.state, "RUNNING")
+            replicas.append(rep)
             self._bump(f"replicas::{name}")
         while len(replicas) > target:
             rep = replicas.pop()
@@ -289,6 +292,7 @@ class ServeController:
         from ray_tpu._private.config import get_config
 
         timeout_s = float(get_config().serve_drain_timeout_s)
+        rep.state = lifecycle.step("serve_replica", rep.state, "DRAINING")
 
         def drain():
             from ray_tpu._private.worker import global_worker
@@ -313,6 +317,7 @@ class ServeController:
         import ray_tpu
         from ray_tpu.actor import ActorHandle
 
+        rep.state = lifecycle.step("serve_replica", rep.state, "STOPPED")
         try:
             ray_tpu.kill(ActorHandle(rep.actor_id, "ServeReplica"))
         except Exception:
@@ -375,7 +380,9 @@ class ServeController:
         for nid in list(existing):
             if nid not in alive:
                 with self._lock:
-                    self._proxies.pop(nid, None)
+                    p = self._proxies.pop(nid, None)
+                if p is not None:
+                    p.state = lifecycle.step("serve_proxy", p.state, "STOPPED")
                 existing.pop(nid, None)
         for nid in sorted(alive):
             # Re-check the LIVE cordon set per node: a drain_proxy that
@@ -403,7 +410,10 @@ class ServeController:
                 except Exception:  # noqa: BLE001 — actor gone: respawn below
                     respawn = True
                     with self._lock:
-                        self._proxies.pop(nid, None)
+                        p = self._proxies.pop(nid, None)
+                    if p is not None:
+                        p.state = lifecycle.step("serve_proxy", p.state,
+                                                 "STOPPED")
             name = f"{PROXY_NAME}::{nid[:8]}"
             proxy_id = f"{name}@{nid[:8]}"
             try:
@@ -443,13 +453,17 @@ class ServeController:
                     cordon_hit = True
                 else:
                     cordon_hit = False
-                    self._proxies[nid] = ProxyInfo(
+                    p = ProxyInfo(
                         proxy_id=proxy_id,
                         actor_id=handle._actor_id,
                         node_id=nid,
                         port=bound,
                         actor_name=name,
                     )
+                    # Bound and probed above: it serves as soon as it is in
+                    # the fleet table.
+                    p.state = lifecycle.step("serve_proxy", p.state, "RUNNING")
+                    self._proxies[nid] = p
             if cordon_hit:
                 try:
                     ray_tpu.kill(ActorHandle(handle._actor_id, "HTTPProxy"))
@@ -490,21 +504,23 @@ class ServeController:
         if timeout_s is None:
             timeout_s = float(get_config().serve_drain_timeout_s)
         with self._lock:
-            info = self._proxies.pop(node_id, None)
-            if info is not None:
+            p = self._proxies.pop(node_id, None)
+            if p is not None:
                 # Cordon BEFORE the (slow) drain: the reconcile tick must
                 # not re-adopt the still-alive draining actor and push it
                 # back to clients mid-drain.
                 self._proxy_cordoned.add(node_id)
-        if info is None:
+        if p is None:
             return {"ok": False, "inflight": -1, "error": "no proxy on node"}
+        p.state = lifecycle.step("serve_proxy", p.state, "DRAINING")
         result = global_worker.context.serve_drain_actor(
-            info.actor_id.binary(), float(timeout_s)
+            p.actor_id.binary(), float(timeout_s)
         )
         try:
-            ray_tpu.kill(ActorHandle(info.actor_id, "HTTPProxy"))
+            ray_tpu.kill(ActorHandle(p.actor_id, "HTTPProxy"))
         except Exception:
             pass
+        p.state = lifecycle.step("serve_proxy", p.state, "STOPPED")
         emit_event(
             "serve_proxy_drain",
             f"proxy on node {node_id[:8]} drained and removed "
@@ -621,6 +637,9 @@ class ServeController:
         with self._lock:
             replicas = self._replicas.get(name, [])
             before = len(replicas)
+            for r in replicas:
+                if r.replica_id == replica_id:
+                    r.state = lifecycle.step("serve_replica", r.state, "STOPPED")
             replicas[:] = [r for r in replicas if r.replica_id != replica_id]
             if len(replicas) < before:
                 self._bump(f"replicas::{name}")
@@ -751,6 +770,7 @@ class ServeController:
             self._stop.set()
             self._change.notify_all()  # release parked long-polls
         for p in proxies:
+            p.state = lifecycle.step("serve_proxy", p.state, "STOPPED")
             try:
                 ray_tpu.kill(ActorHandle(p.actor_id, "HTTPProxy"))
             except Exception:

@@ -1,0 +1,169 @@
+"""rt-state exploration side: the interleaving explorer
+(ray_tpu.devtools.verify.explore) must (a) leave every shipped scenario
+invariant-clean, (b) FIND planted control-plane bugs within the default
+budget — the explorer's own regression gate: a harness change that stops
+reaching the buggy interleavings fails here, not silently — and (c) be
+deterministic per seed so corpus schedules replay byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from ray_tpu.devtools.verify import explore
+
+
+# ---------------------------------------------------------- clean scenarios
+@pytest.mark.parametrize("name", sorted(explore.SCENARIOS))
+def test_scenario_quiesces_clean(name):
+    r = explore.explore(name, budget=explore.DEFAULT_BUDGET)
+    msg = "\n".join(
+        "%s: %s" % (sch, m) for sch, msgs in r.failures for m in msgs
+    )
+    assert not r.failures, f"{name} interleaving failures:\n{msg}"
+    assert not r.truncated, f"{name} did not fit the default budget"
+    assert r.complete, f"{name} reached no complete schedule"
+
+
+def test_exploration_actually_permutes():
+    r = explore.explore("submit_vs_worker_death")
+    # The crash point must move across the schedule: before any completion,
+    # between the pipelined dones, and after both.
+    positions = {sch.index("crash:w1") for sch in r.complete}
+    assert len(positions) >= 3
+    # Post-crash retries re-dispatch to a fresh worker.
+    assert any("deliver:w2:done:t1" in sch for sch in r.complete)
+
+
+# ------------------------------------------------------------- planted bugs
+class DoubleSealScheduler(explore.VirtualScheduler):
+    """Planted bug A: completions from a SUSPECT worker re-seal their first
+    result. Only reachable when the heartbeat verdict lands BEFORE a done."""
+
+    def _on_task_done(self, wh, task_id, ok, metas, stages=None):
+        super()._on_task_done(wh, task_id, ok, metas, stages)
+        if ok and metas and wh.health == "SUSPECT":
+            self._seal_object(metas[0])
+
+
+class LostTaskScheduler(explore.VirtualScheduler):
+    """Planted bug B: the death handler only fails the running head,
+    dropping the lease-pipelined tail. Only reachable when a second task
+    pipelined onto the worker before it crashed."""
+
+    def _on_worker_death(self, wh):
+        if len(wh.inflight_tasks) > 1:
+            wh.inflight_tasks[:] = wh.inflight_tasks[:1]
+        super()._on_worker_death(wh)
+
+
+def test_finds_planted_double_seal():
+    r = explore.explore("submit_vs_worker_death",
+                        sched_cls=DoubleSealScheduler)
+    assert r.failures and not r.truncated
+    assert any("double-seal" in m for _, msgs in r.failures for m in msgs)
+    # The bug needs verdict-before-done: every failing schedule shows it.
+    for sch, _ in r.failures:
+        assert sch.index("verdict:workers") < max(
+            i for i, k in enumerate(sch) if k.startswith("deliver:")
+        )
+
+
+def test_finds_planted_lost_task():
+    r = explore.explore("submit_vs_worker_death",
+                        sched_cls=LostTaskScheduler)
+    assert r.failures and not r.truncated
+    assert any("lost task" in m for _, msgs in r.failures for m in msgs)
+    # Reached only via crash while BOTH tasks were in flight on w1.
+    for sch, _ in r.failures:
+        assert "deliver:w1:done:t1" not in sch or (
+            sch.index("crash:w1") < sch.index("deliver:w1:done:t1")
+        )
+
+
+# ------------------------------------------------------------- determinism
+def test_seeded_replay_determinism():
+    a = explore.explore("submit_vs_worker_death", seed=123)
+    b = explore.explore("submit_vs_worker_death", seed=123)
+    assert a.complete == b.complete
+    assert a.failures == b.failures
+    assert a.schedules_run == b.schedules_run
+    # A different seed permutes visit order but the reduced schedule SET it
+    # covers must stay invariant-clean.
+    c = explore.explore("submit_vs_worker_death", seed=124)
+    assert not c.failures
+    assert {tuple(s) for s in c.complete} == {tuple(s) for s in a.complete}
+
+
+def test_replay_reproduces_schedules():
+    r = explore.explore("drain_vs_kill")
+    for sch in r.complete:
+        ok, msgs = explore.replay("drain_vs_kill", sch)
+        assert ok, msgs
+    bad = explore.explore("submit_vs_worker_death",
+                          sched_cls=LostTaskScheduler)
+    sch, _ = bad.failures[0]
+    ok, msgs = explore.replay("submit_vs_worker_death", sch,
+                              sched_cls=LostTaskScheduler)
+    assert not ok and any("lost task" in m for m in msgs)
+    # The same schedule is CLEAN on the shipped scheduler.
+    ok, msgs = explore.replay("submit_vs_worker_death", sch)
+    assert ok, msgs
+
+
+def test_replay_rejects_unknown_key():
+    ok, msgs = explore.replay("drain_vs_kill", ["deliver:w9:done:t9"])
+    assert not ok and any("mismatch" in m for m in msgs)
+
+
+# ------------------------------------------------------------------ corpus
+def test_sweep_writes_and_replays_corpus(tmp_path, monkeypatch):
+    monkeypatch.setattr(explore, "CORPUS_DIR", str(tmp_path))
+    assert explore.run_sweep(["drain_vs_kill"], budget=100, quiet=True)
+    path = tmp_path / "drain_vs_kill.json"
+    doc = json.loads(path.read_text())
+    assert doc["scenario"] == "drain_vs_kill" and doc["schedules"]
+    assert doc["failures"] == []
+    # Second sweep replays the stored corpus and stays green + byte-stable.
+    before = path.read_text()
+    assert explore.run_sweep(["drain_vs_kill"], budget=100, quiet=True)
+    assert path.read_text() == before
+
+
+def test_committed_corpus_replays():
+    # The shipped corpus under tools/explore_corpus/ must stay replayable.
+    if not os.path.isdir(explore.CORPUS_DIR):
+        pytest.skip("no committed corpus")
+    found = 0
+    for name in sorted(explore.SCENARIOS):
+        doc = explore._load_corpus(name)
+        if not doc:
+            continue
+        for sch in doc.get("schedules", []):
+            ok, msgs = explore.replay(name, sch)
+            assert ok, (name, sch, msgs)
+            found += 1
+    assert found > 0
+
+
+# ------------------------------------------------------- harness hygiene
+def test_harness_releases_fds():
+    import resource
+
+    # Each virtual scheduler opens two socketpairs + a selector; the DFS
+    # builds hundreds per explore() call. A teardown leak exhausts the fd
+    # table long before the sweep finishes — pin that close() runs.
+    soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    runs = 0
+    for _ in range(3):
+        r = explore.explore("seal_vs_owner_death", budget=60)
+        runs += r.schedules_run
+    assert runs * 5 > soft or True  # documentation only; the real check:
+    h = explore.Harness()
+    h.close()
+    for sock in (h.sched._wake_r, h.sched._wake_w,
+                 h.sched._urgent_r, h.sched._urgent_w):
+        assert sock.fileno() == -1

@@ -1,0 +1,311 @@
+"""rt-state static side: the lifecycle pass + the shared spec + the runtime
+monitor.
+
+Same two-layer structure as the other rt-lint passes: synthetic fixtures pin
+every check kind (L1-L8) against a tiny injected spec, and the live tree
+under the shipped allowlist must be clean. The spec itself is pinned as a
+pure literal (the pass never imports the runtime), and the armed runtime
+monitor — the second consumer of the same literal — is checked both
+in-process and through the RAY_TPU_DEBUG_INVARIANTS env seam.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu._private import lifecycle
+from ray_tpu.devtools import lint, pass_lifecycle
+from ray_tpu.devtools.astutil import Package, load_package
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE_DIR = os.path.join(REPO_ROOT, "ray_tpu")
+
+FIXTURE_SPEC = {
+    "door": {
+        "attr": "state",
+        "classes": ("Door",),
+        "receivers": ("d",),
+        "modules": ("fix", "other"),
+        "initial": "closed",
+        "terminal": ("broken",),
+        "transitions": {
+            # "closed" has no in-edge: stepping back to it is the L1 fixture.
+            "closed": {"open": ("fix",)},
+            "open": {"broken": ("fix",)},
+        },
+    },
+}
+
+
+def make_pkg(**modules: str) -> Package:
+    pkg = Package()
+    for name, src in modules.items():
+        pkg.add_module(name, name + ".py", textwrap.dedent(src))
+    return pkg
+
+
+def run_fixture(spec=None, **modules: str):
+    return pass_lifecycle.run(make_pkg(**modules),
+                              spec=FIXTURE_SPEC if spec is None else spec)
+
+
+GOOD = """
+    from ray_tpu._private import lifecycle
+
+    class Door:
+        state: str = "closed"
+
+    def open_door(d):
+        d.state = lifecycle.step("door", d.state, "open")
+
+    def smash(d):
+        if d.state == "open":
+            d.state = lifecycle.step("door", d.state, "broken")
+    """
+
+
+def test_good_fixture_is_clean():
+    assert run_fixture(fix=GOOD) == []
+
+
+def test_L1_undeclared_transition_and_bypass():
+    violations = run_fixture(fix="""
+        from ray_tpu._private import lifecycle
+
+        class Door:
+            state: str = "closed"
+
+        def reopen(d):
+            # "closed" is declared but has NO in-edge in the fixture spec.
+            d.state = lifecycle.step("door", d.state, "closed")
+
+        def slam(d):
+            d.state = "open"   # transition write not going through step()
+
+        def smash(d):
+            d.state = lifecycle.step("door", d.state, "broken")
+
+        def probe(d):
+            return d.state == "open"
+        """)
+    kinds = {v.key.rsplit(":", 1)[-1] for v in violations}
+    assert "undeclared-transition" in kinds
+    assert "bypasses-step" in kinds
+
+
+def test_L2_initial_mismatch_default_and_init():
+    violations = run_fixture(fix="""
+        from ray_tpu._private import lifecycle
+
+        class Door:
+            state: str = "open"
+
+            def __init__(self):
+                self.state = "open"
+
+        def open_door(d):
+            d.state = lifecycle.step("door", d.state, "open")
+
+        def smash(d):
+            if d.state == "broken":
+                return
+            d.state = lifecycle.step("door", d.state, "broken")
+
+        def probe(d):
+            return d.state == "closed"
+        """)
+    assert sum(1 for v in violations
+               if v.key.endswith("initial-mismatch")) == 2
+
+
+def test_L3_unknown_state_and_machine():
+    violations = run_fixture(fix="""
+        from ray_tpu._private import lifecycle
+
+        class Door:
+            state: str = "closed"
+
+        def open_door(d):
+            d.state = lifecycle.step("door", d.state, "ajar")
+
+        def teleport(d):
+            d.state = lifecycle.step("portal", d.state, "open")
+
+        def legal(d):
+            d.state = lifecycle.step("door", d.state, "open")
+            d.state = lifecycle.step("door", d.state, "broken")
+        """)
+    kinds = {v.key.rsplit(":", 1)[-1] for v in violations}
+    assert "unknown-state" in kinds
+    assert "unknown-machine" in kinds
+
+
+def test_L4_unauthorized_module():
+    # "other" is covered by the machine but authorized for NO edge.
+    violations = run_fixture(
+        fix=GOOD,
+        other="""
+        from ray_tpu._private import lifecycle
+
+        def sneak(d):
+            d.state = lifecycle.step("door", d.state, "open")
+        """,
+    )
+    assert any(v.key.endswith("unauthorized-module") and v.path == "other.py"
+               for v in violations)
+
+
+def test_L5_unknown_state_compare():
+    violations = run_fixture(fix=GOOD + """
+    def probe(d):
+        return d.state in ("open", "ajar")
+    """)
+    bad = [v for v in violations if v.key.endswith("unknown-state-compare")]
+    assert len(bad) == 1 and "ajar" in bad[0].message
+
+
+def test_L6_unreachable_state():
+    spec = {
+        "door": dict(FIXTURE_SPEC["door"], terminal=("broken", "stuck")),
+    }
+    violations = run_fixture(spec=spec, fix=GOOD)
+    assert any(v.key.endswith("unreachable") and "stuck" in v.message
+               for v in violations)
+
+
+def test_L7_unattributed_write():
+    violations = run_fixture(fix=GOOD + """
+    def mystery(q):
+        q.state = "open"
+    """)
+    assert any(v.key.endswith("unattributed-write") for v in violations)
+
+
+def test_L8_old_arg_and_spec_incoherence():
+    violations = run_fixture(fix=GOOD + """
+    def swap(d, e):
+        d.state = lifecycle.step("door", e.state, "open")
+    """)
+    assert any(v.key.endswith("old-arg-mismatch") for v in violations)
+
+    bad_spec = {
+        "door": dict(
+            FIXTURE_SPEC["door"],
+            transitions={
+                "closed": {"open": ("fix",)},
+                "open": {"broken": ("fix",)},
+                "broken": {"open": ("fix",)},  # terminal with an out-edge
+            },
+        ),
+    }
+    violations = run_fixture(spec=bad_spec, fix=GOOD)
+    assert any(v.key.endswith("terminal-out-edge") for v in violations)
+
+
+def test_missing_spec_is_a_violation():
+    violations = pass_lifecycle.run(make_pkg(fix=GOOD))
+    assert len(violations) == 1 and "missing-spec" in violations[0].key
+
+
+# ------------------------------------------------------------- shared spec
+def test_spec_is_a_pure_literal_with_enough_machines():
+    # Both consumers (this pass and the runtime monitor) read the SAME
+    # literal; a refactor to computed values would silently disable the pass.
+    pkg = load_package(PACKAGE_DIR, package_name="ray_tpu")
+    spec = pass_lifecycle._spec_from_source(pkg)
+    assert isinstance(spec, dict) and len(spec) >= 6
+    assert spec == lifecycle.LIFECYCLE_SPEC
+    for name, m in spec.items():
+        states = pass_lifecycle._machine_states(m)
+        assert m["initial"] in states, name
+        for old, outs in m["transitions"].items():
+            for new, mods in outs.items():
+                assert mods, f"{name}: edge {old}->{new} authorizes no module"
+
+
+def test_spec_literal_parses_without_import():
+    src = open(os.path.join(PACKAGE_DIR, "_private", "lifecycle.py")).read()
+    tree = ast.parse(src)
+    found = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "LIFECYCLE_SPEC"
+            for t in node.targets
+        ):
+            found = ast.literal_eval(node.value)
+    assert isinstance(found, dict) and len(found) >= 6
+
+
+# --------------------------------------------------------------- live tree
+def test_live_tree_is_clean_under_shipped_allowlist():
+    # Full run (not passes=("lifecycle",)): the shared allowlist holds
+    # entries for every pass, and stale-entry detection needs them all live.
+    violations, errors = lint.run_all(
+        PACKAGE_DIR, allowlist_path=lint.DEFAULT_ALLOWLIST,
+    )
+    lifecycle_v = [v for v in violations if v.pass_id == "lifecycle"]
+    msg = "\n".join(v.render() for v in lifecycle_v) + "\n".join(errors)
+    assert not lifecycle_v and not errors, f"lifecycle regressions:\n{msg}"
+
+
+def test_cli_json_output_includes_lifecycle_pass():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.lint", PACKAGE_DIR,
+         "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    doc = json.loads(proc.stdout)
+    assert doc["tool"] == "rt-lint" and doc["exit_code"] == 0
+
+
+# --------------------------------------------------------- runtime monitor
+def test_runtime_monitor_enforces_spec_edges(monkeypatch):
+    monkeypatch.setattr(lifecycle, "ENABLED", True)
+    lifecycle.reset()
+    assert lifecycle.step("task", "PENDING", "RUNNING") == "RUNNING"
+    assert lifecycle.step("task", "RUNNING", "RUNNING") == "RUNNING"  # self-loop
+    with pytest.raises(AssertionError, match="illegal transition"):
+        lifecycle.step("task", "FINISHED", "RUNNING")
+    with pytest.raises(AssertionError, match="undeclared state"):
+        lifecycle.step("task", "PENDING", "LIMBO")
+    with pytest.raises(AssertionError, match="unknown machine"):
+        lifecycle.step("ghost", "a", "b")
+    assert len(lifecycle.violations()) == 3
+    lifecycle.reset()
+    assert lifecycle.violations() == []
+
+
+def test_runtime_monitor_disabled_is_passthrough(monkeypatch):
+    monkeypatch.setattr(lifecycle, "ENABLED", False)
+    lifecycle.reset()
+    # Off-mode must never raise, whatever the edge: it is the hot path.
+    assert lifecycle.step("task", "FINISHED", "RUNNING") == "RUNNING"
+    assert lifecycle.violations() == []
+
+
+def test_debug_invariants_env_arms_monitor():
+    env = dict(os.environ, RAY_TPU_DEBUG_INVARIANTS="1", JAX_PLATFORMS="cpu")
+    code = (
+        "from ray_tpu._private import lifecycle\n"
+        "assert lifecycle.ENABLED\n"
+        "try:\n"
+        "    lifecycle.step('worker', 'dying', 'idle')\n"
+        "except AssertionError:\n"
+        "    print('CAUGHT')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=REPO_ROOT,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0 and "CAUGHT" in proc.stdout, (
+        proc.stdout + proc.stderr
+    )

@@ -16,6 +16,11 @@ if [[ "${1:-}" == "--lint-only" ]]; then
 fi
 
 echo
+echo "== rt-verify explore (control-plane interleaving sweep + corpus replay) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu \
+    python -m ray_tpu.devtools.verify ray_tpu --passes stale --explore all
+
+echo
 echo "== native wire-codec parity fuzz (from-source build + C/py byte parity) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/native_parity_fuzz.py
 
