@@ -98,15 +98,26 @@ REQUIRED_METRICS_SERVE = (
     "serve_proxy_scaling_ratio",
 )
 
+# Elastic-training suite (bench_elastic.py -> BENCH_ELASTIC.json): the
+# resize-in-place contract — churn must cost a bounded recovery window and
+# leave the gang mostly productive (ISSUE 19 acceptance).
+REQUIRED_METRICS_ELASTIC = (
+    "elastic_time_to_recover_s",
+    "elastic_goodput_under_churn",
+    "elastic_resizes",
+)
+
 # Which REQUIRED set applies is decided by what the BASELINE contains
 # (--baseline invites arbitrary copied/renamed paths, so a filename key
 # would silently drop the data-plane contract): a baseline carrying any
-# data-plane/serve metric is held to that suite's REQUIRED set.
+# data-plane/serve/elastic metric is held to that suite's REQUIRED set.
 def required_for(baseline_metrics: Dict[str, float]) -> tuple:
     if any(m in baseline_metrics for m in REQUIRED_METRICS_DATAPLANE):
         return REQUIRED_METRICS_DATAPLANE
     if any(m in baseline_metrics for m in REQUIRED_METRICS_SERVE):
         return REQUIRED_METRICS_SERVE
+    if any(m in baseline_metrics for m in REQUIRED_METRICS_ELASTIC):
+        return REQUIRED_METRICS_ELASTIC
     return REQUIRED_METRICS
 
 # Absolute floors, enforced regardless of the baseline's value: trajectory
@@ -127,6 +138,10 @@ HARD_FLOORS = {
     "serve_saturation_goodput_ratio": 0.8,
     # Ingress must scale with proxies: 2-proxy aggregate >= 1.5x single.
     "serve_proxy_scaling_ratio": 1.5,
+    # Under the seeded churn schedule the gang must stay >= 70% productive
+    # post-bring-up (ISSUE 19 acceptance: resize-in-place keeps preemption
+    # cheap; a full-gang-restart design lands well below this).
+    "elastic_goodput_under_churn": 0.7,
 }
 
 # Metrics where SMALLER is better (seconds of recovery, not ops/s): the
@@ -134,6 +149,7 @@ HARD_FLOORS = {
 # threshold fails, a drop is an improvement.
 LOWER_IS_BETTER = frozenset({
     "worker_kill_recovery_s",
+    "elastic_time_to_recover_s",
     "serve_p50_ms",
     "serve_p95_ms",
     "serve_p99_ms",

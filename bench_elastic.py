@@ -1,0 +1,135 @@
+"""Elastic-training benchmark: time-to-recover and goodput under churn
+(ISSUE 19 acceptance).
+
+A 4-worker elastic gang runs a fixed-length training job (~25ms steps) while
+a seeded preemption schedule kills ranks mid-run. Two metrics come out of the
+goodput ledger:
+
+ - elastic_time_to_recover_s: mean wall time of one resize-in-place window
+   (detection -> drain -> re-rendezvous -> session re-init -> first new
+   round), i.e. buckets["resize"] / resizes. Lower is better.
+ - elastic_goodput_under_churn: productive share of the post-bring-up wall,
+   productive / (productive + checkpoint + resize + recover + idle). The
+   acceptance floor is 0.7 — resize-in-place must keep churn cheap enough
+   that the gang spends >= 70% of its life doing real steps.
+
+Prints one JSON line per metric (the BENCH_ELASTIC.json format bench_check.py
+consumes). Runs anywhere: the workload is numpy on CPU workers.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+STEPS = 160
+STEP_S = 0.025
+WORLD = 4
+KILL_ROUNDS = (30, 90)  # two churn events, seeded by round
+RULES = [("w", ("data", None)), (".*", ())]
+
+
+def _emit(results, name, value, unit):
+    rec = {"metric": name, "value": round(value, 3), "unit": unit}
+    results.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def train_fn(config):
+    import numpy as np
+
+    from ray_tpu.air import session
+    from ray_tpu.train.jax import resharding
+
+    rank = session.get_world_rank()
+    world = session.get_world_size()
+    full = {"w": np.arange(24.0).reshape(6, 4), "step": np.float64(0)}
+    start = 0
+    ck = session.get_checkpoint()
+    if ck is not None:
+        start, st, _ = resharding.resume_state(ck.to_dict())
+        full = {"w": np.asarray(st["w"]), "step": np.float64(start)}
+    for s in range(start, STEPS):
+        session.mark_phase("step_exec")
+        time.sleep(STEP_S)
+        full["w"] = full["w"] + 1.0
+        full["step"] = np.float64(s + 1)
+        session.stash_checkpoint(
+            resharding.shard_for_rank(full, RULES, world, rank),
+            rules=RULES,
+            step=s + 1,
+        )
+        session.report({"step": s + 1, "loss": float(full["w"].sum())})
+
+
+def main():
+    import ray_tpu
+    from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+    from ray_tpu.util import state
+    from ray_tpu.util.preemption import (
+        PreemptionEvent,
+        PreemptionSchedule,
+        PreemptionSimulator,
+    )
+
+    results = []
+    ray_tpu.init(num_cpus=8)
+    sim = PreemptionSimulator(
+        PreemptionSchedule(
+            [
+                PreemptionEvent(at_round=r, rank=(i + 1) % WORLD, mode="kill")
+                for i, r in enumerate(KILL_ROUNDS)
+            ]
+        )
+    ).install()
+    try:
+        trainer = DataParallelTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=WORLD, elastic=True),
+            run_config=RunConfig(failure_config=FailureConfig(max_failures=0)),
+        )
+        result = trainer.fit()
+        assert result.error is None, f"churn run errored: {result.error}"
+        expected = 276.0 + 24.0 * STEPS
+        assert result.metrics["loss"] == expected, (
+            f"loss continuity broken under churn: "
+            f"{result.metrics['loss']} != {expected}"
+        )
+
+        rep = list(state.training_report()["gangs"].values())[-1]
+        b = rep["buckets"]
+        resizes = max(1, rep["resizes"])
+        assert rep["resizes"] == len(KILL_ROUNDS), rep
+        _emit(
+            results, "elastic_time_to_recover_s",
+            b["resize"] / resizes, "s",
+        )
+        # Post-bring-up wall: everything but the one-time init/compile/
+        # rendezvous cost — the steady-state window churn actually taxes.
+        churn_wall = (
+            b["productive"] + b["checkpoint"] + b["resize"]
+            + b["recover"] + b["idle"]
+        )
+        _emit(
+            results, "elastic_goodput_under_churn",
+            (b["productive"] / churn_wall) if churn_wall else 0.0, "ratio",
+        )
+        _emit(results, "elastic_resizes", float(rep["resizes"]), "count")
+        _emit(
+            results, "elastic_final_world_size",
+            float(rep["world_size"]), "workers",
+        )
+    finally:
+        sim.uninstall()
+        ray_tpu.shutdown()
+
+    print()
+    for r in results:
+        print(f"# {r['metric']:32s} {r['value']:>12g} {r['unit']}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -352,6 +352,18 @@ class Config:
     # rule's for_s, evaluated per result round on the BackendExecutor).
     train_straggler_for_s: float = 2.0
 
+    # --- elastic gang training (ScalingConfig.elastic; ISSUE 19) ---
+    # Step-boundary drain budget per surviving rank at resize: a rank that
+    # cannot reach its next report within this window (collective hang,
+    # multi-minute step) is treated as dead and replaced.
+    elastic_drain_timeout_s: float = 10.0
+    # Liveness probe timeout when re-forming membership after a loss.
+    elastic_probe_timeout_s: float = 5.0
+    # How long a shrunken gang waits before trying to re-expand toward
+    # ScalingConfig.num_workers: preempted capacity rarely returns instantly,
+    # and eager re-expansion right after a kill would thrash the gang.
+    elastic_grow_after_s: float = 30.0
+
     # --- worker process ---
     # Stream worker stdout/stderr to subscribed drivers (init(log_to_driver=)).
     log_to_driver: bool = True

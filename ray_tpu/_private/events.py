@@ -46,6 +46,8 @@ EVENT_KINDS = (
     "serve_replica_failover",
     "serve_scale",
     "train_gang_recover",
+    "train_gang_resize",
+    "train_preempt_notice",
     "train_straggler",
     "worker_dead",
     "worker_started",

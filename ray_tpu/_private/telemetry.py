@@ -517,9 +517,15 @@ def train_metrics() -> dict:
     watches."""
     global _train_metrics
     if _train_metrics is None:
-        from ray_tpu.util.metrics import Gauge, Histogram
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
         _train_metrics = {
+            "resize_total": Counter(
+                "ray_tpu_train_resize_total",
+                "elastic gang membership changes (resize-in-place), "
+                "incremented by the driver per re-formation",
+                ("gang", "direction"),
+            ),
             "step_seconds": Histogram(
                 "ray_tpu_train_step_seconds",
                 "training-step phase wall time per rank "

@@ -29,10 +29,24 @@ class ScalingConfig:
     mesh: Optional[Union[Dict[str, int], Any]] = None
     # Chips each worker process owns (TPU hosts have 4 or 8 local chips).
     tpus_per_worker: Optional[float] = None
+    # Elastic gang membership (ISSUE 19): on a worker/node loss the gang
+    # drains survivors at a step boundary and re-forms at the new world size
+    # instead of failing the run (resizes do NOT consume FailureConfig's
+    # max_failures budget), then re-expands toward num_workers when capacity
+    # returns. Elastic gangs are scheduled by plain resources, not an
+    # all-or-nothing placement group.
+    elastic: bool = False
+    # Floor below which a resize is impossible and the loss is treated as an
+    # ordinary gang failure. Defaults to 1.
+    min_workers: Optional[int] = None
 
     def __post_init__(self):
         if self.num_workers < 1:
             raise ValueError("num_workers must be >= 1")
+        if self.min_workers is not None and not (
+            1 <= self.min_workers <= self.num_workers
+        ):
+            raise ValueError("min_workers must be in [1, num_workers]")
 
     @property
     def _resources(self) -> Dict[str, float]:

@@ -52,6 +52,20 @@ def mark_phase(phase: str) -> None:
         marker(phase)
 
 
+def stash_checkpoint(state: Any, *, rules=None, step: Optional[int] = None) -> None:
+    """In-memory checkpoint for elastic recovery: snapshot this rank's state
+    (host numpy) into the worker's stash and mirror it to a peer worker, so a
+    node loss never loses the newest step. `rules` is an ordered list of
+    ``(regex, partition_spec)`` pairs (train.jax.resharding) describing how
+    `state` is sharded across the gang; omit it when `state` is replicated.
+    `step` defaults to the number of `report` calls completed so far. No-op
+    outside a Train worker session, so loops can stash unconditionally."""
+    sess = _require_session()
+    stasher = getattr(sess, "stash_checkpoint", None)
+    if stasher is not None:
+        stasher(state, rules=rules, step=step)
+
+
 def get_checkpoint() -> Optional[Checkpoint]:
     """The checkpoint to resume from (set on restart after failure), else None."""
     return _require_session().loaded_checkpoint

@@ -374,9 +374,21 @@ def cmd_train(ns):
         wall = g.get("wall_s", 0.0) or 0.0
         print(f"gang {gang_id}  [{g.get('status', '?')}]  "
               f"world_size={g.get('world_size', '?')}  steps={g.get('steps', 0)}  "
-              f"failures={g.get('failures', 0)}")
+              f"failures={g.get('failures', 0)}  "
+              f"resizes={g.get('resizes', 0)}")
         print(f"  wall {wall:.2f}s  goodput {g.get('goodput_frac', 0.0) * 100:.1f}%  "
               f"coverage {g.get('coverage', 0.0) * 100:.1f}%")
+        last_resize = g.get("last_resize")
+        if last_resize:
+            print(f"  last resize: {last_resize.get('old_world')} -> "
+                  f"{last_resize.get('new_world')} "
+                  f"({last_resize.get('direction')}, "
+                  f"{last_resize.get('reason')}; "
+                  f"{last_resize.get('resize_s', 0.0):.2f}s, resumed from "
+                  f"{last_resize.get('ckpt_source')} checkpoint)")
+        if g.get("proactive_checkpoints"):
+            print(f"  proactive checkpoints: {g['proactive_checkpoints']} "
+                  f"(SUSPECT-triggered stash fetches)")
         for bucket, secs in (g.get("buckets") or {}).items():
             share = secs / wall * 100 if wall > 0 else 0.0
             print(f"    {bucket:<16} {secs:>10.3f}s {share:>6.1f}%")

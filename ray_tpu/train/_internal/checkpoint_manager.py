@@ -46,7 +46,20 @@ class CheckpointManager:
         try:
             self._count += 1
             path = os.path.join(self.run_dir, f"checkpoint_{self._count:06d}")
-            checkpoint.to_directory(path)
+            # Crash-safe persist: materialize into a .tmp sibling, then one
+            # atomic rename. A crash mid-write leaves only a .tmp directory,
+            # which restore_from_disk ignores (and sweeps) — latest_checkpoint
+            # can never point at a torn entry.
+            tmp = path + ".tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            checkpoint.to_directory(tmp)
+            from ray_tpu._private import failpoints
+
+            if failpoints.ENABLED:
+                # Chaos seam between write and publish: a crash/error here is
+                # the torn-persist case the atomic rename protects against.
+                failpoints.maybe_crash("ckpt.persist")
+            os.rename(tmp, path)
             self._kept.append((path, dict(metrics or {})))
             self._prune()
             self._write_manifest()
@@ -93,8 +106,13 @@ class CheckpointManager:
             pass
         found = []
         for entry in sorted(os.listdir(self.run_dir)):
-            m = re.fullmatch(r"checkpoint_(\d+)", entry)
             path = os.path.join(self.run_dir, entry)
+            if entry.endswith(".tmp") and re.fullmatch(r"checkpoint_\d+\.tmp", entry):
+                # Torn persist from a crash mid-write: never a valid resume
+                # point (the atomic rename did not happen). Sweep it.
+                shutil.rmtree(path, ignore_errors=True)
+                continue
+            m = re.fullmatch(r"checkpoint_(\d+)", entry)
             if m is None or not os.path.isdir(path):
                 continue
             metrics = manifest.get(entry, {})

@@ -14,6 +14,9 @@ Every second of a fit() is claimed by exactly one bucket:
                   (CheckpointManager.register)
   recover         failure detection + full gang restart after a
                   TrainingWorkerError
+  resize          elastic membership change: drain at the step boundary,
+                  re-rendezvous at the new world size, session re-init
+                  (ISSUE 19 — resizes are not failures and not recover)
   idle            everything else: data_wait, report backpressure, driver
                   overhead between rounds
 
@@ -36,7 +39,7 @@ from typing import Any, Dict, List, Optional
 
 BUCKETS = (
     "productive", "init", "compile", "rendezvous_wait",
-    "checkpoint", "recover", "idle",
+    "checkpoint", "recover", "resize", "idle",
 )
 
 # Worker step-phase -> ledger bucket for the per-round fold. data_wait and
@@ -73,6 +76,10 @@ class GoodputLedger:
         self.buckets: Dict[str, float] = {b: 0.0 for b in BUCKETS}
         self.steps = 0
         self.failures = 0
+        # Elastic membership changes (not failures): count + last transition.
+        self.resizes = 0
+        self.last_resize: Optional[Dict[str, Any]] = None
+        self.proactive_checkpoints = 0
         self.status = "running"
         self.max_skew_s = 0.0
         self.last_skew_s = 0.0
@@ -139,6 +146,22 @@ class GoodputLedger:
             self._slow_last[rank] = straggler
         self.per_rank = per_rank
 
+    def note_resize(self, old_world: int, new_world: int, reason: str,
+                    resize_s: float, ckpt_source: str) -> None:
+        """Record one elastic membership change; the wall time was already
+        accounted into the resize bucket by the trainer."""
+        self.resizes += 1
+        self.world_size = new_world
+        self.last_resize = {
+            "old_world": old_world,
+            "new_world": new_world,
+            "direction": "grow" if new_world > old_world else "shrink",
+            "reason": reason,
+            "resize_s": round(resize_s, 6),
+            "ckpt_source": ckpt_source,
+        }
+        self.publish(force=True)
+
     @property
     def straggler(self) -> Optional[Dict[str, Any]]:
         """The modal slow rank with its latest round's phase attribution,
@@ -170,6 +193,9 @@ class GoodputLedger:
             if wall > 0 else 0.0,
             "steps": self.steps,
             "failures": self.failures,
+            "resizes": self.resizes,
+            "last_resize": self.last_resize,
+            "proactive_checkpoints": self.proactive_checkpoints,
             "skew_s": round(self.last_skew_s, 6),
             "max_skew_s": round(self.max_skew_s, 6),
             "straggler": self.straggler,

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import traceback
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
@@ -23,6 +24,15 @@ from ray_tpu.air.checkpoint import Checkpoint
 REPORT = "report"
 DONE = "done"
 ERROR = "error"
+# The session was stopped at a step boundary by an elastic drain — not an
+# error, not a completion. Emitted to unblock any in-flight next_result.
+DRAINED = "drained"
+
+
+class SessionDrained(BaseException):
+    """Raised inside `session.report` when the driver drained this rank at a
+    step boundary (elastic resize). Derives from BaseException so a user
+    loop's `except Exception` cannot swallow the gang's stop request."""
 
 
 @dataclass
@@ -78,6 +88,11 @@ class _TrainSession:
         self._q: "queue.Queue[TrainingResult]" = queue.Queue(maxsize=1)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._finished = threading.Event()
+        # Elastic drain: set by the driver (via the worker actor) to stop the
+        # loop at the next step boundary; `drained` records a clean stop.
+        self._stop = threading.Event()
+        self.drained = False
+        self._reported_steps = 0
 
     # ----------------------------------------------------------- thread side
     def _run(self):
@@ -109,6 +124,13 @@ class _TrainSession:
                     except Exception:  # noqa: BLE001
                         pass
             self._q.put(done)
+        except SessionDrained:
+            # Elastic stop at a step boundary: clean, no result to forward
+            # (the driver is not reading this queue any more — it is mid
+            # resize and will re-init the session on the re-formed gang).
+            self.drained = True
+            if self._clock is not None:
+                self._clock.finalize()
         except BaseException as e:  # noqa: BLE001 - forwarded to the driver
             if self._clock is not None:
                 self._clock.finalize()
@@ -132,6 +154,8 @@ class _TrainSession:
     def report(self, metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
         from ray_tpu._private import failpoints
 
+        if self._stop.is_set():
+            raise SessionDrained()
         if failpoints.ENABLED:
             # Injection point for straggler (delay) and mid-step crash
             # (recover accounting) scenarios: fires on the session thread
@@ -144,6 +168,9 @@ class _TrainSession:
         clock = self._clock
         if clock is None:
             self._q.put(result)
+            self._reported_steps += 1
+            if self._stop.is_set():
+                raise SessionDrained()
             return
         telem = clock.close_step(checkpoint=checkpoint is not None)
         if clock.metrics_on:
@@ -155,13 +182,63 @@ class _TrainSession:
             self._q.put(result)
         finally:
             clock.mark("step_exec")
+        self._reported_steps += 1
+        # Second seam: the drain request may have landed while this thread
+        # was blocked in the bounded-queue put above.
+        if self._stop.is_set():
+            raise SessionDrained()
+
+    def stash_checkpoint(self, state: Any, *, rules=None,
+                         step: Optional[int] = None) -> None:
+        """In-memory checkpoint stash + peer mirror (elastic recovery). The
+        state is snapshot to host numpy; the mirror push is fire-and-forget
+        (see train/_internal/elastic.py)."""
+        from ray_tpu.air.checkpoint import _tree_to_host
+        from ray_tpu.train._internal import elastic
+
+        elastic.stash(
+            rank=self.world_rank,
+            step=self._reported_steps if step is None else int(step),
+            world_size=self.world_size,
+            state=_tree_to_host(state),
+            rules=rules,
+        )
 
     # ----------------------------------------------------------- driver side
     def start(self):
         self._thread.start()
 
     def next_result(self, timeout: Optional[float] = None) -> TrainingResult:
-        return self._q.get(timeout=timeout)
+        # Polling get, not a bare blocking get: a drained session puts nothing
+        # more, and the actor thread parked here must unwind (the driver has
+        # abandoned the ref) instead of pinning a concurrency slot forever.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._finished.is_set() and self._q.empty():
+                    return TrainingResult(DRAINED, world_rank=self.world_rank)
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Stop the session at the next step boundary and unblock a put-
+        blocked report by consuming the queue. Returns True when the loop
+        thread actually finished within the timeout (a False return means the
+        rank is stuck mid-step — collective hang, very long step — and the
+        caller should treat it as dead)."""
+        self._stop.set()
+        deadline = time.monotonic() + timeout
+        while not self._finished.is_set() and time.monotonic() < deadline:
+            try:
+                self._q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+        return self._finished.is_set()
 
     def telemetry_snapshot(self) -> Optional[Dict[str, Any]]:
         """Cumulative phase totals so far (driver-pollable, no step close).

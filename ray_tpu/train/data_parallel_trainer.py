@@ -19,7 +19,11 @@ from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import RunConfig, ScalingConfig
 from ray_tpu.air.result import Result
 from ray_tpu.air import session as air_session
-from ray_tpu.train._internal.backend_executor import BackendExecutor, TrainingWorkerError
+from ray_tpu.train._internal.backend_executor import (
+    BackendExecutor,
+    GangResizeNeeded,
+    TrainingWorkerError,
+)
 from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
 from ray_tpu.train._internal.ledger import GoodputLedger
 from ray_tpu.train.backend import BackendConfig
@@ -63,7 +67,9 @@ class DataParallelTrainer(BaseTrainer):
         self._inside_tune = False
 
     # ------------------------------------------------------------- data ingest
-    def _dataset_shards(self) -> Optional[List[Dict[str, Any]]]:
+    def _dataset_shards(
+        self, num_workers: Optional[int] = None
+    ) -> Optional[List[Dict[str, Any]]]:
         """Pipelined per-worker iterators over each provided dataset (Data
         P18 ingest seam; reference: `streaming_split` feeding
         `session.get_dataset_shard`, `python/ray/data/dataset.py:1134`).
@@ -76,7 +82,7 @@ class DataParallelTrainer(BaseTrainer):
         """
         if not self.datasets:
             return None
-        n = self.scaling_config.num_workers
+        n = num_workers or self.scaling_config.num_workers
         shards: List[Dict[str, Any]] = [{} for _ in range(n)]
         for name, ds in self.datasets.items():
             if hasattr(ds, "streaming_split"):
@@ -87,6 +93,68 @@ class DataParallelTrainer(BaseTrainer):
                 for i in range(n):
                     shards[i][name] = ds
         return shards
+
+    # ------------------------------------------------------------- elastic path
+    def _resize_and_resume(
+        self,
+        executor: BackendExecutor,
+        reason: str,
+        grow: bool,
+        ledger,
+        gang_id: str,
+        ckpt_mgr: CheckpointManager,
+        latest_ckpt: Optional[Checkpoint],
+        mesh_builder,
+    ) -> Optional[Checkpoint]:
+        """Re-form an elastic gang in place and restart its sessions from the
+        newest checkpoint. Returns the checkpoint resumed from; raises
+        TrainingWorkerError (budgeted, whole-gang restart) when the gang
+        cannot re-form at min_workers."""
+        info = executor.resize_gang(reason, grow=grow)
+        resume_ckpt = (
+            info["checkpoint"] or ckpt_mgr.latest_checkpoint or latest_ckpt
+        )
+        executor.start_training(
+            self._train_fn,
+            self._train_loop_config,
+            checkpoint=resume_ckpt,
+            dataset_shards=self._dataset_shards(info["new_world"]),
+            mesh_builder=mesh_builder,
+        )
+        # Everything since the last round fold — detection, drain, respawn,
+        # re-rendezvous, session re-init — is the resize badput window; its
+        # length is the per-event time-to-recover.
+        resize_s = ledger.account("resize") if ledger is not None else 0.0
+        direction = "grow" if info["new_world"] > info["old_world"] else "shrink"
+        from ray_tpu._private.events import emit_event
+        from ray_tpu._private.telemetry import metrics_enabled, train_metrics
+
+        emit_event(
+            "train_gang_resize",
+            f"gang {gang_id}: re-formed {info['old_world']} -> "
+            f"{info['new_world']} workers ({reason}; {resize_s:.2f}s, "
+            f"resumed from {info['ckpt_source']} checkpoint)",
+            severity="warning",
+            source="train-driver",
+            gang=gang_id,
+            old_world=info["old_world"],
+            new_world=info["new_world"],
+            direction=direction,
+            reason=reason,
+            resize_s=round(resize_s, 6),
+            ckpt_source=info["ckpt_source"],
+            step=info["recovered_step"],
+        )
+        if metrics_enabled():
+            train_metrics()["resize_total"].inc(
+                1, {"gang": gang_id, "direction": direction}
+            )
+        if ledger is not None:
+            ledger.note_resize(
+                info["old_world"], info["new_world"], reason, resize_s,
+                info["ckpt_source"],
+            )
+        return resume_ckpt
 
     # ---------------------------------------------------------------- fit loop
     def _fit_impl(self, trial_info: Optional[Dict[str, str]] = None) -> Result:
@@ -152,7 +220,17 @@ class DataParallelTrainer(BaseTrainer):
                     else:
                         ledger.account_init(executor.gang_rendezvous_seconds())
                 while True:
-                    results = executor.get_next_results()
+                    try:
+                        results = executor.get_next_results()
+                    except GangResizeNeeded as sig:
+                        # Elastic membership change: re-form in place, resume
+                        # from the newest checkpoint (in-memory replica when
+                        # it beats the last disk persist). NOT a failure.
+                        latest_ckpt = self._resize_and_resume(
+                            executor, sig.reason, sig.grow, ledger, gang_id,
+                            ckpt_mgr, latest_ckpt, mesh_builder,
+                        )
+                        continue
                     if results is None:
                         break
                     rank0 = results[0]
@@ -163,6 +241,7 @@ class DataParallelTrainer(BaseTrainer):
                     )
                     if ckpt is not None:
                         latest_ckpt = ckpt_mgr.register(ckpt, rank0.metrics)
+                        executor.note_persisted_checkpoint()
                         if ledger is not None:
                             # Driver-side persist rides the checkpoint bucket.
                             ledger.account("checkpoint")
@@ -171,6 +250,12 @@ class DataParallelTrainer(BaseTrainer):
                         tune_session.report(
                             dict(last_metrics or {}),
                             checkpoint=ckpt if ckpt is not None else None,
+                        )
+                    if executor.should_grow():
+                        # Capacity returned: re-expand toward the target.
+                        latest_ckpt = self._resize_and_resume(
+                            executor, "capacity returned", True, ledger,
+                            gang_id, ckpt_mgr, latest_ckpt, mesh_builder,
                         )
                 executor.shutdown()
                 if ledger is not None:

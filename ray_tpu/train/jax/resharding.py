@@ -1,0 +1,208 @@
+"""Rule-based checkpoint resharding: regex rules -> PartitionSpec pytree.
+
+The machinery behind elastic resize (ISSUE 19): when a gang shrinks or grows,
+the surviving checkpoint shards must re-partition onto the new mesh. The rule
+format follows the flax/EasyLM `match_partition_rules` idiom (SNIPPETS.md [2]):
+an ordered list of ``(regex, PartitionSpec)`` pairs matched against the
+'/'-joined path of each leaf; first match wins; scalars are always replicated.
+
+Everything here is host-side and jax-optional: partition specs are plain
+tuples (``None`` = replicated axis, an axis *name* marks the sharded
+dimension), shards are numpy arrays, and `device_put_tree` upgrades the result
+to `jax.NamedSharding` only when jax and a live mesh are available. That keeps
+the elastic path testable on hosts whose jaxlib cannot run multiprocess SPMD.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# A partition spec is a tuple with one entry per array dimension: None keeps
+# the dimension replicated, a string names the mesh axis it shards over. The
+# empty tuple replicates the whole leaf (always used for scalars).
+PartitionSpec = Tuple[Optional[str], ...]
+
+REPLICATED: PartitionSpec = ()
+
+
+def tree_paths(tree: Any, sep: str = "/") -> Dict[str, Any]:
+    """Flatten a nested dict/list pytree into {joined_path: leaf}."""
+    out: Dict[str, Any] = {}
+
+    def walk(node: Any, prefix: List[str]) -> None:
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], prefix + [str(k)])
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, prefix + [str(i)])
+        else:
+            out[sep.join(prefix)] = node
+
+    walk(tree, [])
+    return out
+
+
+def tree_unflatten(paths: Dict[str, Any], sep: str = "/") -> Any:
+    """Inverse of `tree_paths` for dict-shaped trees (lists come back as
+    dicts keyed by index — fine for checkpoint state, which is dict-shaped)."""
+    root: Dict[str, Any] = {}
+    for path, leaf in paths.items():
+        parts = path.split(sep) if path else [""]
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return root
+
+
+def match_partition_rules(
+    rules: Sequence[Tuple[str, PartitionSpec]], tree: Any, sep: str = "/"
+) -> Dict[str, PartitionSpec]:
+    """Map every leaf path to its PartitionSpec via the first matching regex.
+
+    Scalars (0-d) are replicated without consulting the rules. A non-scalar
+    leaf matching no rule is an error — silent replication of a sharded
+    tensor is how resharding corrupts a run.
+    """
+    specs: Dict[str, PartitionSpec] = {}
+    for path, leaf in tree_paths(tree, sep).items():
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0:
+            specs[path] = REPLICATED
+            continue
+        for pattern, spec in rules:
+            if re.search(pattern, path) is not None:
+                specs[path] = tuple(spec)
+                break
+        else:
+            raise ValueError(
+                f"no partition rule matches checkpoint leaf '{path}' "
+                f"(shape {tuple(shape)}); add a rule or an explicit "
+                f"catch-all ('.*', ())"
+            )
+    return specs
+
+
+def _shard_axis(spec: PartitionSpec) -> Optional[int]:
+    """The (single) dimension a spec shards, or None if fully replicated."""
+    axes = [i for i, a in enumerate(spec) if a is not None]
+    if not axes:
+        return None
+    if len(axes) > 1:
+        raise ValueError(f"at most one sharded dimension supported, got {spec}")
+    return axes[0]
+
+
+def shard_for_rank(
+    tree: Any,
+    rules: Sequence[Tuple[str, PartitionSpec]],
+    world_size: int,
+    rank: int,
+    sep: str = "/",
+) -> Any:
+    """Slice a full (host) state tree down to one rank's shard.
+
+    Sharded dimensions use balanced uneven splits (np.array_split semantics:
+    shard sizes differ by at most one) so ANY world size can host the state —
+    the point of elastic resize is that 4 -> 3 must work without padding the
+    model to a magic multiple.
+    """
+    specs = match_partition_rules(rules, tree, sep)
+    leaves = tree_paths(tree, sep)
+    out: Dict[str, Any] = {}
+    for path, leaf in leaves.items():
+        axis = _shard_axis(specs[path])
+        if axis is None:
+            out[path] = leaf
+            continue
+        arr = np.asarray(leaf)
+        start, stop = shard_bounds(arr.shape[axis], world_size, rank)
+        index = [slice(None)] * arr.ndim
+        index[axis] = slice(start, stop)
+        out[path] = np.ascontiguousarray(arr[tuple(index)])
+    return tree_unflatten(out, sep)
+
+
+def shard_bounds(dim: int, world_size: int, rank: int) -> Tuple[int, int]:
+    """[start, stop) of `rank`'s slice of a dimension of size `dim` under the
+    balanced uneven split (first dim % world ranks get the extra element)."""
+    base, extra = divmod(dim, world_size)
+    start = rank * base + min(rank, extra)
+    return start, start + base + (1 if rank < extra else 0)
+
+
+def gather_tree(
+    shards_by_rank: Dict[int, Any],
+    rules: Sequence[Tuple[str, PartitionSpec]],
+    sep: str = "/",
+) -> Any:
+    """Reassemble the full state tree from one shard per rank (the inverse of
+    `shard_for_rank` at the world size == len(shards_by_rank) that cut them).
+
+    Replicated leaves are taken from the lowest rank; sharded leaves are
+    concatenated along their partition axis in rank order.
+    """
+    if not shards_by_rank:
+        raise ValueError("gather_tree needs at least one shard")
+    ranks = sorted(shards_by_rank)
+    flat = {rk: tree_paths(shards_by_rank[rk], sep) for rk in ranks}
+    template = flat[ranks[0]]
+    specs = match_partition_rules(rules, shards_by_rank[ranks[0]], sep)
+    out: Dict[str, Any] = {}
+    for path, leaf in template.items():
+        axis = _shard_axis(specs[path])
+        if axis is None:
+            out[path] = leaf
+            continue
+        out[path] = np.concatenate(
+            [np.asarray(flat[rk][path]) for rk in ranks], axis=axis
+        )
+    return tree_unflatten(out, sep)
+
+
+def reshard(
+    tree: Any,
+    rules: Sequence[Tuple[str, PartitionSpec]],
+    new_world_size: int,
+    new_rank: int,
+    sep: str = "/",
+) -> Any:
+    """One-step repartition of a full tree onto a resized gang: what a
+    surviving/new rank calls on the recovered checkpoint at resume."""
+    return shard_for_rank(tree, rules, new_world_size, new_rank, sep)
+
+
+def device_put_tree(tree: Any, rules, mesh=None, sep: str = "/") -> Any:
+    """Best effort: place a host tree onto jax devices with NamedSharding
+    derived from the rules. Falls back to the host tree when jax (or a mesh)
+    is unavailable, so callers can use it unconditionally."""
+    if mesh is None:
+        return tree
+    try:
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as JaxSpec
+    except Exception:  # noqa: BLE001 — jax not importable on this host
+        return tree
+    specs = match_partition_rules(rules, tree, sep)
+    leaves = tree_paths(tree, sep)
+    out = {}
+    for path, leaf in leaves.items():
+        try:
+            sharding = NamedSharding(mesh, JaxSpec(*specs[path]))
+            out[path] = jax.device_put(np.asarray(leaf), sharding)
+        except Exception:  # noqa: BLE001 — axis not in mesh, CPU-only host
+            out[path] = leaf
+    return tree_unflatten(out, sep)
+
+
+def resume_state(ckpt_dict: Dict[str, Any]) -> Tuple[int, Any, Any]:
+    """Unpack a recovery checkpoint assembled by the elastic controller:
+    returns (step, full state tree, rules). Raises KeyError on a checkpoint
+    that is not elastic-shaped, so callers can fall back to their own format.
+    """
+    return ckpt_dict["elastic_step"], ckpt_dict["state"], ckpt_dict["rules"]

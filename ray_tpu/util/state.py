@@ -149,9 +149,12 @@ def training_report(gang: Optional[str] = None) -> Dict[str, Any]:
     published by each fit()'s driver under the `train::<gang_id>` KV keys.
 
     Per gang: wall_s, buckets (productive|init|compile|rendezvous_wait|
-    checkpoint|recover|idle — they partition wall time, coverage ~1.0),
-    goodput_frac, steps, failures, the current skew and the named straggler
-    ({rank, phase, skew_s}), and the last round's per-rank phase split.
+    checkpoint|recover|resize|idle — they partition wall time, coverage
+    ~1.0), goodput_frac, steps, failures, elastic membership history
+    (resizes, last_resize {old_world, new_world, direction, reason,
+    resize_s, ckpt_source}, proactive_checkpoints), the current skew and
+    the named straggler ({rank, phase, skew_s}), and the last round's
+    per-rank phase split.
 
     Returns ``{"gangs": {gang_id: report}}`` (one entry when `gang` given;
     empty when `enable_metrics` is off — nothing is published then)."""

@@ -1,0 +1,441 @@
+"""Elastic gang training tests (ISSUE 19): resize-in-place SPMD with
+checkpoint resharding, in-memory checkpoint replication, and the preemption
+chaos lab.
+
+The trainer state is deliberately tiny and fully deterministic: ``w`` starts
+as ``arange(24).reshape(6, 4)`` and every step adds 1.0 to every element, so
+after N steps ``w.sum() == 276 + 24 * N`` exactly (float64, no rounding).
+A resize is bit-exact iff the final loss equals that closed form — any
+dropped, replayed, or mis-resharded step shows up as an exact-integer miss.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import failpoints
+from ray_tpu.air import Checkpoint, FailureConfig, RunConfig, ScalingConfig, session
+from ray_tpu.train import DataParallelTrainer
+from ray_tpu.train._internal import backend_executor
+from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
+from ray_tpu.train.jax import resharding
+from ray_tpu.util import state
+from ray_tpu.util.preemption import (
+    PreemptionEvent,
+    PreemptionSchedule,
+    PreemptionSimulator,
+)
+
+RULES = [("w", ("data", None)), (".*", ())]
+
+
+def _expected_loss(steps: int) -> float:
+    # sum(arange(24)) + 24 * steps — exact in float64 at these magnitudes.
+    return 276.0 + 24.0 * steps
+
+
+def _make_train_fn(steps: int, sleep_s: float = 0.02):
+    """Elastic SPMD loop: each rank stashes its shard every step; resume
+    reassembles the full tree from `elastic_step`/`state` (resharding.py)."""
+
+    def train_fn(config):
+        rank = session.get_world_rank()
+        world = session.get_world_size()
+        full = {"w": np.arange(24.0).reshape(6, 4), "step": np.float64(0)}
+        start = 0
+        ck = session.get_checkpoint()
+        if ck is not None:
+            d = ck.to_dict()
+            start, st, _ = resharding.resume_state(d)
+            full = {"w": np.asarray(st["w"]), "step": np.float64(start)}
+        for s in range(start, steps):
+            time.sleep(sleep_s)
+            full["w"] = full["w"] + 1.0
+            full["step"] = np.float64(s + 1)
+            session.stash_checkpoint(
+                resharding.shard_for_rank(full, RULES, world, rank),
+                rules=RULES,
+                step=s + 1,
+            )
+            session.report({"step": s + 1, "loss": float(full["w"].sum())})
+
+    return train_fn
+
+
+def _gang_report():
+    gangs = state.training_report()["gangs"]
+    assert len(gangs) >= 1
+    # Newest gang: highest insertion order == last value.
+    return list(gangs.values())[-1]
+
+
+def _resize_events():
+    return [
+        e for e in state.list_cluster_events() if e["kind"] == "train_gang_resize"
+    ]
+
+
+@pytest.fixture
+def ray_8cpu():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+# =========================================================================
+# Resharding unit tests (no cluster).
+# =========================================================================
+
+
+def test_match_partition_rules():
+    tree = {
+        "layer": {"kernel": np.zeros((8, 4)), "bias": np.zeros(4)},
+        "count": np.float64(3),
+    }
+    rules = [("kernel", ("data", None)), (".*", ())]
+    specs = resharding.match_partition_rules(rules, tree)
+    assert specs["layer/kernel"] == ("data", None)
+    assert specs["layer/bias"] == ()  # caught by the catch-all
+    assert specs["count"] == ()  # scalars always replicated
+
+    with pytest.raises(ValueError, match="no partition rule"):
+        resharding.match_partition_rules([("kernel", ("data", None))], tree)
+
+
+def test_shard_bounds_match_array_split():
+    for dim in (6, 7, 12, 13):
+        for world in (1, 2, 3, 4, 5):
+            splits = np.array_split(np.arange(dim), world)
+            for rank in range(world):
+                start, stop = resharding.shard_bounds(dim, world, rank)
+                assert np.array_equal(np.arange(dim)[start:stop], splits[rank])
+
+
+def test_shard_gather_roundtrip_even_and_uneven():
+    tree = {"w": np.arange(28.0).reshape(7, 4), "step": np.float64(9)}
+    for world in (2, 3, 4):  # 7 rows: world 3 -> 3/2/2, world 4 -> 2/2/2/1
+        shards = {
+            r: resharding.shard_for_rank(tree, RULES, world, r)
+            for r in range(world)
+        }
+        rebuilt = resharding.gather_tree(shards, RULES)
+        assert np.array_equal(rebuilt["w"], tree["w"])
+        assert rebuilt["step"] == tree["step"]
+
+
+def test_reshard_across_world_sizes():
+    tree = {"w": np.arange(24.0).reshape(6, 4), "step": np.float64(1)}
+    shards4 = {
+        r: resharding.shard_for_rank(tree, RULES, 4, r) for r in range(4)
+    }
+    # Recover the full tree from the 4-way shards, repartition it 3 ways —
+    # the exact resume path a survivor takes after a 4 -> 3 resize.
+    rebuilt = resharding.gather_tree(shards4, RULES)
+    for r in range(3):
+        direct = resharding.shard_for_rank(tree, RULES, 3, r)
+        mine = resharding.reshard(rebuilt, RULES, 3, r)
+        assert np.array_equal(mine["w"], direct["w"])
+        assert mine["step"] == direct["step"]
+
+
+# =========================================================================
+# Satellite 1: crash-safe checkpoint persist (temp + atomic rename).
+# =========================================================================
+
+
+def test_atomic_persist_survives_midwrite_crash(tmp_path):
+    run_dir = str(tmp_path / "run")
+    mgr = CheckpointManager(run_dir)
+    mgr.register(Checkpoint.from_dict({"step": 1}), {"loss": 1.0})
+    try:
+        # Inject between to_directory() and the atomic rename: the classic
+        # torn-persist window.
+        failpoints.arm("ckpt.persist", "error", trigger="once")
+        with pytest.raises(failpoints.FailpointInjected):
+            mgr.register(Checkpoint.from_dict({"step": 2}), {"loss": 2.0})
+    finally:
+        failpoints.reset()
+    # The torn attempt left only a .tmp sibling; the published view is intact.
+    entries = sorted(os.listdir(run_dir))
+    assert "checkpoint_000002.tmp" in entries
+    assert "checkpoint_000002" not in entries
+
+    fresh = CheckpointManager(run_dir)
+    fresh.restore_from_disk()
+    assert fresh.latest_checkpoint.to_dict()["step"] == 1
+    # restore_from_disk swept the torn entry.
+    assert not any(e.endswith(".tmp") and e.startswith("checkpoint_")
+                   for e in os.listdir(run_dir))
+
+
+# =========================================================================
+# Chaos-lab schedule determinism (no cluster).
+# =========================================================================
+
+
+def test_seeded_schedule_is_deterministic():
+    a = PreemptionSchedule.seeded(7, n_events=4, world_size=4)
+    b = PreemptionSchedule.seeded(7, n_events=4, world_size=4)
+    assert a.events == b.events
+    assert all(5 <= e.at_round < 40 and 0 <= e.rank < 4 for e in a.events)
+    assert [  # round-sorted so the simulator can pop front-to-back
+        (e.at_round, e.rank) for e in a.events
+    ] == sorted((e.at_round, e.rank) for e in a.events)
+    c = PreemptionSchedule.seeded(8, n_events=4, world_size=4)
+    assert a.events != c.events
+
+    with pytest.raises(ValueError, match="mode must be one of"):
+        PreemptionEvent(at_round=1, rank=0, mode="meteor")
+
+
+# =========================================================================
+# Tentpole: resize-in-place with bit-exact continuity.
+# =========================================================================
+
+
+def test_elastic_shrink_bit_exact(ray_8cpu):
+    """A 4-rank gang survives a seeded mid-run SIGKILL, re-forms at world 3,
+    and finishes with the exact reference loss — with max_failures=0, proving
+    resizes never consume the failure budget."""
+    steps = 30
+    sim = PreemptionSimulator(
+        PreemptionSchedule([PreemptionEvent(at_round=5, rank=1, mode="kill")])
+    ).install()
+    try:
+        trainer = DataParallelTrainer(
+            _make_train_fn(steps),
+            scaling_config=ScalingConfig(num_workers=4, elastic=True),
+            run_config=RunConfig(
+                failure_config=FailureConfig(max_failures=0)
+            ),
+        )
+        result = trainer.fit()
+    finally:
+        sim.uninstall()
+    assert result.error is None
+    assert result.metrics["step"] == steps
+    assert result.metrics["loss"] == _expected_loss(steps)  # bit-exact
+    assert [f["mode"] for f in sim.fired] == ["kill"]
+
+    report = _gang_report()
+    assert report["world_size"] == 3
+    assert report["resizes"] == 1
+    assert report["failures"] == 0  # NOT budgeted
+    assert report["last_resize"]["direction"] == "shrink"
+    assert report["buckets"]["resize"] > 0.0
+
+    events = _resize_events()
+    assert len(events) == 1
+    data = events[0]["data"]
+    assert (data["old_world"], data["new_world"]) == (4, 3)
+    # No disk checkpoint existed, so recovery came from the in-memory mirror.
+    assert data["ckpt_source"] == "memory"
+    assert data["step"] >= 1
+
+
+def test_elastic_grow_when_capacity_returns():
+    """After a shrink frees its slot, the gang re-expands to the target once
+    `elastic_grow_after_s` has elapsed — and the grown run is still exact."""
+    ray_tpu.init(num_cpus=8, _system_config={"elastic_grow_after_s": 0.25})
+    steps = 50
+    sim = PreemptionSimulator(
+        PreemptionSchedule([PreemptionEvent(at_round=3, rank=2, mode="kill")])
+    ).install()
+    try:
+        trainer = DataParallelTrainer(
+            _make_train_fn(steps),
+            scaling_config=ScalingConfig(num_workers=4, elastic=True),
+            run_config=RunConfig(failure_config=FailureConfig(max_failures=0)),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        assert result.metrics["loss"] == _expected_loss(steps)
+
+        directions = [e["data"]["direction"] for e in _resize_events()]
+        assert directions[:2] == ["shrink", "grow"]
+        report = _gang_report()
+        assert report["world_size"] == 4  # back at target
+        assert report["resizes"] >= 2
+        assert report["failures"] == 0
+    finally:
+        sim.uninstall()
+        ray_tpu.shutdown()
+
+
+def test_preemption_notice_grace_flushes_then_resizes(ray_8cpu):
+    """The SIGTERM-with-grace contract: the noticed rank flushes its stash to
+    its mirror peer and exits inside the grace window; the gang then re-forms
+    from memory with no lost steps."""
+    steps = 40
+    sim = PreemptionSimulator(
+        PreemptionSchedule(
+            [PreemptionEvent(at_round=5, rank=1, mode="notice", grace_s=0.3)]
+        )
+    ).install()
+    try:
+        trainer = DataParallelTrainer(
+            _make_train_fn(steps, sleep_s=0.03),
+            scaling_config=ScalingConfig(num_workers=4, elastic=True),
+            run_config=RunConfig(failure_config=FailureConfig(max_failures=0)),
+        )
+        result = trainer.fit()
+    finally:
+        sim.uninstall()
+    assert result.error is None
+    assert result.metrics["loss"] == _expected_loss(steps)
+
+    notices = [
+        e for e in state.list_cluster_events()
+        if e["kind"] == "train_preempt_notice"
+    ]
+    assert len(notices) == 1
+    assert notices[0]["data"]["flushed"] is True
+    assert notices[0]["data"]["stash_step"] >= 1
+
+    events = _resize_events()
+    assert len(events) >= 1
+    assert events[0]["data"]["ckpt_source"] == "memory"
+    assert _gang_report()["failures"] == 0
+
+
+def test_rank0_death_recovers_from_peer_mirror(ray_8cpu):
+    """Killing rank 0 — the rank whose checkpoints would normally persist —
+    must still recover: its shard survives on the ring peer's mirror."""
+    steps = 30
+    sim = PreemptionSimulator(
+        PreemptionSchedule([PreemptionEvent(at_round=6, rank=0, mode="kill")])
+    ).install()
+    try:
+        trainer = DataParallelTrainer(
+            _make_train_fn(steps),
+            scaling_config=ScalingConfig(num_workers=4, elastic=True),
+            run_config=RunConfig(failure_config=FailureConfig(max_failures=0)),
+        )
+        result = trainer.fit()
+    finally:
+        sim.uninstall()
+    assert result.error is None
+    assert result.metrics["loss"] == _expected_loss(steps)
+    events = _resize_events()
+    assert len(events) == 1
+    assert events[0]["data"]["ckpt_source"] == "memory"
+    assert events[0]["data"]["step"] >= 1
+    assert _gang_report()["failures"] == 0
+
+
+def test_chaos_runs_are_deterministic(ray_8cpu):
+    """Same seed, same schedule, same fired sequence and resize shape across
+    two independent runs (the chaos-lab reproducibility contract)."""
+    steps = 16
+
+    def run(seed):
+        sched = PreemptionSchedule.seeded(
+            seed, n_events=1, min_round=4, max_round=8, world_size=4,
+            notice_frac=0.0,
+        )
+        sim = PreemptionSimulator(sched).install()
+        try:
+            trainer = DataParallelTrainer(
+                _make_train_fn(steps),
+                scaling_config=ScalingConfig(num_workers=4, elastic=True),
+                run_config=RunConfig(
+                    failure_config=FailureConfig(max_failures=0)
+                ),
+            )
+            result = trainer.fit()
+        finally:
+            sim.uninstall()
+        assert result.error is None
+        assert result.metrics["loss"] == _expected_loss(steps)
+        fired = [(f["at_round"], f["rank"], f["mode"]) for f in sim.fired]
+        resize = [
+            (e["data"]["old_world"], e["data"]["new_world"])
+            for e in _resize_events()
+        ]
+        return fired, resize
+
+    fired_a, _ = run(21)
+    fired_b, resizes = run(21)
+    assert fired_a == fired_b
+    # Both runs shrank 4 -> 3 (events accumulate across runs in one cluster).
+    assert resizes == [(4, 3), (4, 3)]
+
+
+def test_below_min_workers_falls_back_to_failure_budget(ray_8cpu):
+    """A loss that leaves the gang below min_workers is NOT resizable: it
+    consumes the FailureConfig budget like any other gang failure, and the
+    budgeted whole-gang restart still completes the run."""
+    steps = 20
+    sim = PreemptionSimulator(
+        PreemptionSchedule([PreemptionEvent(at_round=4, rank=1, mode="kill")])
+    ).install()
+    try:
+        trainer = DataParallelTrainer(
+            _make_train_fn(steps),
+            scaling_config=ScalingConfig(
+                num_workers=2, elastic=True, min_workers=2
+            ),
+            run_config=RunConfig(failure_config=FailureConfig(max_failures=1)),
+        )
+        result = trainer.fit()
+    finally:
+        sim.uninstall()
+    assert result.error is None
+    assert result.metrics["loss"] == _expected_loss(steps)
+    report = _gang_report()
+    assert report["failures"] == 1  # budgeted, unlike a resize
+    assert report["resizes"] == 0
+
+
+def test_scaling_config_validates_elastic_fields():
+    with pytest.raises(ValueError):
+        ScalingConfig(num_workers=2, elastic=True, min_workers=3)
+    with pytest.raises(ValueError):
+        ScalingConfig(num_workers=2, elastic=True, min_workers=0)
+    cfg = ScalingConfig(num_workers=4, elastic=True, min_workers=2)
+    assert cfg.elastic and cfg.min_workers == 2
+
+
+# =========================================================================
+# Satellite 2: SUSPECT verdict triggers a proactive in-memory checkpoint.
+# =========================================================================
+
+
+def test_suspect_worker_triggers_proactive_checkpoint():
+    """A gang rank whose heartbeats go silent (SUSPECT, observational) gets
+    its stash pulled driver-side before anything actually dies."""
+    ray_tpu.init(num_cpus=8, _system_config={"health_check_period_ms": 200})
+    armed = {"done": False}
+
+    # Nested so it serializes by value (this test module is not importable
+    # from the worker process).
+    def drop_heartbeats():
+        from ray_tpu._private import failpoints as fp
+
+        fp.arm("worker.heartbeat", "drop", trigger="always")
+
+    def arm_silence(executor, round_idx):
+        # One rank goes heartbeat-silent from round 2 on; its process stays
+        # alive, so the run completes without any resize.
+        if round_idx >= 2 and not armed["done"]:
+            armed["done"] = True
+            executor.worker_group.workers[1].execute.remote(drop_heartbeats)
+
+    backend_executor.register_round_hook(arm_silence)
+    try:
+        trainer = DataParallelTrainer(
+            _make_train_fn(60, sleep_s=0.05),
+            scaling_config=ScalingConfig(num_workers=2, elastic=True),
+            run_config=RunConfig(failure_config=FailureConfig(max_failures=0)),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        report = _gang_report()
+        assert report["proactive_checkpoints"] >= 1
+        assert report["failures"] == 0
+    finally:
+        backend_executor.unregister_round_hook(arm_silence)
+        ray_tpu.shutdown()

@@ -266,6 +266,60 @@ def test_chaos_matrix(env_spec, arm, workload, det_trace):
         assert t1 == t2, f"injection trace diverged: {t1} vs {t2}"
 
 
+def test_deep_pipeline_crash_schedule_exactly_once_completions():
+    """Crash schedule over a DEEP pipeline (worker_pipeline_depth=8): one
+    injected crash now kills up to a whole 8-deep in-flight window, including
+    completed-but-unflushed batched dones — the amplification the matrix
+    deliberately avoids with depth=1. The done/retry machinery must re-run
+    exactly the lost attempts: every submitted task resolves once with the
+    right value (no lost completions), and no ref resolves from a stale
+    duplicate done (a double-counted completion would route some other
+    attempt's result into the wrong request). Deterministic across runs."""
+
+    def run():
+        failpoints.reset()
+        os.environ["RAY_TPU_FAILPOINTS"] = (
+            "worker.crash_before_result_stored=crash@nth:6"
+        )
+        try:
+            ray_tpu.init(
+                num_cpus=2,
+                _system_config={**SYS_CFG, "worker_pipeline_depth": 8},
+            )
+
+            @ray_tpu.remote(max_retries=16)
+            def sq(i):
+                time.sleep(0.005)
+                return i * i
+
+            refs = [sq.remote(i) for i in range(24)]
+            # Drain via wait so each ref must become ready exactly once; a
+            # lost completion hangs (timeout), a duplicate would surface as
+            # a re-ready ref in a later wait round.
+            seen = []
+            pending = list(refs)
+            deadline = time.time() + 120
+            while pending and time.time() < deadline:
+                ready, pending = ray_tpu.wait(
+                    pending, num_returns=1, timeout=5.0
+                )
+                seen.extend(ready)
+            assert not pending, "lost completion: task(s) never resolved"
+            assert len(seen) == len(set(seen)) == 24
+            return [ray_tpu.get(r, timeout=30) for r in refs]
+        finally:
+            try:
+                ray_tpu.shutdown()
+            finally:
+                failpoints.reset()
+                os.environ.pop("RAY_TPU_FAILPOINTS", None)
+
+    out1 = run()
+    out2 = run()
+    assert out1 == [i * i for i in range(24)]  # each value routed correctly
+    assert out1 == out2
+
+
 # ------------------------------------------------- exception taxonomy
 def _taxonomy_worker_crash():
     @ray_tpu.remote(max_retries=0)

@@ -61,6 +61,10 @@ echo "== train smoke (4-worker gang, seeded straggler named + alert fire->resolv
 timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/train_smoke.py
 
 echo
+echo "== elastic smoke (4-worker gang, seeded kill -> resize-in-place at world 3, bit-exact resume) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/elastic_smoke.py
+
+echo
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
