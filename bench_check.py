@@ -69,6 +69,10 @@ REQUIRED_METRICS = (
     # per-step observability costs <= 5% of a mini gang's steps/s (ISSUE 17
     # acceptance: the hard floor below enforces it).
     "train_step_obs_ratio",
+    # Per-job accounting ledger (dispatch/terminal hooks + resident-bytes
+    # sampler, riding the enable_obs knob) vs obs off: attribution must cost
+    # <= 5% task throughput (ISSUE 20 acceptance: hard floor below).
+    "task_throughput_jobs_ratio",
 )
 
 # Data-plane suite (bench_dataplane.py -> BENCH_DATAPLANE.json): the
@@ -132,6 +136,9 @@ HARD_FLOORS = {
     # Training-gang observability (step clock, skew fold, goodput ledger)
     # costs <= 5% step throughput (ISSUE 17 acceptance criterion).
     "train_step_obs_ratio": 0.95,
+    # Per-job accounting (JobLedger on the scheduler seams) costs <= 5%
+    # task throughput vs enable_obs=False (ISSUE 20 acceptance criterion).
+    "task_throughput_jobs_ratio": 0.95,
     # Shed-not-collapse: at 2x offered load, goodput must hold >= 80% of
     # single-proxy capacity (admission control converts overload into fast
     # 503s, never latency collapse).

@@ -457,6 +457,29 @@ def main():
         }
     )
 
+    # ------------------------------------------------- job-ledger overhead
+    # Per-job accounting (jobs.py JobLedger: per-dispatch/terminal hooks on
+    # the scheduler seams + the resident-bytes sampler on the obs tick)
+    # rides the enable_obs knob, so default-vs-obs-off prices the ledger
+    # together with the over-time layer it is part of. The contract is the
+    # same: dict bookkeeping only on seams the scheduler already crosses,
+    # nothing on the per-task wire path — ratio ~1.0, REQUIRED in
+    # bench_check with a 0.95 hard floor. Fresh interpreters + best-of-3
+    # alternating pairs, same protocol as the obs probe above.
+    jobs_on = jobs_off = 0.0
+    for _ in range(3):
+        jobs_on = max(jobs_on, obs_throughput({}))
+        jobs_off = max(jobs_off, obs_throughput({"enable_obs": False}))
+    results.append(
+        {
+            "metric": "task_throughput_jobs_ratio",
+            "value": round(jobs_on / jobs_off, 3),
+            "unit": "ratio",
+            "jobs_on_ops_s": round(jobs_on, 1),
+            "jobs_off_ops_s": round(jobs_off, 1),
+        }
+    )
+
     # ------------------------------------------------- tracing overhead
     # Always-on tracing (RAY_TPU_TRACING=1 at the DEFAULT trace_sample_rate:
     # every root span pays one seeded RNG draw, sampled traces pay span

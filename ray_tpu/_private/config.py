@@ -335,6 +335,16 @@ class Config:
     # analogue; rules see samples ingested from the per-process KV flushes).
     alert_eval_interval_s: float = 1.0
 
+    # --- per-job accounting (_private/jobs.py, sub-layer of enable_obs:
+    # the ledger exists exactly when sched.obs does) ---
+    # Queue-wait p95 above which a job counts as starved. Drives the
+    # `job_starved` alert rule on ray_tpu_job_queue_wait_seconds via
+    # threshold_config_frac (same pattern as train_straggler_skew_s).
+    job_starved_wait_s: float = 2.0
+    # Bounded ring of finalized job ledgers (dead drivers); persisted in the
+    # GCS snapshot so `state.list_jobs()` history survives a head restart.
+    finished_jobs_cap: int = 256
+
     # --- collective ---
     # Rendezvous wait ceiling for collective group formation (KV-based
     # barrier in util/collective/rendezvous.py).

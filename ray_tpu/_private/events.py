@@ -34,6 +34,8 @@ EVENT_KINDS = (
     "alert_resolved",
     "autoscaler_scale_down",
     "autoscaler_scale_up",
+    "job_finished",
+    "job_started",
     "node_added",
     "node_dead",
     "node_removed",

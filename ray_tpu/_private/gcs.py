@@ -121,6 +121,11 @@ class GCS:
         self._trace_span_cap = 20000
         self.trace_spans: "deque[dict]" = deque(maxlen=self._trace_span_cap)
         self.trace_spans_total = 0
+        # Finalized job ledgers (jobs.py): a dead driver's accounting seals
+        # into this bounded ring instead of vanishing with the connection.
+        # Rides the snapshot — "what did tenant X cost" survives a restart.
+        self._finished_job_cap = 256
+        self.finished_jobs: "deque[dict]" = deque(maxlen=self._finished_job_cap)
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
 
     # --- internal KV (reference: GcsKvManager / experimental.internal_kv) ---
@@ -156,6 +161,20 @@ class GCS:
                 cb(message)
             except Exception:
                 pass
+
+    # --- finished jobs (jobs.py ledger finalization) ---
+    def set_finished_job_cap(self, cap: int) -> None:
+        """Resize the ring to `finished_jobs_cap` (config)."""
+        cap = max(1, int(cap))
+        if cap != self._finished_job_cap:
+            self._finished_job_cap = cap
+            self.finished_jobs = deque(self.finished_jobs, maxlen=cap)
+
+    def append_finished_job(self, summary: dict) -> None:
+        self.finished_jobs.append(summary)
+
+    def finished_job_list(self) -> List[dict]:
+        return [dict(s) for s in self.finished_jobs]
 
     # --- task events ---
     def set_task_event_cap(self, cap: int) -> None:
@@ -288,6 +307,9 @@ class GCS:
             # crash need the transitions that led up to it, not a fresh ring.
             "cluster_events": list(self.cluster_events),
             "cluster_events_total": self.cluster_events_total,
+            # Sealed tenant ledgers: accounting history is as durable as the
+            # event history it explains.
+            "finished_jobs": list(self.finished_jobs),
         })
 
     def restore_bytes(self, blob: bytes) -> None:
@@ -301,6 +323,8 @@ class GCS:
         for ev in payload.get("cluster_events", ()):
             self.cluster_events.append(ev)
         self.cluster_events_total += int(payload.get("cluster_events_total", 0))
+        for s in payload.get("finished_jobs", ()):
+            self.finished_jobs.append(s)
 
     def save_to(self, path: str) -> None:
         import os

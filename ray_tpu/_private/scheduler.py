@@ -40,6 +40,7 @@ from ray_tpu._private.config import Config
 from ray_tpu._private.gcs import GCS, ActorInfo
 from ray_tpu._private.ids import (
     ActorID,
+    JobID,
     NodeID,
     ObjectID,
     PlacementGroupID,
@@ -160,6 +161,9 @@ class DriverHandle(_ConnSender):
         # OS pid from the attach info (None for legacy drivers): death-time
         # pruning of this process's metrics::/spans:: KV snapshots + series.
         self.pid = None
+        # Job id minted for this driver at attach (hex; None until then).
+        # Everything the driver creates embeds it via the id scheme.
+        self.job_id: Optional[str] = None
 
 
 @dataclass
@@ -621,10 +625,32 @@ class Scheduler:
         # handler already sees. None when metrics are off — the knob-off
         # contract is that NOTHING observability-shaped exists.
         self.obs = None
+        # Per-job accounting (jobs.py): tenant ledger keyed by the job id
+        # embedded in every ActorID/TaskID/ObjectID. Exists exactly when the
+        # obs layer does — same knob-off contract. Identity MINTING is
+        # unconditional (ids are structural); only the metering is gated.
+        self.jobs = None
+        # Next job id to mint; job 1 is the in-process driver (the id every
+        # worker and legacy client also defaults to).
+        self._job_counter = 1
         if config.enable_metrics and config.enable_obs:
             from ray_tpu._private.timeseries import ObsState
+            from ray_tpu._private.jobs import JobLedger
 
             self.obs = ObsState(config, gcs)
+            self.jobs = JobLedger(config, gcs)
+            gcs.set_finished_job_cap(config.finished_jobs_cap)
+            # Serve request attribution rides the snapshot parse ingest_kv
+            # already pays for.
+            self.obs.snapshot_hook = self.jobs.ingest_snapshot
+            self.jobs.register_job(
+                JobID.from_int(1).hex(), self._INPROC_DRIVER, "inproc"
+            )
+            self._emit_event(
+                "job_started",
+                f"job {JobID.from_int(1).hex()} started (in-process driver)",
+                job=JobID.from_int(1).hex(), source_kind="inproc",
+            )
         self.nodes: Dict[NodeID, NodeState] = {}
         self.node_order: List[NodeID] = []
         self.object_table: Dict[bytes, ObjectMeta] = {}
@@ -956,6 +982,21 @@ class Scheduler:
         self._holder_to_driver[dh.holder_id] = dh
         if dh.pull_node_id:
             self._pull_sources[dh.pull_node_id] = dh
+        # Trusted mint: each attaching driver gets the next job id; every
+        # TaskID/ActorID/ObjectID it creates embeds it (ids.py), so all of
+        # its usage is attributable with no per-message tags. Minting is
+        # identity, not observability — it happens even when the ledger is
+        # off (the id must be stable if obs is flipped on later via restart).
+        self._job_counter += 1
+        job = JobID.from_int(self._job_counter)
+        dh.job_id = job.hex()
+        if self.jobs is not None:
+            self.jobs.register_job(dh.job_id, dh.holder_id, "client")
+        self._emit_event(
+            "job_started",
+            f"job {dh.job_id} started (client driver {dh.holder_id})",
+            job=dh.job_id, driver=dh.holder_id, source_kind="client",
+        )
         head = self.nodes.get(self.node_order[0]) if self.node_order else None
         dh.send(
             (
@@ -965,6 +1006,7 @@ class Scheduler:
                     "shm_dir": head.shm_dir if head else os.path.join(self.session_dir, "shm"),
                     "head_node_id": head.node_id.hex() if head else "",
                     "config": self.config,
+                    "job_id": dh.job_id,
                 },
             )
         )
@@ -1010,6 +1052,27 @@ class Scheduler:
         self._fail_tasks_of_dead_owner(dh.holder_id)
         # Owned actors die with their creator; detached actors survive.
         self._kill_actors_owned_by(dh.holder_id)
+        # Seal the tenant ledger AFTER the dead-owner sweeps above: they
+        # close each task/actor accrual through the normal terminal hooks,
+        # and finalize_job closes whatever those left open (e.g. a RUNNING
+        # task allowed to finish) before the summary enters the ring.
+        if self.jobs is not None:
+            if dh.job_id is not None:
+                summary = self.jobs.finalize_job(
+                    dh.job_id, time.time(), "driver disconnected"
+                )
+                if summary is not None:
+                    t = summary["totals"]
+                    self._emit_event(
+                        "job_finished",
+                        f"job {dh.job_id} finished: "
+                        f"{t['tasks']['finished']} tasks ok, "
+                        f"{t['tasks']['failed']} failed, "
+                        f"{t['cpu_seconds']:.1f} cpu-s",
+                        job=dh.job_id, driver=dh.holder_id,
+                        reason="driver disconnected",
+                        totals=t,
+                    )
         try:
             dh.conn.close()
         except OSError:
@@ -1305,6 +1368,10 @@ class Scheduler:
             # alert_eval_interval_s; absent entirely when metrics are off.
             if self.obs is not None:
                 self.obs.on_iteration(self, now)
+            # Tenant ledger sample + metric flush: same self-gated cadence,
+            # same absence contract (self.jobs is None exactly when obs is).
+            if self.jobs is not None:
+                self.jobs.on_iteration(self, now)
             if self._delayed_retries:
                 due = [x for x in self._delayed_retries if x[0] <= now]
                 if due:
@@ -1397,6 +1464,11 @@ class Scheduler:
                     with self._wake_lock:
                         self._blocking_pending -= 1
                 if method == "_stop":
+                    if self.jobs is not None:
+                        # Orderly shutdown: every still-live job (including
+                        # the in-process driver's) seals into the ring so a
+                        # --persist restart can still answer for it.
+                        self.jobs.finalize_all(time.time())
                     self._shutdown_workers()
                     fut.set_result(None)
                     self._stopped.set()
@@ -1834,6 +1906,10 @@ class Scheduler:
             rec.worker = None
             self._record_event(rec.spec, "RETRY")
             self.telemetry.retried += 1
+            if self.jobs is not None:
+                # The dead attempt's partial lease accrues; the retry waits
+                # in queue again from now.
+                self.jobs.task_requeued(rec.spec.task_id, time.time())
             # A fresh attempt gets a fresh stage pipeline (the dead attempt's
             # lease/worker stamps would otherwise leak into the retry's).
             rec.stage_ts = {"queued": time.time()}
@@ -2411,6 +2487,8 @@ class Scheduler:
         self.gcs.kv_del(f"spans::{pid}".encode())
         if self.obs is not None:
             self.obs.prune_process(str(pid))
+        if self.jobs is not None:
+            self.jobs.prune_process(str(pid))
 
     def _emit_event(self, kind: str, message: str, severity: str = "info",
                     **data) -> None:
@@ -2487,6 +2565,12 @@ class Scheduler:
             tel.finished += 1
         else:
             tel.failed += 1
+        if self.jobs is not None:
+            # Before resource release/transfer below: the ledger reads the
+            # lease interval it opened at dispatch, not rec.acquired.
+            self.jobs.task_terminal(
+                task_id, "finished" if ok else "failed", time.time()
+            )
         if tel.enabled and stages:
             t0, t1 = stages.get("exec_start"), stages.get("exec_end")
             if t0 is not None and t1 is not None:
@@ -2528,6 +2612,13 @@ class Scheduler:
                 successor.acquired_pg = rec.acquired_pg
                 rec.acquired = {}
                 rec.acquired_pg = None
+                if self.jobs is not None:
+                    # The successor's (cpus=0) open lease now carries the
+                    # transferred resources — its job pays from here on.
+                    self.jobs.task_lease_transferred(
+                        successor.spec.task_id,
+                        successor.acquired.get("CPU", 0.0), time.time(),
+                    )
                 wh.current_task = successor.spec.task_id
                 if wh.state == "blocked":
                     # The blocked head finished; the successor runs unblocked.
@@ -3141,6 +3232,13 @@ class Scheduler:
                 self._seal_object(err_meta(oid))
         rec.state = lifecycle.step("task", rec.state, "FAILED")
         self.telemetry.failed += 1
+        if self.jobs is not None:
+            # Universal error seal — also the satellite hygiene fix: a task
+            # sealed while still PENDING (owner died, cancel) closes its
+            # open queue-wait accrual here instead of leaking it. Idempotent
+            # pop in the ledger: cancel paths that already recorded a
+            # "cancelled" terminal are not double-counted.
+            self.jobs.task_terminal(rec.spec.task_id, "failed", time.time())
         self._release_task_pins(rec)
         self._record_event(rec.spec, "FAILED", rec=rec)
         if rec.spec.returns_mode is not None:
@@ -3347,6 +3445,14 @@ class Scheduler:
             )
             if rec.state == "PENDING":
                 self.pending.remove(rec)
+                if self.jobs is not None:
+                    # Hygiene: the dead driver's still-queued task closes
+                    # its queue-wait accrual NOW, as "cancelled" — the seal
+                    # below would otherwise label it a failure (and nothing
+                    # would close it at all pre-PR; see test_jobs).
+                    self.jobs.task_terminal(
+                        rec.spec.task_id, "cancelled", time.time()
+                    )
                 self._store_error_results(rec, err)
                 rec.state = lifecycle.step("task", rec.state, "CANCELLED")
                 continue
@@ -3359,6 +3465,10 @@ class Scheduler:
             ):
                 wh.inflight_tasks.remove(rec.spec.task_id)
                 self._send_to(wh, ("cancel_queued", rec.spec.task_id.binary()))
+                if self.jobs is not None:
+                    self.jobs.task_terminal(
+                        rec.spec.task_id, "cancelled", time.time()
+                    )
                 self._store_error_results(rec, err)
                 rec.state = lifecycle.step("task", rec.state, "CANCELLED")
 
@@ -3451,6 +3561,20 @@ class Scheduler:
             # it into the time-series store makes history free of extra
             # protocol traffic (the ingestion cadence IS the flush cadence).
             self.obs.ingest_kv(args[0], args[1])
+        if (
+            self.jobs is not None
+            and op == "event"
+            and args
+            and args[0]
+            and args[0][0] == "serve_deploy"
+        ):
+            # The controller's deploy event carries the app -> owning-job
+            # mapping (the deploy ran as the calling driver's actor task, so
+            # the controller knew the job); proxy request counters re-key
+            # through it at snapshot-ingest time.
+            data = args[0][4] or {}
+            if data.get("app") and data.get("job"):
+                self.jobs.register_serve_app(data["app"], data["job"])
         return getattr(self.gcs, "kv_" + op)(*args)
 
     def _cmd_create_pg(self, payload):
@@ -3493,8 +3617,17 @@ class Scheduler:
         rec = self.tasks.get(task_id)
         if rec is None:
             return False
+
+        def note_cancelled():
+            # Label the terminal "cancelled" ahead of the error seal (whose
+            # own hook says "failed"); ledger pop-idempotency gives the
+            # first caller precedence.
+            if self.jobs is not None:
+                self.jobs.task_terminal(task_id, "cancelled", time.time())
+
         if rec.state == "PENDING":
             self.pending.remove(rec)
+            note_cancelled()
             self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
             rec.state = lifecycle.step("task", rec.state, "CANCELLED")
             return True
@@ -3511,6 +3644,7 @@ class Scheduler:
             ):
                 wh.inflight_tasks.remove(task_id)
                 self._send_to(wh, ("cancel_queued", task_id.binary()))
+                note_cancelled()
                 self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
                 rec.state = lifecycle.step("task", rec.state, "CANCELLED")
                 return True
@@ -3523,6 +3657,7 @@ class Scheduler:
                     wh.process.terminate()
                 except Exception:
                     pass
+                note_cancelled()
                 self._release_task_resources(rec)
                 self._store_error_results(rec, TaskCancelledError("Task was cancelled."))
                 rec.state = lifecycle.step("task", rec.state, "CANCELLED")
@@ -3569,6 +3704,7 @@ class Scheduler:
     def _task_summary(rec: TaskRecord) -> dict:
         return {
             "task_id": rec.spec.task_id.hex(),
+            "job_id": rec.spec.task_id.actor_id.job_id.hex(),
             "name": rec.spec.name or rec.spec.func.name,
             "state": rec.state,
             "actor_id": rec.spec.actor_id.hex() if rec.spec.actor_id else None,
@@ -3582,11 +3718,32 @@ class Scheduler:
         }
 
     def _cmd_list_tasks(self, payload):
+        # Payload: None = defaults; int = limit (legacy shape); dict =
+        # {"limit", "job"} (job: hex filter on the embedded job id).
+        job = None
+        if isinstance(payload, dict):
+            job = payload.get("job")
+            payload = payload.get("limit")
         # None = default; 0 is a real limit (the dashboard accepts ?limit=0)
         # and must return nothing, not fall back to 1000.
         limit = 1000 if payload is None else int(payload)
         if limit <= 0:
             return []
+        if job is not None:
+            # Filter BEFORE the tail slice: a limit'd listing of one job
+            # must not be hollowed out by other jobs' newer records.
+            live = [
+                rec for rec in self.tasks.values()
+                if rec.spec.task_id.actor_id.job_id.hex() == job
+            ][-limit:]
+            out = [self._task_summary(rec) for rec in live]
+            if len(out) < limit:
+                need = limit - len(out)
+                out = [
+                    dict(s) for s in list(self._gc_task_summaries)
+                    if s.get("job_id") == job
+                ][-need:] + out
+            return out
         # Live records keep dict insertion (submission) order; only the tail
         # slices materialize. GC'd history (older by construction) fills any
         # remaining budget in front.
@@ -3655,10 +3812,12 @@ class Scheduler:
     # the WHOLE table; only the detailed listing truncates, largest-first).
     _MEMORY_SUMMARY_TOP = 200
 
-    def _cmd_memory_summary(self, _):
+    def _cmd_memory_summary(self, payload=None):
         """`ray memory` analogue over the ownership tables: every object's
         holders/pins/location/size joined with the on-disk store state,
-        grouped by creation site, with leak suspects.
+        grouped by creation site, with leak suspects. Payload: optional
+        {"job": hex} narrows the detailed object listing to one tenant
+        (aggregates stay cluster-wide; `by_job` is the per-tenant rollup).
 
         Two leak classes:
          - table-level: objects whose every holder is a dead process and
@@ -3697,9 +3856,11 @@ class Scheduler:
                     reachable.add(child)
                     stack.append(child)
 
+        job_filter = payload.get("job") if isinstance(payload, dict) else None
         objects = []
         shm_bytes = inline_bytes = spilled_bytes = 0
         by_site: Dict[str, Dict[str, float]] = {}
+        by_job: Dict[str, Dict[str, float]] = {}
         known_segments: set = set()
         known_oids: set = set()
         for key, meta in self.object_table.items():
@@ -3721,9 +3882,16 @@ class Scheduler:
             agg = by_site.setdefault(site, {"count": 0, "bytes": 0})
             agg["count"] += 1
             agg["bytes"] += meta.size
+            job = meta.object_id.task_id.actor_id.job_id.hex()
+            jagg = by_job.setdefault(job, {"count": 0, "bytes": 0})
+            jagg["count"] += 1
+            jagg["bytes"] += meta.size
+            if job_filter is not None and job != job_filter:
+                continue
             objects.append(
                 {
                     "object_id": meta.object_id.hex(),
+                    "job_id": job,
                     "size": meta.size,
                     "in_shm": meta.segment is not None,
                     "spilled": meta.spilled,
@@ -3751,6 +3919,7 @@ class Scheduler:
             "num_objects": len(self.object_table),
             "objects": objects[: self._MEMORY_SUMMARY_TOP],
             "by_site": top_sites,
+            "by_job": by_job,
             "shm_bytes": shm_bytes,
             "inline_bytes": inline_bytes,
             "spilled_bytes": spilled_bytes,
@@ -3765,16 +3934,19 @@ class Scheduler:
             "store_scan": scan,
         }
 
-    def _cmd_list_actors(self, _):
+    def _cmd_list_actors(self, payload=None):
+        job = payload.get("job") if isinstance(payload, dict) else None
         return [
             {
                 "actor_id": a.actor_id.hex(),
+                "job_id": a.actor_id.job_id.hex(),
                 "name": a.name,
                 "class_name": a.class_name,
                 "state": a.state,
                 "num_restarts": a.num_restarts,
             }
             for a in self.gcs.actors.values()
+            if job is None or a.actor_id.job_id.hex() == job
         ]
 
     # ------------------------------------------------------------------ worker requests
@@ -3882,7 +4054,8 @@ class Scheduler:
             "get_nodes", "add_node", "remove_node", "autoscaler_state",
             "memory_summary", "transfer_stats", "serve_directory",
             "serve_actor_inflight", "query_series", "cluster_events",
-            "list_alerts", "obs_stats", "spans_list",
+            "list_alerts", "obs_stats", "spans_list", "list_jobs",
+            "job_report",
         }
     )
 
@@ -3959,7 +4132,13 @@ class Scheduler:
         # lets _purge_replicas delete the cache file on free — skipping it
         # leaks the bytes for the session. _locate_object re-checks
         # data_address before offering the node as a pull source.
+        fresh = node_id not in self.object_replicas.get(object_key, ())
         self.object_replicas.setdefault(object_key, set()).add(node_id)
+        if self.jobs is not None and fresh:
+            # Peer-direct pull completed (the replica registration is its
+            # only head-visible trace): meta.size bytes moved for the
+            # owning job.
+            self.jobs.transfer_bytes(meta.object_id, meta.size or 0)
         return True
 
     def _req_object_replica(self, wh, req_id: Optional[int], payload):
@@ -4059,7 +4238,29 @@ class Scheduler:
             len(s) for s in self.object_replicas.values()
         )
         out["head_transfer"] = dict(object_transfer._STATS)
+        if self.jobs is not None:
+            # Per-tenant attribution of the same traffic (job hex -> bytes).
+            out["per_job_bytes"] = self.jobs.transfer_rollup()
         return out
+
+    def _cmd_list_jobs(self, _):
+        """Tenant ledger readout (state.list_jobs / /api/jobs / CLI). Raises
+        when accounting is off — same contract as _cmd_query_series: a
+        silent empty answer would read as "nobody is using the cluster"."""
+        if self.jobs is None:
+            raise RuntimeError(
+                "job accounting disabled "
+                "(enable_metrics=False or enable_obs=False)"
+            )
+        return self.jobs.list_jobs()
+
+    def _cmd_job_report(self, job):
+        if self.jobs is None:
+            raise RuntimeError(
+                "job accounting disabled "
+                "(enable_metrics=False or enable_obs=False)"
+            )
+        return self.jobs.job_report(str(job))
 
     def _req_pull_object(self, wh, req_id: int, object_key: bytes):
         """A reader is missing a sealed object's segment locally and could not
@@ -4152,6 +4353,8 @@ class Scheduler:
         waiters = self._relay_waiters.pop(key, [])
         if ok:
             self._transfer_stats["relay_bytes"] += len(data) if data else 0
+            if self.jobs is not None and data:
+                self.jobs.transfer_bytes(meta.object_id, len(data))
             for respond in waiters:
                 respond(True, (meta, data))
         else:
@@ -4640,6 +4843,8 @@ class Scheduler:
             self.gcs.function_table.setdefault(rec.spec.func.function_id, rec.func_blob)
         rec.stage_ts["queued"] = time.time()
         self.telemetry.submitted += 1
+        if self.jobs is not None:
+            self.jobs.task_submitted(rec.spec.task_id, rec.stage_ts["queued"])
         self._record_event(rec.spec, "SUBMITTED")
         if rec.spec.actor_id is not None and not rec.spec.is_actor_creation:
             # Actor call path (should come through _submit_actor_task).
@@ -4727,6 +4932,8 @@ class Scheduler:
         self.tasks[spec.task_id] = rec
         rec.stage_ts["queued"] = time.time()
         self.telemetry.submitted += 1
+        if self.jobs is not None:
+            self.jobs.task_submitted(spec.task_id, rec.stage_ts["queued"])
         self._record_event(spec, "SUBMITTED")
         ar = self.actors.get(spec.actor_id)
         if ar is None or ar.state == "DEAD":
@@ -5233,6 +5440,13 @@ class Scheduler:
         OUTCOMES — never _pick_node probes repeated across scheduler ticks
         for a task stuck behind the worker cap."""
         rec.stage_ts["lease_granted"] = now
+        if self.jobs is not None:
+            # Queue-wait closes, CPU lease opens. acquired is {} for
+            # pipelined pushes and actor calls — the lease head / the actor
+            # record carries those resources (and their accounting).
+            self.jobs.task_dispatched(
+                rec.spec.task_id, rec.acquired.get("CPU", 0.0), now
+            )
         node = self.nodes.get(rec.node)
         if node is not None:
             self._note_locality(self._locality_bytes(rec), node)
@@ -5330,6 +5544,12 @@ class Scheduler:
         else:
             _acquire(node.available, rec.spec.resources)
         ar.acquired = dict(rec.spec.resources)
+        if self.jobs is not None:
+            # Actors hold their resources for their whole lifetime: the
+            # lease accrues creation -> _release_actor_resources.
+            self.jobs.actor_lease_opened(
+                ar.actor_id, ar.acquired.get("CPU", 0.0), time.time()
+            )
         node.last_active = time.time()
         env_vars = dict(rec.spec.env_vars)
         # TPU visibility: give the actor its chip share (analogue of
@@ -5402,6 +5622,9 @@ class Scheduler:
         rec.acquired = {}
 
     def _release_actor_resources(self, ar: ActorRecord):
+        if self.jobs is not None:
+            # Idempotent (pop): restarts re-open at the next creation.
+            self.jobs.actor_lease_closed(ar.actor_id, time.time())
         if ar.acquired_pg is not None:
             pg = self.pgs.get(ar.acquired_pg[0])
             if pg is not None and pg.state == "CREATED":

@@ -126,6 +126,31 @@ DEFAULT_ALERT_RULES = [
         "severity": "warning",
         "summary": "A training-gang rank is straggling its steps",
     },
+    {
+        # Starved tenant (jobs.py): some job's per-task queue-wait p95 over
+        # the window exceeds the config knob — its tasks sit queued while
+        # (typically) another job's flood holds every lease. agg=max: the
+        # WORST job is the signal, whichever one it is.
+        "name": "job_starved",
+        "metric": "ray_tpu_job_queue_wait_seconds",
+        "kind": "quantile", "agg": "max", "window_s": 10.0, "q": 0.95,
+        "op": ">", "threshold_config_frac": ["job_starved_wait_s", 1.0],
+        "for_s": 3.0,
+        "severity": "warning",
+        "summary": "A job's queue-wait p95 says it is being starved",
+    },
+    {
+        # Runaway tenant: one job owns more than half the object-store byte
+        # budget — the usual prelude to object_store_near_cap, but with a
+        # name attached (the job label on the breaching series).
+        "name": "job_runaway_object_bytes",
+        "metric": "ray_tpu_job_object_bytes",
+        "kind": "gauge", "agg": "max", "window_s": 15.0,
+        "op": ">", "threshold_config_frac": ["object_store_memory", 0.5],
+        "for_s": 5.0,
+        "severity": "warning",
+        "summary": "One job owns over half the object-store byte budget",
+    },
 ]
 
 
@@ -723,6 +748,10 @@ class ObsState:
         self._last_eval = 0.0
         self._metrics: Optional[dict] = None
         self._last_events_total = 0
+        # Optional parsed-snapshot tap (JobLedger.ingest_snapshot): runs on
+        # the same already-parsed JSON this ingest pays for — per-job Serve
+        # request attribution costs no second parse and no new traffic.
+        self.snapshot_hook: Optional[Callable[[str, list], None]] = None
         # Standalone head servers have no driver context, so their registry
         # flusher can't reach the KV the normal way — give it a direct sink
         # into THIS process's GCS + store (no-op in in-proc drivers, whose
@@ -759,7 +788,10 @@ class ObsState:
         which is a metrics-pipeline change, not a store change."""
         try:
             pid = key.decode().split("::", 1)[1]
-            self.store.ingest(pid, json.loads(value))
+            snapshot = json.loads(value)
+            self.store.ingest(pid, snapshot)
+            if self.snapshot_hook is not None:
+                self.snapshot_hook(pid, snapshot)
         except Exception:  # noqa: BLE001 — malformed snapshot: skip
             pass
 

@@ -545,8 +545,8 @@ class DriverContext:
         self.scheduler.call("profile_collect", inner).result()
         return inner.result(timeout=60.0)
 
-    def memory_summary(self):
-        return self.scheduler.call("memory_summary", None).result()
+    def memory_summary(self, payload=None):
+        return self.scheduler.call("memory_summary", payload).result()
 
     def task_events(self):
         return self.scheduler.call("task_events", None).result()
@@ -574,11 +574,17 @@ class DriverContext:
     def obs_stats(self):
         return self.scheduler.call("obs_stats", None).result()
 
-    def list_actors(self):
-        return self.scheduler.call("list_actors", None).result()
+    def list_actors(self, payload=None):
+        return self.scheduler.call("list_actors", payload).result()
 
     def list_tasks(self, limit=1000):
         return self.scheduler.call("list_tasks", limit).result()
+
+    def list_jobs(self):
+        return self.scheduler.call("list_jobs", None).result()
+
+    def job_report(self, job):
+        return self.scheduler.call("job_report", job).result()
 
     def list_objects(self, limit=1000):
         return self.scheduler.call("list_objects", limit).result()
@@ -823,8 +829,8 @@ class RemoteDriverContext:
     def profile_collect(self):
         return self.wc.request("profile_collect", None, timeout=60.0)
 
-    def memory_summary(self):
-        return self.wc.request("driver_cmd", ("memory_summary", None))
+    def memory_summary(self, payload=None):
+        return self.wc.request("driver_cmd", ("memory_summary", payload))
 
     def task_events(self):
         return self.wc.request("driver_cmd", ("task_events", None))
@@ -850,11 +856,17 @@ class RemoteDriverContext:
     def obs_stats(self):
         return self.wc.request("driver_cmd", ("obs_stats", None))
 
-    def list_actors(self):
-        return self.wc.request("driver_cmd", ("list_actors", None))
+    def list_actors(self, payload=None):
+        return self.wc.request("driver_cmd", ("list_actors", payload))
 
     def list_tasks(self, limit=1000):
         return self.wc.request("driver_cmd", ("list_tasks", limit))
+
+    def list_jobs(self):
+        return self.wc.request("driver_cmd", ("list_jobs", None))
+
+    def job_report(self, job):
+        return self.wc.request("driver_cmd", ("job_report", job))
 
     def list_objects(self, limit=1000):
         return self.wc.request("driver_cmd", ("list_objects", limit))
@@ -1034,8 +1046,8 @@ class WorkerProcContext:
     def profile_collect(self):
         return self.rt.wc.request("profile_collect", None, timeout=60.0)
 
-    def memory_summary(self):
-        return self.rt.wc.request("driver_cmd", ("memory_summary", None))
+    def memory_summary(self, payload=None):
+        return self.rt.wc.request("driver_cmd", ("memory_summary", payload))
 
     def task_events(self):
         return self.rt.wc.request("driver_cmd", ("task_events", None))
@@ -1061,11 +1073,17 @@ class WorkerProcContext:
     def obs_stats(self):
         return self.rt.wc.request("driver_cmd", ("obs_stats", None))
 
-    def list_actors(self):
-        return self.rt.wc.request("driver_cmd", ("list_actors", None))
+    def list_actors(self, payload=None):
+        return self.rt.wc.request("driver_cmd", ("list_actors", payload))
 
     def list_tasks(self, limit=1000):
         return self.rt.wc.request("driver_cmd", ("list_tasks", limit))
+
+    def list_jobs(self):
+        return self.rt.wc.request("driver_cmd", ("list_jobs", None))
+
+    def job_report(self, job):
+        return self.rt.wc.request("driver_cmd", ("job_report", job))
 
     def list_objects(self, limit=1000):
         return self.rt.wc.request("driver_cmd", ("list_objects", limit))
@@ -1341,7 +1359,14 @@ def _init_client_mode(address: str, namespace: Optional[str],
         store = LocalObjectStore(own_dir, node_id=pull_node_id.binary())
 
     global_worker.mode = DRIVER_MODE
-    global_worker.job_id = JobID.from_int(1)
+    # The head mints a job id per attaching driver ("job_id" in the attach
+    # reply); every id this driver creates embeds it, which is how all of
+    # its usage is attributed with no per-message tags. Legacy heads without
+    # the field fall back to the shared job 1.
+    job_hex = info.get("job_id")
+    global_worker.job_id = (
+        JobID.from_hex(job_hex) if job_hex else JobID.from_int(1)
+    )
     global_worker.session_dir = None  # owned by the head, not us
     global_worker.store = store
     from ray_tpu._private.object_transfer import ObjectTransferManager

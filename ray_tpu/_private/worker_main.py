@@ -740,6 +740,10 @@ def _execute(rt: WorkerRuntime, req: ExecRequest, batch_done: bool = False):
     # Put-id minting and lineage attribution key off the module-level worker
     # state too (per-thread: threaded actors run concurrent calls).
     worker_mod.global_worker.current_task_id = spec.task_id
+    # Job identity rides the task id (ids.py embedding): nested submits and
+    # puts made DURING execution mint ids under the calling job, so the
+    # head's ledger attributes them to the right tenant.
+    worker_mod.global_worker.job_id = spec.task_id.actor_id.job_id
     cfg = rt.args.config
     if spec.env_vars:
         for k, v in spec.env_vars.items():

@@ -8,7 +8,7 @@ whichever process starts it (driver or head).
   GET /             tiny HTML overview
   GET /api/cluster  resource + entity rollup (state.summarize)
   GET /api/nodes    /api/actors  /api/tasks  /api/objects
-  GET /api/jobs     job-submission table
+  GET /api/jobs     per-job accounting ledgers (?job=<hex> for one report)
   GET /metrics      Prometheus text (util.metrics across all processes)
 """
 

@@ -143,9 +143,12 @@ class DashboardServer:
             # Training-gang goodput ledgers: ?gang= for one fit's report.
             return state_api.training_report((query or {}).get("gang"))
         if kind == "jobs":
-            from ray_tpu.job_submission import JobSubmissionClient
-
-            return JobSubmissionClient().list_jobs()
+            # Per-job accounting ledgers: every live driver plus the
+            # finished-jobs ring; ?job=<hex> for one tenant's full report.
+            job = (query or {}).get("job")
+            if job:
+                return state_api.job_report(job)
+            return state_api.list_jobs()
         raise KeyError(kind)
 
     async def _api(self, request):
@@ -199,6 +202,9 @@ class DashboardServer:
                 )
             if kind == "traces":
                 # /api/traces?trace_id=<unknown>: caller error.
+                return web.json_response({"error": str(e)}, status=400)
+            if kind == "jobs" and request.query.get("job"):
+                # /api/jobs?job=<unknown>: caller error.
                 return web.json_response({"error": str(e)}, status=400)
             return web.json_response({"error": str(e)}, status=503)
         except Exception as e:  # noqa: BLE001 — e.g. profiler disabled

@@ -62,7 +62,8 @@ INDEX_HTML = """<!doctype html>
   </section>
   <section><h2>Jobs</h2>
     <table id="jobs-table"><thead><tr>
-      <th>submission id</th><th>status</th><th>entrypoint</th><th>message</th>
+      <th>job</th><th>driver</th><th>state</th><th>cpu-s</th><th>tasks f/x/c</th>
+      <th>queue-wait s</th><th>object bytes</th><th>xfer bytes</th><th>serve reqs</th>
     </tr></thead><tbody></tbody></table>
   </section>
 </main>
@@ -122,10 +123,20 @@ async function refresh() {
       `<tr><td>${esc((t.task_id || "").slice(0, 14))}</td>` +
       `<td>${esc(t.name)}</td><td>${esc(t.state)}</td>` +
       `<td>${esc((t.node_id || "").slice(0, 14))}</td></tr>`));
-    fill("jobs-table", jobs.map((j) =>
-      `<tr><td>${esc(j.submission_id)}</td><td>${esc(j.status)}</td>` +
-      `<td>${esc(j.entrypoint || "")}</td>` +
-      `<td>${esc(j.message || "")}</td></tr>`));
+    const fmtB = (n) => n >= 1 << 20 ? (n / (1 << 20)).toFixed(1) + " MiB"
+      : n >= 1024 ? (n / 1024).toFixed(1) + " KiB" : String(n | 0);
+    fill("jobs-table", jobs.map((j) => {
+      const t = j.totals || {};
+      const k = t.tasks || {};
+      return `<tr><td>${esc(j.job)}</td><td>${esc(j.driver || "")}</td>` +
+        `<td class="${j.state === "LIVE" ? "ok" : ""}">${esc(j.state)}</td>` +
+        `<td>${esc((t.cpu_seconds ?? 0).toFixed(1))}</td>` +
+        `<td>${esc(k.finished ?? 0)}/${esc(k.failed ?? 0)}/${esc(k.cancelled ?? 0)}</td>` +
+        `<td>${esc((t.queue_wait_seconds ?? 0).toFixed(2))}</td>` +
+        `<td>${fmtB(t.object_bytes ?? 0)}</td>` +
+        `<td>${fmtB(t.transfer_bytes ?? 0)}</td>` +
+        `<td>${esc(t.serve_requests ?? 0)}</td></tr>`;
+    }));
     document.getElementById("updated").textContent =
       "updated " + new Date().toLocaleTimeString();
   } catch (e) {

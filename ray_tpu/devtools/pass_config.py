@@ -38,6 +38,7 @@ DEFAULT_CONFIG_MODULES = (
     "ray_tpu._private.retry",
     "ray_tpu._private.telemetry",
     "ray_tpu._private.timeseries",
+    "ray_tpu._private.jobs",
     "ray_tpu._private.object_store",
     "ray_tpu._private.head",
     "ray_tpu._private.launch",

@@ -490,6 +490,117 @@ def cmd_top(ns):
         pass
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _render_jobs(state_api, iteration: int) -> str:
+    """One frame of `ray_tpu jobs`: the tenant ledger as a top-like table —
+    who is using the cluster right now, at what rate, and who is starving."""
+    now = time.time()
+    jobs = state_api.list_jobs()
+
+    def last_rate(metric, job, agg="sum", q=None):
+        try:
+            res = state_api.query_series(
+                metric, labels={"job": job}, since=now - 15, step=5.0,
+                agg=agg, q=q,
+            )
+        except Exception:  # noqa: BLE001 — metrics off / head gone
+            return None
+        pts = [p for s in res["series"] for p in s["points"]
+               if p[1] is not None]
+        return pts[-1][1] if pts else None
+
+    try:
+        alerts = state_api.list_alerts()
+    except Exception:  # noqa: BLE001
+        alerts = []
+    firing = [a for a in alerts if a["state"] == "firing"]
+    # The job rules aggregate across tenants (agg=max), so attribute a
+    # firing rule to the jobs whose own value crosses its threshold.
+    starve_thresh = next((a["threshold"] for a in firing
+                          if a["name"] == "job_starved"), None)
+    runaway_thresh = next((a["threshold"] for a in firing
+                           if a["name"] == "job_runaway_object_bytes"), None)
+
+    lines = [f"ray_tpu jobs — {time.strftime('%H:%M:%S')} "
+             f"(refresh #{iteration})", ""]
+    hdr = (f"{'JOB':<10} {'STATE':<9} {'DRIVER':<18} {'CPU-S/S':>8} "
+           f"{'TASKS/S':>8} {'QW-P95':>8} {'OBJ':>9} {'XFER':>9} "
+           f"{'SERVE':>6}  ALERTS")
+    lines.append(hdr)
+    for j in jobs:
+        t = j.get("totals") or {}
+        job = j["job"]
+        live = j.get("state") == "LIVE"
+        cpu_rate = last_rate("ray_tpu_job_cpu_seconds_total", job) if live else None
+        task_rate = last_rate("ray_tpu_job_tasks_total", job) if live else None
+        qw_p95 = last_rate("ray_tpu_job_queue_wait_seconds", job,
+                           agg="max", q=0.95) if live else None
+        names = []
+        if (starve_thresh is not None and qw_p95 is not None
+                and qw_p95 > starve_thresh):
+            names.append("job_starved")
+        if (runaway_thresh is not None
+                and float(t.get("object_bytes") or 0) > runaway_thresh):
+            names.append("job_runaway_object_bytes")
+        alert_names = ",".join(names) or "-"
+        lines.append(
+            f"{job:<10} {j.get('state', ''):<9} "
+            f"{str(j.get('driver') or '')[:18]:<18} "
+            f"{'-' if cpu_rate is None else format(cpu_rate, '.2f'):>8} "
+            f"{'-' if task_rate is None else format(task_rate, '.1f'):>8} "
+            f"{'-' if qw_p95 is None else format(qw_p95, '.2f'):>8} "
+            f"{_fmt_bytes(t.get('object_bytes')):>9} "
+            f"{_fmt_bytes(t.get('transfer_bytes')):>9} "
+            f"{t.get('serve_requests', 0):>6}  {alert_names}"
+        )
+    if not jobs:
+        lines.append("(no jobs)")
+    lines.append("")
+    if firing:
+        lines.append("ALERTS FIRING:")
+        for a in firing:
+            lines.append(f"  !! {a['name']} ({a['severity']}): {a['summary']}")
+    else:
+        lines.append(f"alerts: {len(alerts)} rule(s), none firing")
+    return "\n".join(lines)
+
+
+def cmd_jobs(ns):
+    """Live per-job accounting view (`ray_tpu jobs`): cpu-s rate, tasks/s,
+    queue-wait p95, object/transfer bytes, serve requests, firing alerts."""
+    _connect(ns)
+    from ray_tpu.util import state as state_api
+
+    if ns.json:
+        print(json.dumps(state_api.job_report(ns.job) if ns.job
+                         else state_api.list_jobs(), indent=2, default=str))
+        return
+    if ns.job:
+        print(json.dumps(state_api.job_report(ns.job), indent=2, default=str))
+        return
+    i = 0
+    try:
+        while True:
+            i += 1
+            frame = _render_jobs(state_api, i)
+            if not ns.no_clear:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(frame, flush=True)
+            if ns.iterations and i >= ns.iterations:
+                break
+            time.sleep(ns.interval)
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_microbenchmark(_ns):
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     sys.path.insert(0, repo_root)
@@ -631,6 +742,18 @@ def main(argv=None) -> None:
                     help="append frames instead of clearing the screen")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("jobs", help="live per-job accounting view "
+                                     "(who is using the cluster)")
+    sp.add_argument("--job", help="one job's full ledger report (JSON)")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--iterations", type=int, default=0,
+                    help="stop after N frames (0 = until Ctrl-C)")
+    sp.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of clearing the screen")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_jobs)
 
     sp = sub.add_parser("microbenchmark", help="run the core microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)

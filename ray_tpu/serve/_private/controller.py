@@ -190,12 +190,19 @@ class ServeController:
             version = info.version
         # Emit OUTSIDE the lock: the event append is a blocking control-plane
         # round trip and long-poll listeners share self._lock.
+        # deploy() runs as an actor call, so the executing worker's job_id is
+        # the CALLING driver's (worker_main sets it per task): riding it on
+        # the event is what lets the head's JobLedger attribute this app's
+        # proxy request counters to the deploying tenant — no new wire tag.
+        from ray_tpu._private.worker import global_worker
+
+        job = global_worker.job_id.hex() if global_worker.job_id else None
         emit_event(
             "serve_deploy",
             f"app {info.name} v{version} deployed "
             f"({target} replica(s), route {info.route_prefix or '-'})",
             source="serve-controller", app=info.name, version=version,
-            replicas=target,
+            replicas=target, job=job,
         )
 
     def delete_deployment(self, name: str) -> None:

@@ -39,9 +39,11 @@ def list_nodes(include_postmortems: bool = False) -> List[Dict[str, Any]]:
     )
 
 
-def list_actors() -> List[Dict[str, Any]]:
+def list_actors(job: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Actor table; each entry carries the owning ``job_id`` (recovered from
+    the actor id's embedded job prefix). ``job=`` filters to one tenant."""
     _auto_init()
-    return global_worker.context.list_actors()
+    return global_worker.context.list_actors({"job": job} if job else None)
 
 
 # ------------------------------------------------------------- introspection
@@ -60,7 +62,10 @@ def stacks(timeout_s: float | None = None) -> Dict[str, Dict[str, Any]]:
 def transfer_stats() -> Dict[str, Any]:
     """Data-plane counters from the head: cumulative relay pulls/bytes (zero
     for peer-served workloads — the head answers location queries only),
-    locality-placement hits/misses, and live replica-directory size."""
+    locality-placement hits/misses, and live replica-directory size. When
+    job accounting is on, ``per_job_bytes`` maps job hex -> cumulative
+    data-plane bytes (relay pulls + replica fan-out) attributed via each
+    object's embedded owner-task job prefix."""
     _auto_init()
     return global_worker.context.transfer_stats()
 
@@ -125,6 +130,25 @@ def list_alerts() -> List[Dict[str, Any]]:
     evaluated value, and thresholds. Empty when `enable_metrics` is off."""
     _auto_init()
     return global_worker.context.list_alerts()
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    """Per-job ledger summaries: every live driver (state=LIVE) plus the
+    bounded finished-jobs ring (state=FINISHED, survives head restart under
+    --persist). Each entry: {job, driver, source, started_at, totals} with
+    totals = {cpu_seconds, tasks{submitted,finished,failed,cancelled},
+    queue_wait_seconds, object_byte_seconds, object_bytes, transfer_bytes,
+    serve_requests}. Raises when job accounting is off
+    (`enable_metrics=False` or `enable_obs=False`)."""
+    _auto_init()
+    return global_worker.context.list_jobs()
+
+
+def job_report(job: str) -> Dict[str, Any]:
+    """One job's full ledger record by job hex (live or finished). Raises
+    KeyError for unknown jobs and RuntimeError when accounting is off."""
+    _auto_init()
+    return global_worker.context.job_report(job)
 
 
 def on_alert(callback) -> None:
@@ -249,15 +273,18 @@ def latency_report(limit: int = 200) -> Dict[str, Any]:
     return critical_path.latency_report(spans, stages, limit=limit)
 
 
-def memory_summary() -> Dict[str, Any]:
+def memory_summary(job: Optional[str] = None) -> Dict[str, Any]:
     """`ray memory` analogue: per-object owner/refcount/location/size from
     the scheduler's ownership tables joined with the on-disk store state,
     grouped by creation site, with leak suspects (objects whose only
     references live on dead processes) and a store-dir scan flagging bytes
     no live object references (e.g. results stored by a worker that crashed
-    before reporting them)."""
+    before reporting them). Each object entry carries its owning ``job_id``
+    and the result includes a ``by_job`` rollup ({job: {count, bytes}});
+    ``job=`` narrows the per-object listing to one tenant (aggregates stay
+    cluster-wide)."""
     _auto_init()
-    return global_worker.context.memory_summary()
+    return global_worker.context.memory_summary({"job": job} if job else None)
 
 
 # Chrome-trace events of the most recent profile() run, merged into
@@ -359,9 +386,14 @@ def _stage_durations(stages: Dict[str, float]) -> Dict[str, float]:
     return out
 
 
-def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+def list_tasks(limit: int = 1000,
+               job: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Task table (live + recently-GCed summaries); each entry carries the
+    owning ``job_id`` recovered from the task id's embedded job prefix.
+    ``job=`` filters to one tenant before the ``limit`` tail is taken."""
     _auto_init()
-    out = global_worker.context.list_tasks(limit)
+    payload: Any = {"limit": limit, "job": job} if job else limit
+    out = global_worker.context.list_tasks(payload)
     for t in out:
         stages = t.get("stages") or {}
         if stages:

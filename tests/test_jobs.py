@@ -1,0 +1,596 @@
+"""Per-job resource accounting: cluster-wide usage attribution, tenant
+ledgers, and starvation alerts (reference: the reference's per-JobID GCS
+job table + `usage_stats` accounting; here the job identity is EMBEDDED in
+every TaskID/ActorID/ObjectID — `ids.py` prefix recovery — so the head's
+`JobLedger` attributes every lease-second, queue-wait, byte and Serve
+request to a tenant with zero new wire fields).
+
+Covers the PR acceptance gates:
+  * two concurrent TCP client drivers with disjoint workloads: per-job sums
+    reconcile with the global scheduler counters within 1%;
+  * `job_starved` fires and resolves live under a greedy-vs-light driver
+    mix (seeded);
+  * knob-off parity: `enable_obs=False` means no ledger, no-op emits, and
+    `list_jobs` raises;
+  * a client driver killed with PENDING tasks has their queue-wait accrual
+    closed at seal time (OwnerDiedError path) and its ledger finalized;
+  * finished-jobs ring cap + snapshot persistence across a head restart.
+"""
+
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.launch import spawn_head
+from ray_tpu.util import state as state_api
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _client_script(address: str, body: str) -> str:
+    return (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(address=%r)\n"
+        "from ray_tpu._private.worker import global_worker\n"
+        "print('JOB', global_worker.job_id.hex(), flush=True)\n"
+        % (REPO, address)
+    ) + body
+
+
+def _client_env(authkey_hex: str) -> dict:
+    env = dict(os.environ, RAY_TPU_AUTHKEY_HEX=authkey_hex)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_client(address, authkey_hex, body, timeout=120):
+    r = subprocess.run(
+        [sys.executable, "-c", _client_script(address, body)],
+        env=_client_env(authkey_hex),
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert r.returncode == 0, f"client failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+def _spawn_client(address, authkey_hex, body):
+    return subprocess.Popen(
+        [sys.executable, "-c", _client_script(address, body)],
+        env=_client_env(authkey_hex),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _job_of(stdout: str) -> str:
+    for line in stdout.splitlines():
+        if line.startswith("JOB "):
+            return line.split()[1]
+    raise AssertionError(f"no JOB line in:\n{stdout}")
+
+
+def _counter_total(name: str, since: float) -> float:
+    """Cumulative increase of a head counter over [since, now]: the store
+    serves counters as per-second rates per step window, so the total is
+    sum(rate * window_width) — the last window may be partial."""
+    res = state_api.query_series(name, since=since, step=1.0)
+    step = float(res["step"])
+    total = 0.0
+    for s in res["series"]:
+        prev_end = None
+        for end, rate in s["points"]:
+            width = step if prev_end is None else max(0.0, end - prev_end)
+            prev_end = end
+            if rate is not None:
+                total += rate * width
+    return total
+
+
+def _head_env(**overrides) -> dict:
+    saved = {}
+    for k, v in overrides.items():
+        key = f"RAY_TPU_{k}"
+        saved[key] = os.environ.get(key)
+        os.environ[key] = str(v)
+    return saved
+
+
+def _restore_env(saved: dict) -> None:
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _connect(info) -> None:
+    """Join the spawned head as a TCP client driver from THIS process."""
+    os.environ["RAY_TPU_AUTHKEY_HEX"] = info["authkey_hex"]
+    ray_tpu.init(address=info["address"])
+
+
+# ---------------------------------------------------------------------------
+# Attribution: two concurrent client drivers reconcile with global counters
+# ---------------------------------------------------------------------------
+def test_two_client_drivers_attribution_reconciles():
+    saved = _head_env(obs_series_step_s=0.25, alert_eval_interval_s=0.25)
+    proc = None
+    try:
+        proc, info = spawn_head(num_cpus=4, num_tpus=0, timeout_s=60)
+        _connect(info)
+
+        # Prime the global scheduler counters into the time-series store:
+        # the store's first sight of a counter sets the delta cursor without
+        # emitting a point, so the measured window must start AFTER the
+        # counters' first flush has landed.
+        @ray_tpu.remote
+        def primer():
+            return 0
+
+        ray_tpu.get([primer.remote() for _ in range(2)])
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            res = state_api.query_series(
+                "ray_tpu_scheduler_tasks_terminal_total", since=0, step=5.0
+            )
+            if res["series"]:
+                break
+            time.sleep(0.3)
+        assert res["series"], "scheduler counters never reached the store"
+        time.sleep(1.5)  # let the primer's own deltas land pre-window
+        t0 = time.time()
+        body_a = """
+@ray_tpu.remote
+def fa(i):
+    return i * 2
+refs = [fa.remote(i) for i in range(40)]
+assert sum(ray_tpu.get(refs)) == sum(2 * i for i in range(40))
+ray_tpu.put(b"x" * 10_000)
+print("DONE A")
+"""
+        body_b = """
+@ray_tpu.remote
+def fb(i):
+    return i + 1
+refs = [fb.remote(i) for i in range(15)]
+assert sum(ray_tpu.get(refs)) == sum(i + 1 for i in range(15))
+print("DONE B")
+"""
+        pa = _spawn_client(info["address"], info["authkey_hex"], body_a)
+        pb = _spawn_client(info["address"], info["authkey_hex"], body_b)
+        out_a, _ = pa.communicate(timeout=120)
+        out_b, _ = pb.communicate(timeout=120)
+        assert pa.returncode == 0, out_a
+        assert pb.returncode == 0, out_b
+        job_a, job_b = _job_of(out_a), _job_of(out_b)
+        assert job_a != job_b
+
+        def finished_jobs():
+            return {
+                j["job"]: j for j in state_api.list_jobs()
+                if j["state"] == "FINISHED"
+            }
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if {job_a, job_b} <= set(finished_jobs()):
+                break
+            time.sleep(0.25)
+        ledger = finished_jobs()
+        assert {job_a, job_b} <= set(ledger), ledger
+
+        ta = ledger[job_a]["totals"]
+        tb = ledger[job_b]["totals"]
+        # Disjoint workloads attribute exactly.
+        assert ta["tasks"]["submitted"] == 40
+        assert ta["tasks"]["finished"] == 40
+        assert tb["tasks"]["submitted"] == 15
+        assert tb["tasks"]["finished"] == 15
+        assert ta["cpu_seconds"] > 0
+        assert tb["cpu_seconds"] > 0
+        # put() bytes land on the putting job (resident gauge may have gone
+        # back to 0 after driver death; byte-seconds must have accrued).
+        assert ta["object_byte_seconds"] >= 0
+
+        # Per-job ledger sums reconcile with the head's global scheduler
+        # counters (drained into the time-series store) within 1%. Only the
+        # two client jobs submitted anything inside [t0, now].
+        per_job_submitted = float(
+            ta["tasks"]["submitted"] + tb["tasks"]["submitted"]
+        )
+        per_job_terminal = float(sum(
+            t["tasks"][k]
+            for t in (ta, tb)
+            for k in ("finished", "failed", "cancelled")
+        ))
+        deadline = time.time() + 20
+        global_submitted = global_terminal = 0.0
+        while time.time() < deadline:
+            global_submitted = _counter_total(
+                "ray_tpu_scheduler_tasks_submitted_total", t0
+            )
+            global_terminal = _counter_total(
+                "ray_tpu_scheduler_tasks_terminal_total", t0
+            )
+            if (global_submitted >= per_job_submitted - 0.5
+                    and global_terminal >= per_job_terminal - 0.5):
+                break
+            time.sleep(0.5)
+        assert abs(global_submitted - per_job_submitted) <= max(
+            1.0, 0.01 * per_job_submitted
+        ), (global_submitted, per_job_submitted)
+        assert abs(global_terminal - per_job_terminal) <= max(
+            1.0, 0.01 * per_job_terminal
+        ), (global_terminal, per_job_terminal)
+
+        # job_report round-trips both live (this driver) and finished jobs.
+        rep = state_api.job_report(job_a)
+        assert rep["totals"]["tasks"]["finished"] == 40
+        with pytest.raises(Exception):
+            state_api.job_report("ffffffff")
+
+        # Lifecycle events made it to the cluster event log.
+        evs = state_api.list_cluster_events(kind="job_started")
+        assert {job_a, job_b} <= {
+            e["data"].get("job") for e in evs if e["data"].get("job")
+        }
+        evs = state_api.list_cluster_events(kind="job_finished")
+        assert {job_a, job_b} <= {
+            e["data"].get("job") for e in evs if e["data"].get("job")
+        }
+    finally:
+        _restore_env(saved)
+        os.environ.pop("RAY_TPU_AUTHKEY_HEX", None)
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Starvation alert: greedy-vs-light driver mix, live fire -> resolve
+# ---------------------------------------------------------------------------
+def test_job_starved_alert_fires_and_resolves_live():
+    """A greedy client floods a 2-CPU head with long tasks; the light
+    driver's short tasks queue behind the flood, their queue-wait p95
+    breaches `job_starved_wait_s`, and the `job_starved` rule fires. Once
+    the greedy driver leaves, the high waits age out of the rule window and
+    the alert resolves (hysteresis both ways)."""
+    random.seed(20)
+    saved = _head_env(
+        obs_series_step_s=0.25, alert_eval_interval_s=0.25,
+        job_starved_wait_s=0.5,
+        # Depth-1 pipelining: contention shows up as true PENDING time (the
+        # queue-wait the ledger meters), not as worker-pipeline residency.
+        worker_pipeline_depth=1,
+    )
+    proc = greedy = None
+    try:
+        proc, info = spawn_head(num_cpus=2, num_tpus=0, timeout_s=60)
+        greedy_body = """
+import time
+@ray_tpu.remote
+def hog():
+    time.sleep(0.6)
+deadline = time.time() + 12
+inflight = []
+while time.time() < deadline:
+    while len(inflight) < 6:
+        inflight.append(hog.remote())
+    done, inflight = inflight[:1], inflight[1:]
+    ray_tpu.get(done)
+print("GREEDY DONE", flush=True)
+"""
+        greedy = _spawn_client(info["address"], info["authkey_hex"],
+                               greedy_body)
+        _connect(info)
+
+        @ray_tpu.remote
+        def light():
+            return 1
+
+        def alert_state():
+            for a in state_api.list_alerts():
+                if a["name"] == "job_starved":
+                    return a["state"]
+            return None
+
+        assert alert_state() in ("ok", "pending")
+        t_start = time.time()
+        # Light tenant: trickle short tasks through the flood; each waits
+        # behind the greedy backlog, feeding high queue-wait observations.
+        deadline = time.time() + 45
+        fired = False
+        while time.time() < deadline:
+            ray_tpu.get(light.remote(), timeout=60)
+            if alert_state() == "firing":
+                fired = True
+                break
+            time.sleep(random.uniform(0.05, 0.15))
+        assert fired, "job_starved never fired under greedy flood"
+        evs = state_api.list_cluster_events(kind="alert_firing",
+                                            since=t_start - 1)
+        assert any(e["data"].get("rule") == "job_starved" for e in evs)
+
+        # The greedy driver drains/exits; waits age out of the 10s window
+        # and the clear holds for for_s before the resolve lands.
+        greedy.communicate(timeout=60)
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            if alert_state() == "ok":
+                break
+            ray_tpu.get(light.remote(), timeout=60)
+            time.sleep(0.5)
+        assert alert_state() == "ok", "job_starved never resolved"
+        evs = state_api.list_cluster_events(kind="alert_resolved",
+                                            since=t_start - 1)
+        assert any(e["data"].get("rule") == "job_starved" for e in evs)
+    finally:
+        _restore_env(saved)
+        os.environ.pop("RAY_TPU_AUTHKEY_HEX", None)
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        if greedy is not None and greedy.poll() is None:
+            greedy.kill()
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Hygiene: killed client driver with PENDING tasks closes queue-wait at seal
+# ---------------------------------------------------------------------------
+def test_killed_driver_pending_tasks_sealed_into_ledger():
+    # Pipelining off: the backlog must sit genuinely PENDING (queue-wait
+    # still open) when the owner dies — the hygiene path under test.
+    saved = _head_env(worker_pipeline_depth=1)
+    proc = victim = None
+    try:
+        proc, info = spawn_head(num_cpus=1, num_tpus=0, timeout_s=60)
+        victim_body = """
+import time
+@ray_tpu.remote
+def long_task():
+    time.sleep(60)
+@ray_tpu.remote
+def queued_task():
+    return 1
+refs = [long_task.remote()] + [queued_task.remote() for _ in range(5)]
+print("READY", flush=True)
+time.sleep(120)
+"""
+        victim = _spawn_client(info["address"], info["authkey_hex"],
+                               victim_body)
+        job_line = victim.stdout.readline()
+        assert job_line.startswith("JOB "), job_line
+        victim_job = job_line.split()[1]
+        assert victim.stdout.readline().startswith("READY")
+        time.sleep(1.5)  # let the PENDING tasks accrue real queue-wait
+        victim.kill()
+        victim.wait(timeout=30)
+
+        _connect(info)
+        deadline = time.time() + 30
+        rec = None
+        while time.time() < deadline:
+            recs = [j for j in state_api.list_jobs()
+                    if j["job"] == victim_job and j["state"] == "FINISHED"]
+            if recs:
+                rec = recs[0]
+                break
+            time.sleep(0.25)
+        assert rec is not None, "victim job never finalized into the ring"
+        totals = rec["totals"]
+        assert totals["tasks"]["submitted"] == 6
+        # The 5 PENDING tasks seal as cancelled via the dead-owner path;
+        # the RUNNING one either seals too or has its open lease accrual
+        # closed by the finalize (cpu_seconds > 0 either way).
+        sealed = sum(totals["tasks"][k]
+                     for k in ("finished", "failed", "cancelled"))
+        assert sealed >= 5, totals
+        assert totals["tasks"]["cancelled"] >= 5, totals
+        assert totals["cpu_seconds"] > 0, totals
+        # THE hygiene fix: the PENDING tasks' queue-wait accrual was closed
+        # at seal time, not leaked as open intervals.
+        assert totals["queue_wait_seconds"] >= 5 * 1.0, totals
+        assert rec.get("reason") == "driver disconnected"
+    finally:
+        _restore_env(saved)
+        os.environ.pop("RAY_TPU_AUTHKEY_HEX", None)
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        if victim is not None and victim.poll() is None:
+            victim.kill()
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Knob-off parity
+# ---------------------------------------------------------------------------
+def test_enable_obs_off_means_no_ledger():
+    ray_tpu.init(num_cpus=2, _system_config={"enable_obs": False})
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(1)) == 2
+        from ray_tpu._private.worker import global_worker
+
+        sched = global_worker.node
+        assert sched.jobs is None  # the knob-off contract: no ledger at all
+        with pytest.raises(RuntimeError, match="job accounting disabled"):
+            state_api.list_jobs()
+        with pytest.raises(RuntimeError, match="job accounting disabled"):
+            state_api.job_report("01000000")
+        # The id-embedded attribution fields stay on the listing surfaces
+        # (identity is unconditional; only the METERING is knob-gated).
+        tasks = state_api.list_tasks()
+        assert tasks and all(t.get("job_id") for t in tasks)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_enable_metrics_off_means_no_ledger():
+    ray_tpu.init(num_cpus=1, _system_config={"enable_metrics": False})
+    try:
+        @ray_tpu.remote
+        def f():
+            return 7
+
+        assert ray_tpu.get(f.remote()) == 7
+        with pytest.raises(RuntimeError, match="job accounting disabled"):
+            state_api.list_jobs()
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# In-proc attribution surfaces
+# ---------------------------------------------------------------------------
+def test_inproc_job_surfaces_and_filters():
+    ray_tpu.init(num_cpus=2, _system_config={"alert_eval_interval_s": 0.2})
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.remote()
+        ray_tpu.get([f.remote(i) for i in range(8)])
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+        held = ray_tpu.put(b"y" * 4096)  # keep resident for the sampler
+        # Wait for a ledger tick (resident-bytes sample + metric flush).
+        deadline = time.time() + 10
+        jobs = state_api.list_jobs()
+        while time.time() < deadline:
+            jobs = state_api.list_jobs()
+            if jobs and jobs[0]["totals"]["object_bytes"] > 0:
+                break
+            time.sleep(0.2)
+        assert len(jobs) == 1 and jobs[0]["state"] == "LIVE"
+        job = jobs[0]["job"]
+        assert jobs[0]["source"] == "inproc"
+        totals = jobs[0]["totals"]
+        assert totals["tasks"]["submitted"] >= 9  # 8 tasks + actor call
+        assert totals["object_bytes"] > 0
+
+        # job= filters on the listing surfaces.
+        tasks = state_api.list_tasks(job=job)
+        assert tasks and all(t["job_id"] == job for t in tasks)
+        assert state_api.list_tasks(job="ffffffff") == []
+        actors = state_api.list_actors(job=job)
+        assert actors and all(x["job_id"] == job for x in actors)
+        mem = state_api.memory_summary()
+        assert mem["by_job"].get(job, {}).get("count", 0) > 0
+        filtered = state_api.memory_summary(job="ffffffff")
+        assert filtered["objects"] == []
+        assert "per_job_bytes" in state_api.transfer_stats()
+
+        # The per-job metric families reach the head store at flush cadence.
+        # Keep submitting so post-baseline counter deltas land (the store's
+        # first sight of a counter series only sets its delta cursor).
+        deadline = time.time() + 20
+        landed = False
+        while time.time() < deadline and not landed:
+            ray_tpu.get(f.remote(0))
+            res = state_api.query_series(
+                "ray_tpu_job_tasks_total", labels={"job": job},
+                since=0, step=5.0,
+            )
+            landed = any(
+                p[1] for s in res["series"] for p in s["points"] if p[1]
+            )
+            if not landed:
+                time.sleep(0.3)
+        assert landed, "ray_tpu_job_tasks_total never reached the store"
+        del held
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Finished-jobs ring: cap + snapshot persistence across head restart
+# ---------------------------------------------------------------------------
+def test_finished_jobs_ring_cap_and_snapshot_roundtrip():
+    from ray_tpu._private.gcs import GCS
+
+    g = GCS()
+    g.set_finished_job_cap(3)
+    for i in range(5):
+        g.append_finished_job({"job": f"{i:08d}", "totals": {}})
+    ring = g.finished_job_list()
+    assert [r["job"] for r in ring] == ["00000002", "00000003", "00000004"]
+
+    blob = g.snapshot_bytes()
+    g2 = GCS()
+    g2.restore_bytes(blob)
+    assert [r["job"] for r in g2.finished_job_list()] == [
+        "00000002", "00000003", "00000004"
+    ]
+    # Shrinking the cap keeps the newest entries.
+    g2.set_finished_job_cap(2)
+    assert [r["job"] for r in g2.finished_job_list()] == [
+        "00000003", "00000004"
+    ]
+
+
+def test_finished_jobs_survive_head_restart(tmp_path):
+    persist = str(tmp_path / "gcs.bin")
+    proc = proc2 = None
+    try:
+        proc, info = spawn_head(
+            num_cpus=2, num_tpus=0, timeout_s=60, port=0,
+            extra_args=("--persist", persist, "--persist-interval", "0.2"),
+        )
+        out = _run_client(info["address"], info["authkey_hex"], """
+@ray_tpu.remote
+def f(i):
+    return i
+assert ray_tpu.get([f.remote(i) for i in range(10)]) == list(range(10))
+print("DONE")
+""")
+        job = _job_of(out)
+        # Wait for the finalized ledger to hit the persisted journal.
+        time.sleep(2.0)
+        proc.terminate()
+        proc.wait(timeout=30)
+        proc = None
+
+        proc2, info2 = spawn_head(
+            num_cpus=2, num_tpus=0, timeout_s=60, port=0,
+            extra_args=("--persist", persist, "--persist-interval", "0.2"),
+        )
+        out = _run_client(info2["address"], info2["authkey_hex"], """
+from ray_tpu.util import state
+jobs = {j["job"]: j for j in state.list_jobs()
+        if j["state"] == "FINISHED"}
+print("RING", sorted(jobs))
+rec = jobs[%r]
+assert rec["totals"]["tasks"]["finished"] == 10, rec
+print("PERSISTED OK")
+""" % job)
+        assert "PERSISTED OK" in out
+    finally:
+        for p in (proc, proc2):
+            if p is not None:
+                p.terminate()
+                p.wait(timeout=30)

@@ -124,6 +124,21 @@ def test_dashboard_rest_and_metrics(ray_start_regular):
             urllib.request.urlopen(f"{base}/api/memory", timeout=15).read()
         )
         assert "shm_bytes" in memory and "leak_suspects" in memory
+        # Per-job accounting: the ledger list, the single-job report, and a
+        # JSON 400 for an unknown job id.
+        jobs = json.loads(
+            urllib.request.urlopen(f"{base}/api/jobs", timeout=15).read()
+        )
+        assert any(j["job"] == "01000000" for j in jobs), jobs
+        report = json.loads(
+            urllib.request.urlopen(f"{base}/api/jobs?job=01000000", timeout=15).read()
+        )
+        assert report["state"] == "LIVE" and "totals" in report
+        try:
+            urllib.request.urlopen(f"{base}/api/jobs?job=ffffffff", timeout=15)
+            raise AssertionError("unknown job must 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
         # The live web UI: self-contained page whose JS polls the REST
         # endpoints the assertions above proved live — node/actor/task/job
         # tables plus the refresh loop (reference: dashboard/client SPA).

@@ -53,6 +53,10 @@ echo "== observability smoke (series history, event log, shed alert fire->resolv
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
 echo
+echo "== jobs smoke (2-driver mini-cluster: attribution + job_starved fire->resolve) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/jobs_smoke.py
+
+echo
 echo "== trace smoke (one Serve request traced proxy->router->replica->task, latency report) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/trace_smoke.py
 

@@ -1,0 +1,176 @@
+"""Job-accounting smoke for tools/check.sh: on a 2-driver mini-cluster
+(spawned head + two TCP client drivers), the head's JobLedger must attribute
+each driver's disjoint workload to its own job exactly, the per-job sums
+must reconcile with the known workload sizes, and the `job_starved` alert
+must FIRE under a greedy-vs-light driver mix and RESOLVE once the greedy
+tenant leaves. Fast (<~90s) and assertion-fatal — a broken attribution
+layer fails the pre-merge gate before tier-1 runs."""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_A, N_B = 30, 12
+
+
+def _client(address, authkey_hex, body):
+    env = dict(os.environ, RAY_TPU_AUTHKEY_HEX=authkey_hex)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    script = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(address=%r)\n"
+        "from ray_tpu._private.worker import global_worker\n"
+        "print('JOB', global_worker.job_id.hex(), flush=True)\n"
+        % (REPO, address)
+    ) + body
+    return subprocess.Popen(
+        [sys.executable, "-c", script], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _job_of(stdout: str) -> str:
+    for line in stdout.splitlines():
+        if line.startswith("JOB "):
+            return line.split()[1]
+    raise AssertionError(f"no JOB line in:\n{stdout}")
+
+
+def main() -> int:
+    # Head knobs ride the env into the spawned process: fast obs cadence, a
+    # low starvation bar, and depth-1 pipelining so contention is PENDING
+    # time (what the ledger meters), not worker-pipeline residency.
+    os.environ["RAY_TPU_obs_series_step_s"] = "0.25"
+    os.environ["RAY_TPU_alert_eval_interval_s"] = "0.25"
+    os.environ["RAY_TPU_job_starved_wait_s"] = "0.5"
+    os.environ["RAY_TPU_worker_pipeline_depth"] = "1"
+
+    from ray_tpu._private.launch import spawn_head
+
+    proc, info = spawn_head(num_cpus=2, num_tpus=0, timeout_s=60)
+    os.environ["RAY_TPU_AUTHKEY_HEX"] = info["authkey_hex"]
+    import ray_tpu
+    from ray_tpu.util import state
+
+    greedy = None
+    try:
+        # --- attribution: two client drivers, disjoint workloads.
+        pa = _client(info["address"], info["authkey_hex"], f"""
+@ray_tpu.remote
+def fa(i):
+    return i * 2
+assert ray_tpu.get([fa.remote(i) for i in range({N_A})]) == [
+    2 * i for i in range({N_A})]
+print("DONE A")
+""")
+        pb = _client(info["address"], info["authkey_hex"], f"""
+@ray_tpu.remote
+def fb(i):
+    return i + 1
+assert ray_tpu.get([fb.remote(i) for i in range({N_B})]) == [
+    i + 1 for i in range({N_B})]
+print("DONE B")
+""")
+        out_a, _ = pa.communicate(timeout=120)
+        out_b, _ = pb.communicate(timeout=120)
+        assert pa.returncode == 0, out_a
+        assert pb.returncode == 0, out_b
+        job_a, job_b = _job_of(out_a), _job_of(out_b)
+        assert job_a != job_b
+
+        ray_tpu.init(address=info["address"])
+        deadline = time.time() + 30
+        ledger = {}
+        while time.time() < deadline:
+            ledger = {j["job"]: j for j in state.list_jobs()
+                      if j["state"] == "FINISHED"}
+            if {job_a, job_b} <= set(ledger):
+                break
+            time.sleep(0.25)
+        assert {job_a, job_b} <= set(ledger), sorted(ledger)
+        ta = ledger[job_a]["totals"]
+        tb = ledger[job_b]["totals"]
+        assert ta["tasks"]["submitted"] == N_A, ta
+        assert ta["tasks"]["finished"] == N_A, ta
+        assert tb["tasks"]["submitted"] == N_B, tb
+        assert tb["tasks"]["finished"] == N_B, tb
+        assert ta["cpu_seconds"] > 0 and tb["cpu_seconds"] > 0
+        total = sum(j["totals"]["tasks"]["submitted"]
+                    for j in state.list_jobs())
+        assert total == N_A + N_B, total
+        print(f"attribution: {job_a}={N_A} tasks, {job_b}={N_B} tasks, "
+              f"sum reconciles OK")
+
+        # --- starvation: greedy client floods the 2 CPUs; this (light)
+        # driver's short tasks queue behind it -> job_starved fires.
+        greedy = _client(info["address"], info["authkey_hex"], """
+import time
+@ray_tpu.remote
+def hog():
+    time.sleep(0.6)
+deadline = time.time() + 12
+inflight = []
+while time.time() < deadline:
+    while len(inflight) < 6:
+        inflight.append(hog.remote())
+    done, inflight = inflight[:1], inflight[1:]
+    ray_tpu.get(done)
+print("GREEDY DONE", flush=True)
+""")
+
+        @ray_tpu.remote
+        def light():
+            return 1
+
+        def alert_state():
+            for a in state.list_alerts():
+                if a["name"] == "job_starved":
+                    return a["state"]
+            return None
+
+        fired = False
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            ray_tpu.get(light.remote(), timeout=60)
+            if alert_state() == "firing":
+                fired = True
+                break
+            time.sleep(0.1)
+        assert fired, "job_starved never fired under the greedy flood"
+        assert any(
+            e["data"].get("rule") == "job_starved"
+            for e in state.list_cluster_events(kind="alert_firing")
+        )
+        print("alerts: job_starved FIRING under greedy-vs-light mix OK")
+
+        greedy.communicate(timeout=60)
+        deadline = time.time() + 45
+        while time.time() < deadline and alert_state() != "ok":
+            ray_tpu.get(light.remote(), timeout=60)
+            time.sleep(0.5)
+        assert alert_state() == "ok", "job_starved never resolved"
+        assert any(
+            e["data"].get("rule") == "job_starved"
+            for e in state.list_cluster_events(kind="alert_resolved")
+        )
+        print("alerts: job_starved RESOLVED after the greedy driver left OK")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        if greedy is not None and greedy.poll() is None:
+            greedy.kill()
+        proc.terminate()
+        proc.wait(timeout=30)
+    print("JOBS_SMOKE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
